@@ -1,0 +1,30 @@
+"""CommEfficient-TPU: a TPU-native communication-efficient federated learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+amitport/CommEfficient (FetchSGD et al.): count-sketch gradient
+compression, top-k sparsification, FedAvg local SGD, error feedback and
+momentum (local or virtual), differential privacy, and per-client
+communication accounting — built for SPMD execution over a TPU device
+mesh rather than a parameter-server + NCCL worker topology.
+
+Architecture (vs. the reference's process topology, see SURVEY.md §1):
+
+- The reference runs 1 parameter-server process + N worker GPU
+  processes connected by multiprocessing queues, host shared memory and
+  one NCCL ``reduce`` per round.  Here a federated round is a single
+  jitted SPMD program over a ``jax.sharding.Mesh``: participating
+  clients are vmapped/sharded over the ``clients`` mesh axis, the
+  gradient/sketch aggregation is a sum that XLA lowers to an ICI
+  all-reduce, and the (deterministic) server step runs replicated on
+  every device — no parameter-server rank exists.
+
+- The entire model is a single flat f32 parameter vector (same
+  invariant as reference fed_aggregator.py:81-97), produced by
+  ``jax.flatten_util.ravel_pytree``; compression, error feedback,
+  momentum and the server update all operate on this vector or on its
+  ``(num_rows, num_cols)`` count-sketch.
+"""
+
+__version__ = "0.1.0"
+
+from commefficient_tpu.config import Config, parse_args  # noqa: F401

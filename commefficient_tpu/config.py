@@ -1,0 +1,308 @@
+"""Config / flag system.
+
+Mirrors the reference's single argparse surface (utils.py:102-230 in
+/root/reference/CommEfficient) flag-for-flag so experiment commands
+port 1:1, but materialises the result in a typed ``Config`` dataclass
+that the jitted runtime treats as static. TPU-specific knobs (mesh
+shape, dtype policy) are additive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Sequence
+
+MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
+ERROR_TYPES = ("none", "local", "virtual")
+DP_MODES = ("worker", "server")
+
+# dataset -> num classes (reference utils.py:37-44)
+FED_DATASETS = {
+    "CIFAR10": 10,
+    "CIFAR100": 100,
+    "EMNIST": 62,
+    "ImageNet": 1000,
+    "PERSONA": -1,
+    "Synthetic": 10,
+}
+
+# natural client counts when --num_clients is omitted
+# (reference fed_aggregator.py:66-73)
+NATURAL_NUM_CLIENTS = {
+    "EMNIST": 3500,
+    "CIFAR10": None,  # non-iid CIFAR10 unsupported without --num_clients
+    "PERSONA": 17568,
+}
+
+
+def num_classes_of_dataset(dataset_name: str) -> int:
+    return FED_DATASETS[dataset_name]
+
+
+@dataclasses.dataclass
+class Config:
+    """Typed mirror of the reference's parsed args (utils.py:102-230)."""
+
+    # meta
+    do_test: bool = False
+    mode: str = "sketch"
+    use_tensorboard: bool = False
+    seed: int = 21
+
+    # model/data
+    model: str = "ResNet9"
+    do_finetune: bool = False
+    do_checkpoint: bool = False
+    checkpoint_path: str = "./checkpoint"
+    finetune_path: str = "./finetune"
+    finetuned_from: Optional[str] = None
+    num_results_train: int = 2
+    num_results_val: int = 2
+    dataset_name: str = ""
+    dataset_dir: str = "./dataset"
+    do_batchnorm: bool = False
+    nan_threshold: float = 999.0
+
+    # compression
+    k: int = 50000
+    num_cols: int = 500000
+    num_rows: int = 5
+    num_blocks: int = 20
+    do_topk_down: bool = False
+
+    # optimization
+    local_momentum: float = 0.9
+    virtual_momentum: float = 0.0
+    weight_decay: float = 5e-4
+    num_epochs: float = 24.0
+    num_fedavg_epochs: int = 1
+    fedavg_batch_size: int = -1
+    fedavg_lr_decay: float = 1.0
+    error_type: str = "none"
+    lr_scale: Optional[float] = None
+    pivot_epoch: float = 5.0
+
+    # parallelization
+    port: int = 5315  # kept for CLI parity; unused (no sockets in SPMD runtime)
+    num_clients: Optional[int] = None
+    num_workers: int = 1  # participating clients per round
+    device: str = "tpu"
+    num_devices: int = 1
+    share_ps_gpu: bool = False  # parity no-op: there is no PS rank
+    do_iid: bool = False
+    train_dataloader_workers: int = 0
+    val_dataloader_workers: int = 0
+
+    # GPT-2 / text
+    model_checkpoint: str = "gpt2"
+    num_candidates: int = 2
+    max_history: int = 2
+    local_batch_size: int = 8
+    valid_batch_size: int = 8
+    microbatch_size: int = -1
+    lm_coef: float = 1.0
+    mc_coef: float = 1.0
+    max_grad_norm: Optional[float] = None
+    personality_permutations: int = 1
+    eval_before_start: bool = False
+
+    # differential privacy
+    do_dp: bool = False
+    dp_mode: str = "worker"
+    l2_norm_clip: float = 1.0
+    noise_multiplier: float = 0.0
+
+    # --- TPU-native additions (no reference equivalent) ---
+    mesh_shape: Optional[Sequence[int]] = None  # default: all local devices
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"  # set bfloat16 for MXU throughput
+
+    # populated at runtime (reference sets args.grad_size the same way,
+    # fed_aggregator.py:88)
+    grad_size: int = 0
+
+    def __post_init__(self):
+        self.validate()
+
+    def validate(self) -> "Config":
+        """Parse-time cross-flag validation — same checks, same timing
+        as the reference's parse_args (utils.py:225-228): only the
+        fedavg combination is rejected up front."""
+        assert self.mode in MODES, self.mode
+        assert self.error_type in ERROR_TYPES, self.error_type
+        assert self.dp_mode in DP_MODES, self.dp_mode
+        if self.mode == "fedavg":
+            assert self.local_batch_size == -1, \
+                "fedavg requires --local_batch_size -1"
+            assert self.local_momentum == 0, \
+                "fedavg requires --local_momentum 0"
+            assert self.error_type == "none", \
+                "fedavg requires --error_type none"
+        return self
+
+    def validate_runtime(self) -> "Config":
+        """Mode-lattice invariants, checked when the federated runtime
+        is built (the reference enforces these in the worker/server hot
+        path: fed_worker.py:206-230, fed_aggregator.py:514, 575-578).
+
+        NB the reference's *defaults* (mode=sketch + local_momentum
+        0.9) violate these and crash on the first training round;
+        failing here at setup is the friendlier equivalent.
+        """
+        self.validate()
+        if self.mode == "sketch":
+            # sketched SGD with local error/momentum is undefined: we
+            # can't know which part of a sketch is "error"
+            # (fed_worker.py:221-230)
+            assert self.error_type != "local", \
+                "sketch mode cannot use local error accumulation"
+            assert self.local_momentum == 0, \
+                "sketch mode cannot use local momentum " \
+                "(momentum factor masking is impossible in sketch space)"
+            if self.error_type == "local":
+                assert self.virtual_momentum == 0
+            elif self.error_type == "virtual":
+                assert self.local_momentum == 0
+        if self.mode == "true_topk":
+            # virtual error is required server-side (fed_aggregator.py:514)
+            assert self.error_type == "virtual", \
+                "true_topk requires --error_type virtual"
+        if self.mode == "local_topk":
+            assert self.error_type in ("local", "none"), \
+                "local_topk cannot use virtual error (fed_aggregator.py:547)"
+        if self.mode == "uncompressed":
+            assert self.error_type != "local", \
+                "local error accumulation is pointless uncompressed " \
+                "(fed_worker.py:223-224)"
+        return self
+
+    @property
+    def resolved_num_clients(self) -> Optional[int]:
+        if self.num_clients is not None:
+            return self.num_clients
+        return NATURAL_NUM_CLIENTS.get(self.dataset_name)
+
+    @property
+    def transmit_shape(self):
+        """Shape of what one client transmits (and of server V/error
+        state): the sketch table in sketch mode, else the flat grad
+        (reference fed_worker.py:45-50, fed_aggregator.py:403-407)."""
+        if self.mode == "sketch":
+            return (self.num_rows, self.num_cols)
+        return (self.grad_size,)
+
+    @property
+    def upload_floats_per_client(self) -> int:
+        """Floats uploaded per participating client per round
+        (reference fed_aggregator.py:292-300)."""
+        return {
+            "uncompressed": self.grad_size,
+            "true_topk": self.grad_size,
+            "local_topk": self.k,
+            "sketch": self.num_rows * self.num_cols,
+            "fedavg": self.grad_size,
+        }[self.mode]
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def build_parser(default_lr: Optional[float] = None,
+                 model_names: Optional[Sequence[str]] = None
+                 ) -> argparse.ArgumentParser:
+    """Argparse surface — same flags as reference utils.py:102-214."""
+    parser = argparse.ArgumentParser()
+
+    # meta-args
+    parser.add_argument("--test", action="store_true", dest="do_test")
+    parser.add_argument("--mode", choices=MODES, default="sketch")
+    parser.add_argument("--tensorboard", dest="use_tensorboard",
+                        action="store_true")
+    parser.add_argument("--seed", type=int, default=21)
+
+    # data/model args
+    if model_names is None:
+        from commefficient_tpu import models
+        model_names = models.model_names()
+    parser.add_argument("--model", default="ResNet9", choices=model_names)
+    parser.add_argument("--finetune", action="store_true", dest="do_finetune")
+    parser.add_argument("--checkpoint", action="store_true",
+                        dest="do_checkpoint")
+    parser.add_argument("--checkpoint_path", type=str, default="./checkpoint")
+    parser.add_argument("--finetune_path", type=str, default="./finetune")
+    parser.add_argument("--finetuned_from", type=str,
+                        choices=list(FED_DATASETS.keys()))
+    parser.add_argument("--num_results_train", type=int, default=2)
+    parser.add_argument("--num_results_val", type=int, default=2)
+    parser.add_argument("--dataset_name", type=str, default="",
+                        choices=list(FED_DATASETS.keys()))
+    parser.add_argument("--dataset_dir", type=str, default="./dataset")
+    parser.add_argument("--batchnorm", action="store_true",
+                        dest="do_batchnorm")
+    parser.add_argument("--nan_threshold", type=float, default=999)
+
+    # compression args
+    parser.add_argument("--k", type=int, default=50000)
+    parser.add_argument("--num_cols", type=int, default=500000)
+    parser.add_argument("--num_rows", type=int, default=5)
+    parser.add_argument("--num_blocks", type=int, default=20)
+    parser.add_argument("--topk_down", action="store_true",
+                        dest="do_topk_down")
+
+    # optimization args
+    parser.add_argument("--local_momentum", type=float, default=0.9)
+    parser.add_argument("--virtual_momentum", type=float, default=0)
+    parser.add_argument("--weight_decay", type=float, default=5e-4)
+    parser.add_argument("--num_epochs", type=float, default=24)
+    parser.add_argument("--num_fedavg_epochs", type=int, default=1)
+    parser.add_argument("--fedavg_batch_size", type=int, default=-1)
+    parser.add_argument("--fedavg_lr_decay", type=float, default=1)
+    parser.add_argument("--error_type", choices=ERROR_TYPES, default="none")
+    parser.add_argument("--lr_scale", type=float, default=default_lr)
+    parser.add_argument("--pivot_epoch", type=float, default=5)
+
+    # parallelization args
+    parser.add_argument("--port", type=int, default=5315)
+    parser.add_argument("--num_clients", type=int)
+    parser.add_argument("--num_workers", type=int, default=1)
+    parser.add_argument("--device", type=str,
+                        choices=["cpu", "tpu", "cuda"], default="tpu")
+    parser.add_argument("--num_devices", type=int, default=1)
+    parser.add_argument("--share_ps_gpu", action="store_true")
+    parser.add_argument("--iid", action="store_true", dest="do_iid")
+    parser.add_argument("--train_dataloader_workers", type=int, default=0)
+    parser.add_argument("--val_dataloader_workers", type=int, default=0)
+
+    # GPT2 args
+    parser.add_argument("--model_checkpoint", type=str, default="gpt2")
+    parser.add_argument("--num_candidates", type=int, default=2)
+    parser.add_argument("--max_history", type=int, default=2)
+    parser.add_argument("--local_batch_size", type=int, default=8)
+    parser.add_argument("--valid_batch_size", type=int, default=8)
+    parser.add_argument("--microbatch_size", type=int, default=-1)
+    parser.add_argument("--lm_coef", type=float, default=1.0)
+    parser.add_argument("--mc_coef", type=float, default=1.0)
+    parser.add_argument("--max_grad_norm", type=float)
+    parser.add_argument("--personality_permutations", type=int, default=1)
+    parser.add_argument("--eval_before_start", action="store_true")
+
+    # differential privacy args
+    parser.add_argument("--dp", action="store_true", dest="do_dp")
+    parser.add_argument("--dp_mode", choices=DP_MODES, default="worker")
+    parser.add_argument("--l2_norm_clip", type=float, default=1.0)
+    parser.add_argument("--noise_multiplier", type=float, default=0.0)
+
+    # TPU-native additions
+    parser.add_argument("--param_dtype", type=str, default="float32")
+    parser.add_argument("--compute_dtype", type=str, default="float32")
+
+    return parser
+
+
+def parse_args(default_lr: Optional[float] = None, argv=None) -> Config:
+    parser = build_parser(default_lr)
+    ns = parser.parse_args(argv)
+    field_names = {f.name for f in dataclasses.fields(Config)}
+    kw = {k: v for k, v in vars(ns).items() if k in field_names}
+    return Config(**kw)

@@ -1,0 +1,7 @@
+from commefficient_tpu.ops.topk import topk as topk  # noqa: F401
+from commefficient_tpu.ops.vec import (  # noqa: F401
+    clip_by_l2,
+    flatten_params,
+    global_norm,
+)
+from commefficient_tpu.ops.sketch import CountSketch  # noqa: F401

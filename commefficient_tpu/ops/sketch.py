@@ -1,0 +1,198 @@
+"""Count-sketch (CSVec) — the FetchSGD compression operator, TPU-native.
+
+In-tree replacement for the reference's external CUDA `csvec` library
+(used at fed_aggregator.py:5,466-469,586-597 and fed_worker.py:315-322;
+API surface documented in SURVEY.md §2.9). Semantics:
+
+- An ``(r, c)`` table of buckets. Coordinate ``i`` of a d-dim vector is
+  hashed by each of the r rows to a column ``h_r(i)`` and a sign
+  ``s_r(i) ∈ {±1}``; sketching scatter-adds ``s_r(i)·v[i]`` into
+  ``table[r, h_r(i)]``.
+- Recovery estimates ``v[i] ≈ median_r(s_r(i)·table[r, h_r(i)])``;
+  ``unsketch(k)`` returns a dense vector keeping only the k
+  largest-magnitude estimates (heavy hitters).
+- ``l2estimate() = sqrt(median_r ‖table[r]‖²)``.
+
+Design notes (TPU-first, not a CUDA translation):
+
+- Hashes/signs are **counter-based**: a murmur3-style integer mixer of
+  (coordinate index XOR per-row seed), computed in-register. No stored
+  hash tables, so the operator has zero state to ship across devices
+  and is bit-deterministic on every replica — which makes
+  ``psum(table)`` over the mesh exactly equal to the sketch of the
+  summed vector (sketching is linear in v for *fixed* hashes).
+- Both sketching and recovery stream over fixed-size coordinate blocks
+  with ``lax.scan`` so peak memory is O(block + r·c), never O(r·d).
+  ``num_blocks`` (same flag as the reference's CUDA memory knob) sets
+  the block count.
+- All shapes are static; everything here is jit/vmap/pjit-compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+
+
+def _mix(x: jax.Array) -> jax.Array:
+    """murmur3 fmix32 finalizer — a cheap, well-dispersed bijection on
+    uint32, vectorisable on the VPU."""
+    x = x ^ (x >> 16)
+    x = x * _M1
+    x = x ^ (x >> 13)
+    x = x * _M2
+    x = x ^ (x >> 16)
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class CountSketch:
+    """Static description of a sketch operator (d, c, r, seed).
+
+    Mirrors ``CSVec(d, c, r, numBlocks)`` (reference
+    fed_aggregator.py:466-469) minus the device argument — placement is
+    the mesh's job. Instances are hashable and static under jit.
+    """
+
+    d: int
+    c: int
+    r: int
+    num_blocks: int = 20
+    seed: int = 42
+
+    def __post_init__(self):
+        assert self.d > 0 and self.c > 0 and self.r > 0
+
+    # --- hashing ---------------------------------------------------------
+
+    @property
+    def _block(self) -> int:
+        return -(-self.d // max(self.num_blocks, 1))  # ceil
+
+    @property
+    def _padded_d(self) -> int:
+        return self._block * max(self.num_blocks, 1)
+
+    def _row_seeds(self):
+        """Two distinct uint32 seeds per row (bucket and sign)."""
+        rows = np.arange(self.r, dtype=np.uint64)
+        base = self.seed & 0xFFFFFFFF
+        mask = np.uint64(0xFFFFFFFF)
+        bucket_seed = ((base * 0x9E3779B9 + rows * 0x7FEB352D + 1) & mask)
+        sign_seed = ((base * 0x6C62272E + rows * 0x846CA68B + 2) & mask)
+        return (jnp.asarray(bucket_seed.astype(np.uint32)),
+                jnp.asarray(sign_seed.astype(np.uint32)))
+
+    def hashes(self, idx: jax.Array):
+        """(buckets, signs) for int32 coordinate indices ``idx``:
+        buckets uint32 (r, n) in [0, c); signs float32 (r, n) in {±1}."""
+        bucket_seed, sign_seed = self._row_seeds()
+        x = idx.astype(jnp.uint32)[None, :]
+        b = _mix(x ^ bucket_seed[:, None]) % jnp.uint32(self.c)
+        s = 1.0 - 2.0 * ((_mix(x ^ sign_seed[:, None]) >> 16) & 1).astype(
+            jnp.float32)
+        return b, s
+
+    # --- sketching (accumulateVec) --------------------------------------
+
+    def sketch(self, v: jax.Array) -> jax.Array:
+        """Dense (d,) vector -> (r, c) sketch table.
+
+        Blocked scatter-add: scan over coordinate blocks; within a
+        block, each row's signed values are summed into a flattened
+        (r·c,) table with one scatter-add.
+        """
+        assert v.shape == (self.d,), v.shape
+        block, nblocks = self._block, max(self.num_blocks, 1)
+        v = jnp.pad(v.astype(jnp.float32), (0, self._padded_d - self.d))
+        vb = v.reshape(nblocks, block)
+        offs = jnp.arange(nblocks, dtype=jnp.int32) * block
+        row_base = jnp.arange(self.r, dtype=jnp.uint32)[:, None] * jnp.uint32(self.c)
+
+        def body(table, inp):
+            off, vals = inp
+            idx = off + jnp.arange(block, dtype=jnp.int32)
+            buckets, signs = self.hashes(idx)
+            flat_idx = (row_base + buckets).reshape(-1)
+            contrib = (signs * vals[None, :]).reshape(-1)
+            table = table.at[flat_idx].add(contrib, mode="promise_in_bounds")
+            return table, None
+
+        table, _ = jax.lax.scan(
+            body, jnp.zeros(self.r * self.c, jnp.float32), (offs, vb))
+        return table.reshape(self.r, self.c)
+
+    # --- recovery --------------------------------------------------------
+
+    def _estimate_block(self, table: jax.Array, idx: jax.Array) -> jax.Array:
+        """Median-of-rows estimates for coordinate indices ``idx``."""
+        buckets, signs = self.hashes(idx)
+        ests = signs * table[jnp.arange(self.r)[:, None],
+                             buckets.astype(jnp.int32)]
+        return jnp.median(ests, axis=0)
+
+    def estimates(self, table: jax.Array) -> jax.Array:
+        """All-coordinate estimates (d,). O(r·d) memory — use only for
+        small d (tests); ``unsketch`` streams instead."""
+        return self._estimate_block(
+            table, jnp.arange(self.d, dtype=jnp.int32))
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def unsketch(self, table: jax.Array, k: int) -> jax.Array:
+        """(r, c) table -> dense (d,) vector containing only the k
+        largest-magnitude estimated coordinates (reference
+        ``CSVec.unSketch(k)``; server use at fed_aggregator.py:592).
+
+        Streams blocks, carrying a running top-k: per block, merge the
+        block's estimates with the carry and re-select top-k, so peak
+        memory is O(k + block) instead of O(d).
+        """
+        assert table.shape == (self.r, self.c), table.shape
+        k = min(k, self.d)
+        block, nblocks = self._block, max(self.num_blocks, 1)
+        offs = jnp.arange(nblocks, dtype=jnp.int32) * block
+
+        def body(carry, off):
+            top_vals, top_idx = carry
+            idx = off + jnp.arange(block, dtype=jnp.int32)
+            est = self._estimate_block(table, idx)
+            # padded coords (>= d) must never win
+            est = jnp.where(idx < self.d, est, 0.0)
+            cand_vals = jnp.concatenate([top_vals, est])
+            cand_idx = jnp.concatenate([top_idx, idx])
+            _, sel = jax.lax.top_k(jax.lax.square(cand_vals), k)
+            return (cand_vals[sel], cand_idx[sel]), None
+
+        init = (jnp.zeros(k, jnp.float32),
+                jnp.full(k, self.d, dtype=jnp.int32))  # sentinel idx
+        (top_vals, top_idx), _ = jax.lax.scan(body, init, offs)
+
+        out = jnp.zeros(self.d + 1, jnp.float32)  # slot d absorbs sentinels
+        out = out.at[top_idx].set(top_vals, mode="promise_in_bounds")
+        return out[: self.d]
+
+    # --- norms -----------------------------------------------------------
+
+    @staticmethod
+    def l2estimate(table: jax.Array) -> jax.Array:
+        """sqrt(median over rows of per-row sum of squares) — the sketch
+        estimate of ‖v‖₂ (reference utils.py:309 via CSVec.l2estimate)."""
+        return jnp.sqrt(jnp.median(jnp.sum(jax.lax.square(table), axis=1)))
+
+
+def clip_record(record: jax.Array, clip: float, *, is_sketch: bool) -> jax.Array:
+    """Reference ``clip_grad`` (utils.py:305-313): L2-clip a dense
+    vector, or a sketch table by its l2estimate. Only ever shrinks."""
+    if not is_sketch:
+        from commefficient_tpu.ops.vec import clip_by_l2
+        return clip_by_l2(record, clip)
+    norm = CountSketch.l2estimate(record)
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    return record * scale

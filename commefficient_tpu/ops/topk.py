@@ -1,0 +1,39 @@
+"""Magnitude top-k sparsification.
+
+TPU-native counterpart of reference utils.py:232-252 (`_topk`): keep
+the k largest-magnitude entries of a vector (or of each row of a
+matrix), zeroing the rest. Uses `jax.lax.top_k`, which XLA lowers to a
+fused partial sort — no NaN workarounds needed (the reference's
+zero-initialised output dance at utils.py:239-244 is a CUDA quirk).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def topk(vec: jax.Array, k: int) -> jax.Array:
+    """Return a copy of ``vec`` with everything but the ``k``
+    largest-magnitude entries zeroed.
+
+    1-D: global top-k. 2-D: row-wise top-k along the last axis
+    (matching torch.topk's dim=-1 default used by the reference).
+    """
+    if vec.ndim == 1:
+        _, idx = jax.lax.top_k(jax.lax.square(vec), k)
+        return jnp.zeros_like(vec).at[idx].set(vec[idx], mode="promise_in_bounds")
+    elif vec.ndim == 2:
+        _, idx = jax.lax.top_k(jax.lax.square(vec), k)
+        rows = jnp.arange(vec.shape[0])[:, None]
+        return jnp.zeros_like(vec).at[rows, idx].set(
+            vec[rows, idx], mode="promise_in_bounds")
+    raise ValueError(f"topk supports 1-D/2-D inputs, got ndim={vec.ndim}")
+
+
+def topk_values_indices(vec: jax.Array, k: int):
+    """(values, indices) of the k largest-magnitude entries of a 1-D
+    vector — the sparse representation actually shipped over the wire
+    when measuring upload bytes (k floats, fed_aggregator.py:296-297)."""
+    _, idx = jax.lax.top_k(jax.lax.square(vec), k)
+    return vec[idx], idx

@@ -1,0 +1,49 @@
+"""Flat parameter-vector utilities.
+
+The framework keeps the reference's core invariant — the whole model is
+one flat f32 vector (reference fed_aggregator.py:81-97,
+utils.py:254-297) — via `jax.flatten_util.ravel_pytree`: flatten once
+at init, unravel (a cheap reshape/slice fusion under jit) inside every
+forward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def flatten_params(params: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
+    """pytree -> (flat f32 vector, unravel_fn).
+
+    Counterpart of reference get_param_vec/set_param_vec
+    (utils.py:281-297); unlike the reference there is no mutable module
+    to scatter back into — ``unravel_fn`` reconstitutes the pytree
+    functionally inside the jitted step.
+    """
+    flat, unravel = ravel_pytree(params)
+    return flat.astype(jnp.float32), unravel
+
+
+def global_norm(vec: jax.Array) -> jax.Array:
+    return jnp.sqrt(jnp.sum(jax.lax.square(vec)))
+
+
+def clip_by_l2(vec: jax.Array, clip: float) -> jax.Array:
+    """L2-clip to norm ``clip`` — only shrinks, never grows
+    (reference utils.py:305-313 ``clip_grad`` dense branch)."""
+    norm = global_norm(vec)
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    return vec * scale
+
+
+def clip_by_global_norm_tree(tree: Any, max_norm: float) -> Any:
+    """torch.nn.utils.clip_grad_norm_ analogue for pytrees
+    (used pre-weight-decay, reference fed_worker.py:292-294)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    norm = jnp.sqrt(sum(jnp.sum(jax.lax.square(l)) for l in leaves))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(lambda l: l * scale, tree)

@@ -1,0 +1,111 @@
+"""Cross-cutting utilities: LR schedules, loggers, timers.
+
+Functional parity with reference utils.py:14-99 (Logger, PiecewiseLinear,
+Exp, TableLogger, TSVLogger, Timer, make_logdir).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import namedtuple
+from datetime import datetime
+
+import numpy as np
+
+
+class Logger:
+    """print-based logger (reference utils.py:14-24)."""
+
+    def debug(self, msg, args=None):
+        print(msg.format(args))
+
+    info = warn = error = critical = debug
+
+
+class PiecewiseLinear(namedtuple("PiecewiseLinear", ("knots", "vals"))):
+    """Piecewise-linear schedule; e.g. the triangular CIFAR LR schedule
+    PiecewiseLinear([0, pivot_epoch, num_epochs], [0, lr_scale, 0])
+    (reference utils.py:26-28, cv_train.py:394-397)."""
+
+    def __call__(self, t):
+        return float(np.interp([t], self.knots, self.vals)[0])
+
+
+class Exp(namedtuple("Exp", ("warmup_epochs", "amplitude", "decay_len"))):
+    """Linear warmup then exponential decay (reference utils.py:30-35)."""
+
+    def __call__(self, t):
+        if t < self.warmup_epochs:
+            return float(np.interp([t], [0, self.warmup_epochs],
+                                   [0, self.amplitude])[0])
+        return float(self.amplitude
+                     * 10 ** (-(t - self.warmup_epochs) / self.decay_len))
+
+
+def make_logdir(args) -> str:
+    """runs/<time>_<workers>/<clients>_<mode>... (reference utils.py:51-64)."""
+    rows, cols, k, mode = args.num_rows, args.num_cols, args.k, args.mode
+    sketch_str = f"{mode}: {rows} x {cols}" if mode == "sketch" else f"{mode}"
+    k_str = f"k: {k}" if mode in ["sketch", "true_topk", "local_topk"] else ""
+    clients_str = f"{args.num_workers}/{args.num_clients}"
+    current_time = datetime.now().strftime("%b%d_%H-%M-%S")
+    return os.path.join(
+        "runs", current_time + "_" + clients_str + "_" + sketch_str + "_" + k_str)
+
+
+class TableLogger:
+    """Fixed-width stdout table (reference utils.py:66-74)."""
+
+    def append(self, output):
+        if not hasattr(self, "keys"):
+            self.keys = output.keys()
+            print(*("{:>12s}".format(k) for k in self.keys))
+        filtered = [output[k] for k in self.keys]
+        print(*("{:12.4f}".format(v)
+                if isinstance(v, (float, np.floating)) else "{:12}".format(v)
+                for v in filtered))
+
+
+class TSVLogger:
+    """epoch,hours,top1Accuracy TSV accumulator (reference utils.py:76-85)."""
+
+    def __init__(self):
+        self.log = ["epoch,hours,top1Accuracy"]
+
+    def append(self, output):
+        epoch = output["epoch"]
+        hours = output["total_time"] / 3600
+        acc = output["test_acc"] * 100
+        self.log.append("{},{:.8f},{:.2f}".format(epoch, hours, acc))
+
+    def __str__(self):
+        return "\n".join(self.log)
+
+
+union = lambda *dicts: {k: v for d in dicts for (k, v) in d.items()}  # noqa: E731
+
+
+class Timer:
+    """Wall-clock phase timer (reference utils.py:89-99)."""
+
+    def __init__(self):
+        self.times = [time.time()]
+        self.total_time = 0.0
+
+    def __call__(self, include_in_total=True):
+        self.times.append(time.time())
+        delta_t = self.times[-1] - self.times[-2]
+        if include_in_total:
+            self.total_time += delta_t
+        return delta_t
+
+
+def steps_per_epoch(local_batch_size: int, dataset, num_workers: int) -> int:
+    """Rounds per epoch (reference utils.py:315-321): when the local
+    batch is the client's whole dataset, an epoch is num_clients /
+    num_workers rounds; otherwise ceil(len(ds) / (lbs * num_workers))."""
+    if local_batch_size == -1:
+        return int(dataset.num_clients // num_workers)
+    batch_size = local_batch_size * num_workers
+    return int(np.ceil(len(dataset) / batch_size))

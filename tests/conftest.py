@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The moral equivalent of the reference's "distributed degrades to
+localhost" strategy (SURVEY.md §4): multi-chip sharding is validated on
+N virtual CPU devices via --xla_force_host_platform_device_count, no
+real pod required. Must run before JAX initialises its backends.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# the container's sitecustomize pre-registers a TPU plugin; this
+# overrides it even though the env var was set too late for it.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, devs
+    return devs

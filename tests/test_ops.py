@@ -1,0 +1,151 @@
+"""Unit tests for compression ops: top-k, clipping, count-sketch.
+
+Covers what the reference never tested (SURVEY.md §4): sketch
+linearity, unbiased recovery, heavy-hitter top-k accuracy, l2estimate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.ops import CountSketch, clip_by_l2, topk
+from commefficient_tpu.ops.sketch import clip_record
+from commefficient_tpu.ops.topk import topk_values_indices
+
+
+class TestTopk:
+    def test_1d_keeps_largest_magnitude(self):
+        v = jnp.array([1.0, -5.0, 3.0, 0.5, -2.0])
+        out = topk(v, 2)
+        np.testing.assert_allclose(out, [0.0, -5.0, 3.0, 0.0, 0.0])
+
+    def test_1d_preserves_values_exactly(self):
+        rng = np.random.RandomState(0)
+        v = jnp.asarray(rng.randn(1000).astype(np.float32))
+        out = np.asarray(topk(v, 100))
+        nz = np.nonzero(out)[0]
+        assert len(nz) == 100
+        np.testing.assert_array_equal(out[nz], np.asarray(v)[nz])
+        # the kept set is exactly the 100 largest |v|
+        thresh = np.sort(np.abs(np.asarray(v)))[-100]
+        assert np.all(np.abs(out[nz]) >= thresh)
+
+    def test_2d_rowwise(self):
+        v = jnp.array([[1.0, -5.0, 3.0], [0.1, 0.2, -0.3]])
+        out = topk(v, 1)
+        np.testing.assert_allclose(out, [[0, -5, 0], [0, 0, -0.3]])
+
+    def test_values_indices(self):
+        v = jnp.array([1.0, -5.0, 3.0])
+        vals, idx = topk_values_indices(v, 2)
+        assert set(np.asarray(idx).tolist()) == {1, 2}
+
+    def test_jit_compatible(self):
+        f = jax.jit(lambda v: topk(v, 3))
+        v = jnp.arange(10.0)
+        np.testing.assert_allclose(f(v), topk(v, 3))
+
+
+class TestClip:
+    def test_noop_below_clip(self):
+        v = jnp.array([0.3, 0.4])  # norm 0.5
+        np.testing.assert_allclose(clip_by_l2(v, 1.0), v)
+
+    def test_clips_above(self):
+        v = jnp.array([3.0, 4.0])  # norm 5
+        out = clip_by_l2(v, 1.0)
+        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-6)
+
+
+class TestCountSketch:
+    @pytest.fixture
+    def cs(self):
+        return CountSketch(d=2048, c=512, r=5, num_blocks=4)
+
+    def test_linearity(self, cs):
+        """sketch(a) + sketch(b) == sketch(a + b): required for
+        psum-of-sketches to equal the sketch of the summed gradient."""
+        rng = np.random.RandomState(1)
+        a = jnp.asarray(rng.randn(cs.d).astype(np.float32))
+        b = jnp.asarray(rng.randn(cs.d).astype(np.float32))
+        np.testing.assert_allclose(
+            cs.sketch(a) + cs.sketch(b), cs.sketch(a + b),
+            rtol=1e-4, atol=1e-4)
+
+    def test_determinism_across_calls(self, cs):
+        v = jnp.asarray(np.random.RandomState(2).randn(cs.d).astype(np.float32))
+        np.testing.assert_array_equal(cs.sketch(v), cs.sketch(v))
+
+    def test_scaling(self, cs):
+        v = jnp.asarray(np.random.RandomState(3).randn(cs.d).astype(np.float32))
+        np.testing.assert_allclose(cs.sketch(2.5 * v), 2.5 * cs.sketch(v),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_heavy_hitter_recovery(self):
+        """A sparse signal much larger than the noise floor must be
+        recovered at the right coordinates with ~right values."""
+        cs = CountSketch(d=10000, c=2000, r=5, num_blocks=5)
+        rng = np.random.RandomState(4)
+        v = np.zeros(cs.d, np.float32)
+        hh_idx = rng.choice(cs.d, 20, replace=False)
+        hh_val = rng.randn(20).astype(np.float32) * 100
+        v[hh_idx] = hh_val
+        v += rng.randn(cs.d).astype(np.float32) * 0.01
+        rec = np.asarray(cs.unsketch(cs.sketch(jnp.asarray(v)), k=20))
+        assert set(np.nonzero(rec)[0]) == set(hh_idx.tolist())
+        np.testing.assert_allclose(rec[hh_idx], hh_val, rtol=0.05, atol=1.0)
+
+    def test_unsketch_exact_when_wide(self):
+        """With c >> d and no collisions likely, recovery is exact."""
+        cs = CountSketch(d=50, c=4096, r=5, num_blocks=1)
+        v = jnp.asarray(np.random.RandomState(5).randn(50).astype(np.float32))
+        rec = cs.unsketch(cs.sketch(v), k=50)
+        np.testing.assert_allclose(rec, v, rtol=1e-4, atol=1e-4)
+
+    def test_unsketch_k_sparsity(self, cs):
+        v = jnp.asarray(np.random.RandomState(6).randn(cs.d).astype(np.float32))
+        rec = np.asarray(cs.unsketch(cs.sketch(v), k=64))
+        assert np.count_nonzero(rec) <= 64
+
+    def test_estimates_unbiased(self):
+        """Mean estimate error across many random seeds ~ 0."""
+        rng = np.random.RandomState(7)
+        v = np.zeros(500, np.float32)
+        v[7] = 10.0
+        errs = []
+        for seed in range(20):
+            cs = CountSketch(d=500, c=50, r=3, num_blocks=1, seed=seed)
+            est = np.asarray(cs.estimates(cs.sketch(jnp.asarray(v))))
+            errs.append(est[7] - 10.0)
+        assert abs(np.mean(errs)) < 1.5
+
+    def test_l2estimate(self):
+        cs = CountSketch(d=5000, c=2500, r=5, num_blocks=2)
+        v = jnp.asarray(np.random.RandomState(8).randn(cs.d).astype(np.float32))
+        true = float(jnp.linalg.norm(v))
+        est = float(cs.l2estimate(cs.sketch(v)))
+        assert abs(est - true) / true < 0.15
+
+    def test_clip_record_sketch(self):
+        cs = CountSketch(d=5000, c=2500, r=5, num_blocks=2)
+        v = jnp.asarray(np.random.RandomState(9).randn(cs.d).astype(np.float32))
+        table = cs.sketch(v)
+        clipped = clip_record(table, 1.0, is_sketch=True)
+        assert float(cs.l2estimate(clipped)) <= 1.01
+
+    def test_table_shape_and_jit(self, cs):
+        v = jnp.zeros(cs.d)
+        f = jax.jit(cs.sketch)
+        assert f(v).shape == (cs.r, cs.c)
+
+    def test_hash_quality_uniform(self, cs):
+        """Buckets should be near-uniform: chi-square sanity bound."""
+        idx = jnp.arange(cs.d, dtype=jnp.int32)
+        buckets, signs = cs.hashes(idx)
+        counts = np.bincount(np.asarray(buckets[0]), minlength=cs.c)
+        expected = cs.d / cs.c
+        chi2 = np.sum((counts - expected) ** 2 / expected)
+        # dof = c-1; mean c, sd sqrt(2c): allow 5 sd
+        assert chi2 < cs.c + 5 * np.sqrt(2 * cs.c)
+        assert abs(float(jnp.mean(signs))) < 0.05
