@@ -160,10 +160,6 @@ class Config:
             assert self.local_momentum == 0, \
                 "sketch mode cannot use local momentum " \
                 "(momentum factor masking is impossible in sketch space)"
-            if self.error_type == "local":
-                assert self.virtual_momentum == 0
-            elif self.error_type == "virtual":
-                assert self.local_momentum == 0
         if self.mode == "true_topk":
             # virtual error is required server-side (fed_aggregator.py:514)
             assert self.error_type == "virtual", \
@@ -225,7 +221,8 @@ def build_parser(default_lr: Optional[float] = None,
     if model_names is None:
         from commefficient_tpu import models
         model_names = models.model_names()
-    parser.add_argument("--model", default="ResNet9", choices=model_names)
+    parser.add_argument("--model", default="ResNet9",
+                        choices=model_names or None)
     parser.add_argument("--finetune", action="store_true", dest="do_finetune")
     parser.add_argument("--checkpoint", action="store_true",
                         dest="do_checkpoint")

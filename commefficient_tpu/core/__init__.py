@@ -1,0 +1,5 @@
+from commefficient_tpu.core.client import (  # noqa: F401
+    accumulate_and_compress,
+    ClientUpdate,
+)
+from commefficient_tpu.core.server import server_update, ServerState  # noqa: F401

@@ -1,0 +1,276 @@
+"""The federated round as a single SPMD program.
+
+Where the reference runs a round as: queue batches to worker processes
+→ each worker loops over its clients serially → NCCL-reduce the summed
+transmit → server step on the PS rank (call stack in SURVEY.md §3.1),
+here a round is two jitted functions over a ``clients`` mesh:
+
+- ``client_round``: vmap of the per-client local step over the W
+  participating clients (sharded across devices), returning the summed
+  transmit (one XLA all-reduce), per-client metrics, and updated
+  per-client momentum/error rows;
+- ``server_round``: the deterministic server update, replicated.
+
+They are split (rather than fused) to mirror the reference's
+FedModel.__call__ / FedOptimizer.step protocol — the LR scheduler sits
+between them on the host (cv_train.py:198) — but both stay on device;
+only scalar metrics ever cross to the host.
+
+Batch layout: a dict of (W, B, ...) arrays with a (W, B) float "mask"
+marking real samples — ragged client batches become static shapes via
+padding (SURVEY.md §7). ``client_ids`` is (W,) int32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.core.client import (accumulate_and_compress,
+                                           stale_weight_download)
+from commefficient_tpu.core.grad import make_eval_metrics, make_forward_grad
+from commefficient_tpu.core.server import (ServerState, ServerUpdate,
+                                           server_update)
+from commefficient_tpu.ops.sketch import CountSketch
+
+
+class ClientStates(NamedTuple):
+    """Per-client persistent state, rows sharded over the mesh
+    (reference: host shared-memory tensors, fed_aggregator.py:105-129).
+    Fields a mode doesn't use are None — never allocated."""
+    velocities: Optional[jax.Array]  # (num_clients, *transmit_shape)
+    errors: Optional[jax.Array]      # (num_clients, *transmit_shape)
+    weights: Optional[jax.Array]     # (num_clients, grad_size), topk_down only
+
+    @staticmethod
+    def init(cfg: Config, num_clients: int,
+             ps_weights: Optional[jax.Array] = None) -> "ClientStates":
+        shape = (num_clients,) + cfg.transmit_shape
+        vel = jnp.zeros(shape, jnp.float32) if cfg.local_momentum > 0 else None
+        err = (jnp.zeros(shape, jnp.float32)
+               if cfg.error_type == "local" else None)
+        wts = None
+        if cfg.do_topk_down:
+            assert ps_weights is not None
+            wts = jnp.broadcast_to(ps_weights,
+                                   (num_clients, cfg.grad_size)).copy()
+        return ClientStates(vel, err, wts)
+
+
+class RoundResult(NamedTuple):
+    aggregated: jax.Array        # transmit-sum / total datapoints
+    metrics: tuple               # per-client batch-mean metrics, each (W,)
+    client_states: ClientStates
+
+
+def args2sketch(cfg: Config) -> Optional[CountSketch]:
+    """(reference fed_aggregator.py:466-469)"""
+    if cfg.mode != "sketch":
+        return None
+    return CountSketch(d=cfg.grad_size, c=cfg.num_cols, r=cfg.num_rows,
+                       num_blocks=cfg.num_blocks, seed=cfg.seed)
+
+
+def build_client_round(cfg: Config, loss_fn: Callable,
+                       padded_batch_size: int) -> Callable:
+    """Returns jit-able
+    ``client_round(ps_weights, client_states, batch, client_ids, rng,
+    fedavg_lr) -> RoundResult``.
+    """
+    cfg.validate_runtime()
+    sketch = args2sketch(cfg)
+    if cfg.mode == "fedavg":
+        per_client = _build_fedavg_client_step(cfg, loss_fn,
+                                               padded_batch_size)
+    else:
+        per_client = _build_sgd_client_step(cfg, loss_fn, sketch,
+                                            padded_batch_size)
+
+    def client_round(ps_weights, client_states: ClientStates, batch,
+                     client_ids, rng, fedavg_lr=1.0) -> RoundResult:
+        W = client_ids.shape[0]
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(client_ids)
+
+        vel_rows = (client_states.velocities[client_ids]
+                    if client_states.velocities is not None else None)
+        err_rows = (client_states.errors[client_ids]
+                    if client_states.errors is not None else None)
+        wt_rows = (client_states.weights[client_ids]
+                   if client_states.weights is not None else None)
+
+        transmit, metrics, new_vel, new_err, new_wts = jax.vmap(
+            per_client, in_axes=(None, 0, 0, 0, 0, 0, None)
+        )(ps_weights, _some(vel_rows, W), _some(err_rows, W),
+          _some(wt_rows, W), batch, rngs, fedavg_lr)
+
+        # one ICI all-reduce: Σ_clients transmit, ÷ total datapoints
+        # (reference fed_worker.py:131-140 + fed_aggregator.py:328-334)
+        total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        aggregated = jnp.sum(transmit, axis=0) / total
+
+        states = ClientStates(
+            _scatter(client_states.velocities, client_ids, new_vel),
+            _scatter(client_states.errors, client_ids, new_err),
+            _scatter(client_states.weights, client_ids, new_wts),
+        )
+        return RoundResult(aggregated, metrics, states)
+
+    return client_round
+
+
+def _some(rows, W):
+    """vmap can't map over None: use a zero-size placeholder."""
+    return rows if rows is not None else jnp.zeros((W, 0))
+
+
+def _scatter(arr, ids, rows):
+    if arr is None or rows is None or rows.shape[-1] == 0:
+        return arr
+    return arr.at[ids].set(rows)
+
+
+def _build_sgd_client_step(cfg, loss_fn, sketch, padded_batch_size):
+    """One client's round for all non-fedavg modes
+    (reference process_batch + local_step, fed_worker.py:142-232)."""
+    forward_grad = make_forward_grad(cfg, loss_fn, sketch,
+                                     padded_batch_size)
+
+    def step(ps_weights, velocity, error, client_weights, batch, rng,
+             fedavg_lr):
+        del fedavg_lr
+        if cfg.do_topk_down:
+            weights = stale_weight_download(cfg, ps_weights, client_weights)
+            new_wts = weights
+        else:
+            weights = ps_weights
+            new_wts = client_weights
+
+        g_unit, metrics = forward_grad(weights, batch, noise_rng=rng)
+        batch_size = jnp.sum(batch["mask"])
+        upd = accumulate_and_compress(
+            cfg, g_unit,
+            velocity if cfg.local_momentum > 0 else None,
+            error if cfg.error_type == "local" else None,
+            batch_size)
+        new_vel = upd.velocity if upd.velocity is not None else velocity
+        new_err = upd.error if upd.error is not None else error
+        return upd.transmit, metrics, new_vel, new_err, new_wts
+
+    return step
+
+
+def _build_fedavg_client_step(cfg, loss_fn, padded_batch_size):
+    """One client's FedAvg round: local SGD over its whole (padded)
+    dataset, transmit the weighted weight delta
+    (reference fed_worker.py:62-114)."""
+    if cfg.fedavg_batch_size == -1:
+        sub = padded_batch_size
+    else:
+        sub = min(cfg.fedavg_batch_size, padded_batch_size)
+    n_batches = -(-padded_batch_size // sub)  # ceil
+    pad_to = n_batches * sub
+    forward_grad = make_forward_grad(cfg, loss_fn, None, sub)
+
+    def step(ps_weights, velocity, error, client_weights, batch, rng,
+             fedavg_lr):
+        def pad(x):
+            w = [(0, pad_to - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, w)
+
+        chunked = {k: pad(v).reshape((n_batches, sub) + v.shape[1:])
+                   for k, v in batch.items()}
+        client_size = jnp.sum(batch["mask"])
+
+        def local_sgd(carry, inp):
+            w, step_i = carry
+            microbatch, r = inp
+            n = jnp.sum(microbatch["mask"])
+            g_unit, metrics = forward_grad(w, microbatch, noise_rng=r)
+            # skip all-padding chunks entirely: no weight change, no
+            # step increment (the reference never creates such chunks)
+            valid = n > 0
+            decay = cfg.fedavg_lr_decay ** step_i
+            w_new = w - g_unit * fedavg_lr * decay
+            w = jnp.where(valid, w_new, w)
+            step_i = step_i + valid.astype(jnp.int32)
+            w_metrics = tuple(jnp.where(valid, m, 0.0) for m in metrics)
+            return (w, step_i), w_metrics
+
+        steps_per_epoch = n_batches
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+            jnp.arange(cfg.num_fedavg_epochs * steps_per_epoch))
+
+        w = ps_weights
+        step_i = jnp.zeros((), jnp.int32)
+        all_metrics = []
+        for ep in range(cfg.num_fedavg_epochs):
+            ep_rngs = rngs[ep * steps_per_epoch:(ep + 1) * steps_per_epoch]
+            (w, step_i), ms = jax.lax.scan(
+                local_sgd, (w, step_i), (chunked, ep_rngs))
+            all_metrics.append(ms)
+
+        # metrics: mean over the local steps actually taken
+        # (reference fed_worker.py:103-104)
+        n_steps = jnp.maximum(step_i.astype(jnp.float32), 1.0)
+        metrics = tuple(
+            sum(jnp.sum(ms[i]) for ms in all_metrics) / n_steps
+            for i in range(len(all_metrics[0])))
+
+        # transmit = (w_orig - w_final) * |client data|
+        # (fed_worker.py:105-109)
+        transmit = (ps_weights - w) * client_size
+        return transmit, metrics, velocity, error, client_weights
+
+    return step
+
+
+def build_val_fn(cfg: Config, loss_fn: Callable) -> Callable:
+    """Validation shard evaluator: metrics only, batch-mean over the
+    shard (reference _call_val + forward_grad(compute_grad=False),
+    fed_aggregator.py:339-366)."""
+    eval_metrics = make_eval_metrics(loss_fn)
+
+    def val_shards(ps_weights, batch):
+        # batch: (S, B, ...) shards with (S, B) mask
+        return jax.vmap(lambda b: jnp.stack(
+            eval_metrics(ps_weights, b)))(batch)
+
+    return val_shards
+
+
+def build_server_round(cfg: Config) -> Callable:
+    """Returns jit-able ``server_round(ps_weights, server_state,
+    aggregated, lr, client_velocities, client_ids, noise_rng) ->
+    (new_ps_weights, new_server_state, new_client_velocities,
+    weight_update)``.
+
+    Covers FedOptimizer.step (fed_aggregator.py:431-460) including
+    true_topk's masking of participating clients' local velocities at
+    the global top-k coordinates (fed_aggregator.py:530-535) — done
+    correctly here (the reference has a latent unset-global bug,
+    SURVEY.md §2.1).
+    """
+    cfg.validate_runtime()
+    sketch = args2sketch(cfg)
+
+    def server_round(ps_weights, server_state: ServerState, aggregated,
+                     lr, client_velocities=None, client_ids=None,
+                     noise_rng=None):
+        eff_lr = 1.0 if cfg.mode == "fedavg" else lr
+        res: ServerUpdate = server_update(cfg, aggregated, server_state,
+                                          eff_lr, sketch, noise_rng)
+        new_ps = ps_weights - res.weight_update
+        new_vel = client_velocities
+        if (cfg.mode == "true_topk" and cfg.local_momentum > 0
+                and client_velocities is not None):
+            assert client_ids is not None
+            rows = client_velocities[client_ids]
+            rows = rows * res.client_velocity_keep.astype(rows.dtype)
+            new_vel = client_velocities.at[client_ids].set(rows)
+        return new_ps, res.state, new_vel, res.weight_update
+
+    return server_round

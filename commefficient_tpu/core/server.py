@@ -1,0 +1,156 @@
+"""Server-side update: virtual momentum, virtual error feedback,
+unsketching / top-k recovery.
+
+Pure-functional counterpart of the reference's ``get_server_update``
+dispatch and ``_server_helper_*`` family (fed_aggregator.py:471-615).
+Because the whole server step is deterministic given the aggregated
+gradient, it runs *replicated* on every device of the mesh — the
+reference's parameter-server rank dissolves (SURVEY.md §2.9).
+
+``gradient`` is the round's aggregated quantity: a flat (grad_size,)
+vector, or an (r, c) sketch table in sketch mode — always the
+client-transmit sum divided by the round's total datapoint count
+(fed_aggregator.py:334).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.ops.sketch import CountSketch
+from commefficient_tpu.ops.topk import topk
+
+
+class ServerState(NamedTuple):
+    """Virtual momentum & error buffers, dense or sketch-shaped
+    (reference FedOptimizer.__init__, fed_aggregator.py:401-411)."""
+    Vvelocity: jax.Array
+    Verror: jax.Array
+
+    @staticmethod
+    def init(cfg: Config) -> "ServerState":
+        shape = cfg.transmit_shape
+        return ServerState(jnp.zeros(shape, jnp.float32),
+                           jnp.zeros(shape, jnp.float32))
+
+
+class ServerUpdate(NamedTuple):
+    weight_update: jax.Array          # subtract from ps_weights (dense)
+    state: ServerState
+    # mask of coordinates transmitted to clients this round, used for
+    # true_topk's momentum factor masking of *client* velocities
+    # (fed_aggregator.py:530-535); None for other modes
+    client_velocity_keep: Optional[jax.Array]
+
+
+def server_update(cfg: Config,
+                  gradient: jax.Array,
+                  state: ServerState,
+                  lr,
+                  sketch: Optional[CountSketch] = None,
+                  noise_rng: Optional[jax.Array] = None) -> ServerUpdate:
+    """Dispatch on mode (reference get_server_update,
+    fed_aggregator.py:471-483). ``lr`` may be a scalar or a
+    (grad_size,) per-parameter vector (per-param-group LRs,
+    fed_aggregator.py:413-429). For fedavg the caller passes lr=1 —
+    the LR was already applied in the clients' local SGD
+    (fed_aggregator.py:448-453)."""
+    helper = {
+        "sketch": _sketched,
+        "local_topk": _local_topk,
+        "true_topk": _true_topk,
+        "fedavg": _fedavg,
+        "uncompressed": _uncompressed,
+    }[cfg.mode]
+    return helper(cfg, gradient, state, lr, sketch, noise_rng)
+
+
+def _fedavg(cfg, avg_update, state, lr, sketch, noise_rng):
+    # (fed_aggregator.py:485-497) — avg_update is the data-weighted
+    # mean of client weight *deltas*, LR already applied locally
+    assert cfg.error_type == "none" and cfg.local_momentum == 0
+    Vvel = avg_update + cfg.virtual_momentum * state.Vvelocity
+    return ServerUpdate(Vvel, ServerState(Vvel, state.Verror), None)
+
+
+def _uncompressed(cfg, gradient, state, lr, sketch, noise_rng):
+    # (fed_aggregator.py:499-511)
+    Vvel = gradient + cfg.virtual_momentum * state.Vvelocity
+    grad = Vvel
+    if cfg.do_dp and cfg.dp_mode == "server" and cfg.noise_multiplier != 0:
+        assert noise_rng is not None, \
+            "server-mode DP with noise needs a noise_rng"
+        grad = grad + cfg.noise_multiplier * jax.random.normal(
+            noise_rng, grad.shape, grad.dtype)
+    return ServerUpdate(grad * lr, ServerState(Vvel, state.Verror), None)
+
+
+def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
+    # (fed_aggregator.py:513-544)
+    assert cfg.error_type == "virtual"
+    Vvel = gradient + cfg.virtual_momentum * state.Vvelocity
+    Verr = state.Verror + Vvel
+
+    update = topk(Verr, k=cfg.k)
+    keep = update == 0
+    # error feedback + momentum factor masking at transmitted coords
+    Verr = jnp.where(keep, Verr, 0.0)
+    Vvel = jnp.where(keep, Vvel, 0.0)
+    # participating clients' *local* velocities are masked at the same
+    # coords by the round engine (the reference does this from the
+    # optimizer via globals; here the mask travels in the result —
+    # avoiding the reference's latent unset-global bug, SURVEY.md §2.1)
+    return ServerUpdate(update * lr, ServerState(Vvel, Verr), keep)
+
+
+def _local_topk(cfg, local_topk_grad, state, lr, sketch, noise_rng):
+    # (fed_aggregator.py:546-568): momentum accumulation only; virtual
+    # error is impossible (the transmitted quantity is already sparse)
+    # and masking virtual momentum would zero all of it every round
+    assert cfg.error_type in ("local", "none")
+    Vvel = local_topk_grad + cfg.virtual_momentum * state.Vvelocity
+    return ServerUpdate(Vvel * lr, ServerState(Vvel, state.Verror), None)
+
+
+def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng):
+    """FetchSGD server step (fed_aggregator.py:570-615): momentum and
+    error accumulation happen in (r, c) sketch-table space; top-k
+    recovery via unsketch; error feedback and momentum factor masking
+    are applied in table space at the nonzero buckets of the re-sketch
+    of the recovered update."""
+    assert sketch is not None
+    if cfg.error_type == "local":
+        assert cfg.virtual_momentum == 0
+    elif cfg.error_type == "virtual":
+        assert cfg.local_momentum == 0
+
+    Vvel = sketched_grad + cfg.virtual_momentum * state.Vvelocity
+    if cfg.error_type == "local":
+        Verr = Vvel
+    elif cfg.error_type == "virtual":
+        Verr = state.Verror + Vvel
+    else:  # "none": Verror stays zero forever -> zero updates, exactly
+        # like the reference (fed_aggregator.py:581-587 never assigns)
+        Verr = state.Verror
+
+    update = sketch.unsketch(Verr, k=cfg.k)
+
+    # re-sketch the recovered update to find which table buckets it
+    # occupies (fed_aggregator.py:595-597)
+    sketched_update = sketch.sketch(update)
+    keep = sketched_update == 0
+
+    if cfg.error_type == "virtual":
+        Verr = jnp.where(keep, Verr, 0.0)
+    # momentum factor masking in table space (both error types; with
+    # error "local" this also masks Verror since they alias,
+    # fed_aggregator.py:612-613)
+    Vvel = jnp.where(keep, Vvel, 0.0)
+    if cfg.error_type == "local":
+        Verr = Vvel
+
+    return ServerUpdate(update * lr, ServerState(Vvel, Verr), None)

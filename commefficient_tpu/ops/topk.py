@@ -20,6 +20,7 @@ def topk(vec: jax.Array, k: int) -> jax.Array:
     1-D: global top-k. 2-D: row-wise top-k along the last axis
     (matching torch.topk's dim=-1 default used by the reference).
     """
+    k = min(k, vec.shape[-1])
     if vec.ndim == 1:
         _, idx = jax.lax.top_k(jax.lax.square(vec), k)
         return jnp.zeros_like(vec).at[idx].set(vec[idx], mode="promise_in_bounds")
@@ -35,5 +36,5 @@ def topk_values_indices(vec: jax.Array, k: int):
     """(values, indices) of the k largest-magnitude entries of a 1-D
     vector — the sparse representation actually shipped over the wire
     when measuring upload bytes (k floats, fed_aggregator.py:296-297)."""
-    _, idx = jax.lax.top_k(jax.lax.square(vec), k)
+    _, idx = jax.lax.top_k(jax.lax.square(vec), min(k, vec.shape[-1]))
     return vec[idx], idx

@@ -1,0 +1,5 @@
+from commefficient_tpu.parallel.mesh import (  # noqa: F401
+    client_sharding,
+    make_mesh,
+    replicated,
+)

@@ -1,0 +1,59 @@
+"""Device mesh & sharding layout.
+
+The reference's process topology (1 parameter-server + N worker GPU
+processes over NCCL, fed_aggregator.py:131-165) maps to a 1-D JAX mesh
+with a single ``clients`` axis:
+
+- participating clients' batches and per-client state rows are sharded
+  over ``clients`` (what the reference kept in host shared memory,
+  fed_aggregator.py:94-129);
+- model weights and server state are replicated (every device runs the
+  identical deterministic server step — no PS rank);
+- the per-round transmit aggregation is a sum over the sharded axis,
+  which XLA lowers to one ICI all-reduce — the moral equivalent of the
+  reference's single NCCL ``reduce`` per round (fed_worker.py:139-140).
+
+Multi-host pods need no new code: under the standard JAX
+multi-controller runtime, ``jax.devices()`` spans hosts, the same mesh
+covers ICI+DCN, and XLA routes the collective hierarchically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "clients"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (CLIENT_AXIS,))
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard leading (client) axis across the mesh."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, tree):
+    """Place a pytree of (W, ...)-leading arrays with the client axis
+    sharded. When W doesn't divide the mesh size (XLA requires
+    divisibility) the batch is replicated instead — correct, just not
+    load-balanced; pick num_workers divisible by the device count for
+    full throughput."""
+    n = mesh.devices.size
+
+    def put(x):
+        sh = (client_sharding(mesh) if x.shape[0] % n == 0
+              else replicated(mesh))
+        return jax.device_put(x, sh)
+
+    return jax.tree_util.tree_map(put, tree)

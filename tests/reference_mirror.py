@@ -1,0 +1,162 @@
+"""Independent NumPy mirror of the reference's federated-round math.
+
+Implements, in plain NumPy and torch-free, exactly the semantics of
+/root/reference/CommEfficient fed_worker.py:142-337 (client side) and
+fed_aggregator.py:431-615 (server side), for use as a test oracle
+against the JAX engine. Written from the reference's equations, not
+its code structure.
+
+NB the reference repo's own unit_test.py traces (w2=0.3808 etc.)
+target an *obsolete* API and are unreachable under the current
+reference code (e.g. current math gives w2=0.2604 for the 1-param
+case); this mirror is the oracle for the *current* semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def np_topk(v, k):
+    out = np.zeros_like(v)
+    if k >= v.size:
+        return v.copy()
+    idx = np.argsort(v ** 2)[-k:]
+    out[idx] = v[idx]
+    return out
+
+
+class MirrorFed:
+    """Dense-mode mirror (uncompressed / true_topk / local_topk /
+    fedavg). Sketch mode is exercised through the shared CountSketch op
+    (itself independently property-tested)."""
+
+    def __init__(self, cfg, w0, num_clients, sketch=None):
+        self.cfg = cfg
+        self.w = np.asarray(w0, np.float64).copy()
+        d = self.w.size
+        shape = ((cfg.num_rows, cfg.num_cols) if cfg.mode == "sketch"
+                 else (d,))
+        self.Vvel = np.zeros(shape)
+        self.Verr = np.zeros(shape)
+        self.vel = np.zeros((num_clients,) + shape)
+        self.err = np.zeros((num_clients,) + shape)
+        self.sketch = sketch
+
+    # client math ---------------------------------------------------------
+
+    def _grad_mean(self, X, y, w):
+        """MSE mean loss: L = mean_i (w.x_i - y_i)^2."""
+        r = X @ w - y
+        return (2.0 / len(y)) * (X.T @ r)
+
+    def _client_transmit(self, cid, X, y):
+        cfg = self.cfg
+        g = self._grad_mean(X, y, self.w)
+        if cfg.weight_decay:
+            g = g + cfg.weight_decay / cfg.num_workers * self.w
+        if cfg.do_dp:
+            # clip to l2_norm_clip (fed_worker.py:306-307); worker-mode
+            # noise is tested separately with noise_multiplier=0
+            norm = np.linalg.norm(g)
+            if norm > cfg.l2_norm_clip:
+                g = g * (cfg.l2_norm_clip / norm)
+        if cfg.mode == "sketch":
+            g = np.asarray(self.sketch.sketch(
+                np.asarray(g, np.float32)), np.float64)
+        g = g * len(y)  # sum-of-grads semantics (fed_worker.py:192)
+        if cfg.local_momentum > 0:
+            self.vel[cid] = g + cfg.local_momentum * self.vel[cid]
+        if cfg.error_type == "local":
+            self.err[cid] += (self.vel[cid] if cfg.local_momentum > 0
+                              else g)
+            tt = self.err[cid].copy()
+        else:
+            tt = (self.vel[cid].copy() if cfg.local_momentum > 0
+                  else g.copy())
+        if cfg.mode == "local_topk":
+            tt = np_topk(tt, cfg.k)
+            nz = tt != 0
+            if cfg.error_type == "local":
+                self.err[cid][nz] = 0
+            if cfg.local_momentum > 0:
+                self.vel[cid][nz] = 0
+        return tt
+
+    # server math ---------------------------------------------------------
+
+    def _server(self, agg, lr, participating):
+        cfg = self.cfg
+        rho = cfg.virtual_momentum
+        if cfg.mode in ("uncompressed", "fedavg", "local_topk"):
+            self.Vvel = agg + rho * self.Vvel
+            eff_lr = 1.0 if cfg.mode == "fedavg" else lr
+            return self.Vvel * eff_lr
+        if cfg.mode == "true_topk":
+            self.Vvel = agg + rho * self.Vvel
+            self.Verr = self.Verr + self.Vvel
+            upd = np_topk(self.Verr, cfg.k)
+            nz = upd != 0
+            self.Verr[nz] = 0
+            self.Vvel[nz] = 0
+            if cfg.local_momentum > 0:
+                for cid in participating:
+                    self.vel[cid][nz] = 0
+            return upd * lr
+        if cfg.mode == "sketch":
+            self.Vvel = agg + rho * self.Vvel
+            if cfg.error_type == "local":
+                self.Verr = self.Vvel.copy()
+            elif cfg.error_type == "virtual":
+                self.Verr = self.Verr + self.Vvel
+            upd = np.asarray(self.sketch.unsketch(
+                np.asarray(self.Verr, np.float32), k=cfg.k), np.float64)
+            su = np.asarray(self.sketch.sketch(
+                np.asarray(upd, np.float32)), np.float64)
+            nz = su != 0
+            if cfg.error_type == "virtual":
+                self.Verr[nz] = 0
+            self.Vvel[nz] = 0
+            if cfg.error_type == "local":
+                self.Verr = self.Vvel.copy()
+            return upd * lr
+        raise ValueError(cfg.mode)
+
+    # round ---------------------------------------------------------------
+
+    def round(self, clients, lr):
+        """clients: list of (client_id, X, y). Returns new weights."""
+        total = sum(len(y) for _, _, y in clients)
+        transmits = [self._client_transmit(cid, X, y)
+                     for cid, X, y in clients]
+        agg = np.sum(transmits, axis=0) / total
+        upd = self._server(agg, lr, [cid for cid, _, _ in clients])
+        self.w = self.w - upd
+        return self.w.copy()
+
+    def round_fedavg(self, clients, lr):
+        """FedAvg local SGD (fed_worker.py:62-114): per client, split
+        its data into fedavg_batch_size chunks, run
+        num_fedavg_epochs x n_batches decayed-LR SGD steps, transmit
+        (w0 - w_final) * |client data|."""
+        cfg = self.cfg
+        total = sum(len(y) for _, _, y in clients)
+        transmits = []
+        for cid, X, y in clients:
+            w = self.w.copy()
+            n = len(y)
+            bs = n if cfg.fedavg_batch_size == -1 else cfg.fedavg_batch_size
+            step = 0
+            for _ in range(cfg.num_fedavg_epochs):
+                for s in range(0, n, bs):
+                    Xb, yb = X[s:s + bs], y[s:s + bs]
+                    g = self._grad_mean(Xb, yb, w)
+                    if cfg.weight_decay:
+                        g = g + cfg.weight_decay / cfg.num_workers * w
+                    w = w - g * lr * (cfg.fedavg_lr_decay ** step)
+                    step += 1
+            transmits.append((self.w - w) * n)
+        agg = np.sum(transmits, axis=0) / total
+        upd = self._server(agg, 1.0, [c for c, _, _ in clients])
+        self.w = self.w - upd
+        return self.w.copy()

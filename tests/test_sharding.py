@@ -1,0 +1,120 @@
+"""SPMD correctness: a federated round must produce identical results
+whether the client axis is sharded over 8 devices or run on one —
+the moral equivalent of the reference's NCCL-vs-single-process
+degradation guarantee (fed_aggregator.py:163-169, SURVEY.md §4)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.core.rounds import (ClientStates,
+                                           build_client_round,
+                                           build_server_round)
+from commefficient_tpu.core.server import ServerState
+from commefficient_tpu.parallel import client_sharding, make_mesh
+
+from test_modes import linear_loss
+
+
+def _setup(mode="sketch", **kw):
+    base = dict(mode=mode, local_momentum=0.0, virtual_momentum=0.9,
+                weight_decay=0.0, error_type="virtual", num_workers=8,
+                k=4, num_rows=3, num_cols=32, num_blocks=1,
+                grad_size=16, seed=21)
+    base.update(kw)
+    return Config(**base)
+
+
+def _batch(W=8, B=3, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        {"x": jnp.asarray(rng.randn(W, B, d).astype(np.float32)),
+         "y": jnp.asarray(rng.randn(W, B).astype(np.float32)),
+         "mask": jnp.ones((W, B), jnp.float32)},
+        jnp.arange(W, dtype=jnp.int32),
+    )
+
+
+def _run_round(cfg, batch, ids, shard=False):
+    d = cfg.grad_size
+    client_round = jax.jit(build_client_round(cfg, linear_loss,
+                                              batch["x"].shape[1]))
+    server_round = jax.jit(build_server_round(cfg))
+    ps = jnp.zeros(d, jnp.float32).at[0].set(0.5)
+    cs = ClientStates.init(cfg, 16, ps)
+    ss = ServerState.init(cfg)
+    if shard:
+        mesh = make_mesh()
+        sh = client_sharding(mesh)
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), batch)
+        ids = jax.device_put(ids, sh)
+    res = client_round(ps, cs, batch, ids, jax.random.PRNGKey(0), 1.0)
+    ps2, ss2, _, upd = server_round(ps, ss, res.aggregated,
+                                    jnp.float32(0.01))
+    return np.asarray(res.aggregated), np.asarray(ps2)
+
+
+class TestShardingInvariance:
+    def test_sketch_mode(self, devices):
+        cfg = _setup("sketch")
+        batch, ids = _batch()
+        agg_1, ps_1 = _run_round(cfg, batch, ids, shard=False)
+        agg_8, ps_8 = _run_round(cfg, batch, ids, shard=True)
+        np.testing.assert_allclose(agg_1, agg_8, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(ps_1, ps_8, rtol=1e-5, atol=1e-6)
+
+    def test_true_topk_mode(self, devices):
+        cfg = _setup("true_topk", virtual_momentum=0.0)
+        batch, ids = _batch(seed=1)
+        agg_1, ps_1 = _run_round(cfg, batch, ids, shard=False)
+        agg_8, ps_8 = _run_round(cfg, batch, ids, shard=True)
+        np.testing.assert_allclose(agg_1, agg_8, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(ps_1, ps_8, rtol=1e-5, atol=1e-6)
+
+    def test_uneven_clients_over_devices(self, devices):
+        """W=6 over 8 devices: shard_batch must fall back to
+        replication (XLA requires divisibility) and stay exact."""
+        from commefficient_tpu.parallel.mesh import shard_batch
+        cfg = _setup("uncompressed", error_type="none",
+                     num_workers=6)
+        batch, ids = _batch(W=6, seed=2)
+        agg_1, _ = _run_round(cfg, batch, ids, shard=False)
+        mesh = make_mesh()
+        batch_r = shard_batch(mesh, batch)
+        agg_8, _ = _run_round(cfg, batch_r, ids, shard=False)
+        np.testing.assert_allclose(agg_1, agg_8, rtol=1e-5, atol=1e-6)
+
+    def test_client_state_sharded_rows_update(self, devices):
+        """Per-client momentum rows sharded over the mesh must update
+        exactly as the single-device run (the reference's shared-memory
+        client_velocities, fed_aggregator.py:127-129)."""
+        cfg = _setup("local_topk", error_type="local",
+                     local_momentum=0.9, virtual_momentum=0.0)
+        batch, ids = _batch(seed=3)
+
+        def run(shard):
+            client_round = jax.jit(
+                build_client_round(cfg, linear_loss, 3))
+            ps = jnp.zeros(16, jnp.float32)
+            cs = ClientStates.init(cfg, 16, ps)
+            b, i = batch, ids
+            if shard:
+                mesh = make_mesh()
+                sh = client_sharding(mesh)
+                b = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, sh), b)
+                cs = ClientStates(
+                    jax.device_put(cs.velocities, sh),
+                    jax.device_put(cs.errors, sh), None)
+            res = client_round(ps, cs, b, i, jax.random.PRNGKey(0), 1.0)
+            return (np.asarray(res.client_states.velocities),
+                    np.asarray(res.client_states.errors))
+
+        v1, e1 = run(False)
+        v8, e8 = run(True)
+        np.testing.assert_allclose(v1, v8, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(e1, e8, rtol=1e-5, atol=1e-6)
