@@ -1,0 +1,104 @@
+"""Headline benchmark: federated client-updates/sec, ResNet9/CIFAR10
+config at the reference's default sketch geometry.
+
+Runs the full FetchSGD round on whatever accelerator JAX provides (the
+driver runs this on real TPU): ResNet9 (~6.6M params), 8 clients/round
+x local batch 8, count-sketch 5x500k + unsketch k=50k + server step.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the ratio to BASELINE_CLIENTS_PER_SEC, an estimate
+of the reference PyTorch implementation's single-A100 throughput on
+the same config (the repo publishes no numbers — BASELINE.md; estimate
+derived from per-round fwd/bwd + CSVec cost at batch 8).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.core.rounds import (ClientStates,
+                                           build_client_round,
+                                           build_server_round)
+from commefficient_tpu.core.server import ServerState
+from commefficient_tpu.models import get_model
+from commefficient_tpu.ops.vec import flatten_params
+from commefficient_tpu.train.cv_train import make_compute_loss
+
+BASELINE_CLIENTS_PER_SEC = 60.0  # est. reference single-A100 (see doc)
+
+W, B, NUM_CLIENTS, ROUNDS = 8, 8, 100, 20
+
+
+def main():
+    cfg = Config(mode="sketch", error_type="virtual", local_momentum=0.0,
+                 virtual_momentum=0.9, weight_decay=5e-4,
+                 num_workers=W, local_batch_size=B,
+                 k=50000, num_rows=5, num_cols=500000, num_blocks=20,
+                 dataset_name="CIFAR10", seed=21)
+
+    module = get_model("ResNet9")(num_classes=10)
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 32, 32, 3)))["params"]
+    flat, unravel = flatten_params(params)
+    cfg.grad_size = int(flat.size)
+
+    compute_loss = make_compute_loss(module)
+
+    def loss_flat(p, batch):
+        return compute_loss(unravel(p), batch, cfg)
+
+    client_round = jax.jit(build_client_round(cfg, loss_flat, B))
+    server_round = jax.jit(build_server_round(cfg))
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": jnp.asarray(rng.randn(W, B, 32, 32, 3).astype(np.float32)),
+        "y": jnp.asarray(rng.randint(0, 10, (W, B)).astype(np.int32)),
+        "mask": jnp.ones((W, B), jnp.float32),
+    }
+    ids = jnp.arange(W, dtype=jnp.int32)
+    ps = flat
+    cs = ClientStates.init(cfg, NUM_CLIENTS, ps)
+    ss = ServerState.init(cfg)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def run_rounds(ps, ss):
+        """ROUNDS federated rounds chained in one program — measures
+        true device throughput (per-dispatch tunnel latency to the
+        remote chip is ~70 ms and would otherwise dominate; a real
+        deployment batches rounds the same way)."""
+        def body(r, carry):
+            ps, ss = carry
+            res = client_round(ps, cs, batch, ids,
+                               jax.random.fold_in(key, r), 1.0)
+            ps, ss, _, _ = server_round(ps, ss, res.aggregated,
+                                        jnp.float32(0.1))
+            return ps, ss
+        return jax.lax.fori_loop(0, ROUNDS, body, (ps, ss))
+
+    # warmup/compile
+    w_ps, w_ss = run_rounds(ps, ss)
+    float(jnp.sum(w_ps))  # force full materialisation through the relay
+
+    t0 = time.perf_counter()
+    out_ps, _ = run_rounds(ps, ss)
+    float(jnp.sum(out_ps))
+    dt = time.perf_counter() - t0
+
+    clients_per_sec = W * ROUNDS / dt
+    print(json.dumps({
+        "metric": "client_updates_per_sec_resnet9_sketch",
+        "value": round(clients_per_sec, 2),
+        "unit": "clients/s",
+        "vs_baseline": round(clients_per_sec / BASELINE_CLIENTS_PER_SEC,
+                             3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

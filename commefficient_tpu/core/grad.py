@@ -105,9 +105,12 @@ def make_forward_grad(cfg: Config,
         batch_size = _masked_count(batch)
         metrics = tuple(w / batch_size for w in weighted)
 
-        # per-worker grad clipping, non-sketch (fed_worker.py:292-294)
+        # per-worker grad clipping, non-sketch (fed_worker.py:292-294);
+        # the reference's num_iters comes from the *real* batch size
+        # (fed_worker.py:267), so derive it from the mask, not padding
         if cfg.max_grad_norm is not None and cfg.mode != "sketch":
-            g = clip_by_l2(g, cfg.max_grad_norm * num_iters)
+            real_iters = jnp.ceil(batch_size / mb)
+            g = clip_by_l2(g, cfg.max_grad_norm * real_iters)
 
         # fused weight decay (utils.py:254-259)
         if cfg.weight_decay != 0:

@@ -80,13 +80,15 @@ def _fedavg(cfg, avg_update, state, lr, sketch, noise_rng):
 def _uncompressed(cfg, gradient, state, lr, sketch, noise_rng):
     # (fed_aggregator.py:499-511)
     Vvel = gradient + cfg.virtual_momentum * state.Vvelocity
-    grad = Vvel
     if cfg.do_dp and cfg.dp_mode == "server" and cfg.noise_multiplier != 0:
         assert noise_rng is not None, \
             "server-mode DP with noise needs a noise_rng"
-        grad = grad + cfg.noise_multiplier * jax.random.normal(
-            noise_rng, grad.shape, grad.dtype)
-    return ServerUpdate(grad * lr, ServerState(Vvel, state.Verror), None)
+        # the reference adds the noise in place on Vvelocity
+        # (``grad`` aliases it, fed_aggregator.py:506-510), so the
+        # noise persists into the momentum buffer — keep that
+        Vvel = Vvel + cfg.noise_multiplier * jax.random.normal(
+            noise_rng, Vvel.shape, Vvel.dtype)
+    return ServerUpdate(Vvel * lr, ServerState(Vvel, state.Verror), None)
 
 
 def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):

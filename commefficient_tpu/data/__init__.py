@@ -1,0 +1,33 @@
+from commefficient_tpu.data.fed_dataset import FedDataset  # noqa: F401
+from commefficient_tpu.data.fed_cifar import FedCIFAR10, FedCIFAR100  # noqa: F401
+from commefficient_tpu.data.synthetic import FedSynthetic  # noqa: F401
+from commefficient_tpu.data.fed_sampler import FedSampler  # noqa: F401
+from commefficient_tpu.data.loader import FedLoader, ValLoader  # noqa: F401
+
+DATASET_REGISTRY = {
+    "CIFAR10": FedCIFAR10,
+    "CIFAR100": FedCIFAR100,
+    "Synthetic": FedSynthetic,
+}
+
+
+def get_dataset_cls(name: str):
+    """Dataset registry — the reference resolves ``globals()["Fed" +
+    name]`` (cv_train.py:262); EMNIST/ImageNet/PERSONA register here
+    when their modules land."""
+    try:
+        from commefficient_tpu.data.fed_emnist import FedEMNIST
+        DATASET_REGISTRY.setdefault("EMNIST", FedEMNIST)
+    except ImportError:
+        pass
+    try:
+        from commefficient_tpu.data.fed_imagenet import FedImageNet
+        DATASET_REGISTRY.setdefault("ImageNet", FedImageNet)
+    except ImportError:
+        pass
+    try:
+        from commefficient_tpu.data.fed_persona import FedPERSONA
+        DATASET_REGISTRY.setdefault("PERSONA", FedPERSONA)
+    except ImportError:
+        pass
+    return DATASET_REGISTRY[name]

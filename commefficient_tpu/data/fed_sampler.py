@@ -1,0 +1,57 @@
+"""Federated round scheduler — same semantics as reference
+data_utils/fed_sampler.py:5-71: shuffle within each client, then each
+round sample ``num_workers`` non-exhausted clients without replacement
+and take up to ``local_batch_size`` records from each (-1 = the
+client's whole remaining data); epoch ends when every client is
+exhausted."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["FedSampler"]
+
+
+class FedSampler:
+    def __init__(self, dataset, num_workers, local_batch_size,
+                 shuffle_clients=True, seed=None):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.local_batch_size = local_batch_size
+        self.shuffle_clients = shuffle_clients
+        self.rng = (np.random if seed is None
+                    else np.random.RandomState(seed))
+
+    def __iter__(self):
+        data_per_client = np.asarray(self.dataset.data_per_client)
+        cumsum = np.hstack([[0], np.cumsum(data_per_client)])
+        permuted = np.hstack([
+            s + self.rng.permutation(u)
+            for s, u in zip(cumsum, data_per_client)])
+        cur = np.zeros(self.dataset.num_clients, dtype=int)
+
+        def sampler():
+            while True:
+                alive = np.where(cur < data_per_client)[0]
+                if len(alive) == 0:
+                    break
+                n = min(self.num_workers, len(alive))
+                workers = self.rng.choice(alive, n, replace=False)
+                remaining = data_per_client[workers] - cur[workers]
+                if self.local_batch_size == -1:
+                    sizes = remaining
+                else:
+                    sizes = np.clip(remaining, 0, self.local_batch_size)
+                # per-client index lists (the engine wants them grouped,
+                # unlike the reference's flat concatenation which the
+                # server re-groups, fed_aggregator.py:219-225)
+                idx_lists = [
+                    permuted[s:s + sizes[i]]
+                    for i, s in enumerate(cumsum[workers] + cur[workers])]
+                yield list(zip(workers.tolist(), idx_lists))
+                cur[workers] += sizes
+
+        return sampler()
+
+    def __len__(self):
+        return len(self.dataset)

@@ -1,0 +1,102 @@
+"""Round-batch construction: sampler output -> fixed-shape padded
+engine batches.
+
+The reference ships a flat concatenated tensor batch to the server,
+which re-groups rows by client id and queues them to worker processes
+(fed_aggregator.py:214-238). Here the loader itself emits the static
+(W, B, ...) layout the jitted round wants — client axis first, a
+(W, B) mask for ragged clients — so the device never sees a dynamic
+shape (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+__all__ = ["FedLoader", "ValLoader"]
+
+
+class FedLoader:
+    """Iterate federated train rounds.
+
+    Yields dicts: ``client_ids`` (W,) int32, ``x`` (W, B, ...) f32,
+    ``y`` (W, B) i32, ``mask`` (W, B) f32. Rounds with fewer than
+    ``num_workers`` distinct clients are skipped, matching the
+    reference's run_batches guard (cv_train.py:205-219).
+    """
+
+    def __init__(self, dataset, sampler, max_batch_size: Optional[int] = None):
+        self.dataset = dataset
+        self.sampler = sampler
+        if max_batch_size is not None:
+            self.B = max_batch_size
+        elif sampler.local_batch_size != -1:
+            self.B = sampler.local_batch_size
+        else:
+            self.B = int(np.max(dataset.data_per_client))
+        self.W = sampler.num_workers
+
+    def __iter__(self) -> Iterator[dict]:
+        for round_spec in self.sampler:
+            if len(round_spec) < self.W:
+                continue  # incomplete round: skip
+            yield self.collate(round_spec)
+
+    def collate(self, round_spec) -> dict:
+        W, B = self.W, self.B
+        first = self.dataset[int(round_spec[0][1][0])]
+        img_shape = np.asarray(first[1]).shape
+        x = np.zeros((W, B) + img_shape, np.float32)
+        y = np.zeros((W, B), np.int32)
+        mask = np.zeros((W, B), np.float32)
+        ids = np.zeros((W,), np.int32)
+        for i, (cid, idxs) in enumerate(round_spec):
+            ids[i] = cid
+            for j, idx in enumerate(idxs[:B]):
+                client_id, img, target = self.dataset[int(idx)]
+                assert client_id == cid, (client_id, cid)
+                x[i, j] = img
+                y[i, j] = target
+                mask[i, j] = 1.0
+        return {"client_ids": ids, "x": x, "y": y, "mask": mask}
+
+    def __len__(self):
+        from commefficient_tpu.utils import steps_per_epoch
+        return steps_per_epoch(self.sampler.local_batch_size,
+                               self.dataset, self.W)
+
+
+class ValLoader:
+    """Validation shards: yields (S, B, ...) stacked shards of
+    ``valid_batch_size`` each — the reference's _call_val splitting
+    (fed_aggregator.py:339-350) without the queue plumbing. The final
+    partial shard is padded and masked."""
+
+    def __init__(self, dataset, valid_batch_size: int,
+                 shards_per_step: int = 8):
+        self.dataset = dataset
+        self.B = valid_batch_size
+        self.S = shards_per_step
+
+    def __iter__(self):
+        n = len(self.dataset)
+        step = self.B * self.S
+        for start in range(0, n, step):
+            idxs = np.arange(start, min(start + step, n))
+            first = self.dataset[0]
+            img_shape = np.asarray(first[1]).shape
+            x = np.zeros((self.S, self.B) + img_shape, np.float32)
+            y = np.zeros((self.S, self.B), np.int32)
+            mask = np.zeros((self.S, self.B), np.float32)
+            for pos, idx in enumerate(idxs):
+                s, j = divmod(pos, self.B)
+                _, img, target = self.dataset[int(idx)]
+                x[s, j] = img
+                y[s, j] = target
+                mask[s, j] = 1.0
+            yield {"x": x, "y": y, "mask": mask}
+
+    def __len__(self):
+        return int(np.ceil(len(self.dataset) / (self.B * self.S)))

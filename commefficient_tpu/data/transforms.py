@@ -1,0 +1,77 @@
+"""Image transforms — numpy re-implementations of the torchvision
+stacks the reference uses (data_utils/transforms.py:1-75). All operate
+on HWC float arrays; normalization constants are identical."""
+
+from __future__ import annotations
+
+import numpy as np
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+CIFAR100_MEAN = np.array([0.5071, 0.4865, 0.4409], np.float32)
+CIFAR100_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
+EMNIST_MEAN = np.array([0.1307], np.float32)
+EMNIST_STD = np.array([0.3081], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToFloat:
+    """uint8 HWC -> float32 in [0, 1]."""
+
+    def __call__(self, x):
+        if x.dtype == np.uint8:
+            return x.astype(np.float32) / 255.0
+        return x.astype(np.float32)
+
+
+class Normalize:
+    def __init__(self, mean, std):
+        self.mean, self.std = mean, std
+
+    def __call__(self, x):
+        return (x - self.mean) / self.std
+
+
+class RandomCrop:
+    """Pad by ``padding`` then random-crop back to ``size``."""
+
+    def __init__(self, size, padding=4, rng=None):
+        self.size, self.padding = size, padding
+        self.rng = rng or np.random
+
+    def __call__(self, x):
+        p = self.padding
+        x = np.pad(x, ((p, p), (p, p), (0, 0)), mode="reflect")
+        i = self.rng.randint(0, x.shape[0] - self.size + 1)
+        j = self.rng.randint(0, x.shape[1] - self.size + 1)
+        return x[i:i + self.size, j:j + self.size]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, rng=None):
+        self.rng = rng or np.random
+
+    def __call__(self, x):
+        if self.rng.rand() < 0.5:
+            return x[:, ::-1].copy()
+        return x
+
+
+def cifar_train_transform(mean=CIFAR10_MEAN, std=CIFAR10_STD):
+    return Compose([ToFloat(), RandomCrop(32, 4),
+                    RandomHorizontalFlip(), Normalize(mean, std)])
+
+
+def cifar_val_transform(mean=CIFAR10_MEAN, std=CIFAR10_STD):
+    return Compose([ToFloat(), Normalize(mean, std)])

@@ -1,0 +1,96 @@
+"""ResNet9 — the cifar10_fast-style 9-layer ResNet (default CV model).
+
+Flax re-design of reference models/resnet9.py:32-159: ConvBN blocks
+(3x3 conv, optional BatchNorm, ReLU, optional 2x2 max-pool), two
+residual blocks, a bias-free linear head scaled by 0.125 (``Mul``).
+
+TPU notes:
+- NHWC layout (XLA's native conv layout on TPU).
+- BatchNorm ("--batchnorm") computes batch statistics both in training
+  and eval. The reference keeps torch running stats in each worker
+  process, which never federate and diverge per-worker
+  (SURVEY.md §7 "BatchNorm under client-vmap"); batch-stat eval is the
+  well-defined equivalent. The BN-free default path is identical to
+  the reference's default (do_batchnorm=False, utils.py:138).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from commefficient_tpu.models import register_model
+
+_conv_init = nn.initializers.he_normal()
+
+
+class ConvBN(nn.Module):
+    """(reference resnet9.py:32-50)"""
+    c_out: int
+    do_batchnorm: bool = False
+    pool: bool = False
+    bn_weight_init: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                    kernel_init=_conv_init)(x)
+        if self.do_batchnorm:
+            # batch statistics in train and eval; running averages are
+            # never used (see module docstring), so mark them
+            # non-collectable by always recomputing
+            x = nn.BatchNorm(
+                use_running_average=False,
+                scale_init=nn.initializers.constant(self.bn_weight_init),
+            )(x)
+        x = nn.relu(x)
+        if self.pool:
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class Residual(nn.Module):
+    """x + relu(ConvBN(ConvBN(x))) (reference resnet9.py:61-68)"""
+    c: int
+    do_batchnorm: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = ConvBN(self.c, self.do_batchnorm)(x, train)
+        y = ConvBN(self.c, self.do_batchnorm)(y, train)
+        return x + nn.relu(y)
+
+
+@register_model("ResNet9")
+class ResNet9(nn.Module):
+    """(reference resnet9.py:74-159; channel plan at 147-148)"""
+    num_classes: int = 10
+    do_batchnorm: bool = False
+    initial_channels: int = 3
+    channels: Optional[Dict[str, int]] = None
+    weight: float = 0.125
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        ch = self.channels or {"prep": 64, "layer1": 128,
+                               "layer2": 256, "layer3": 512}
+        x = ConvBN(ch["prep"], self.do_batchnorm)(x, train)
+        x = ConvBN(ch["layer1"], self.do_batchnorm, pool=True)(x, train)
+        x = Residual(ch["layer1"], self.do_batchnorm)(x, train)
+        x = ConvBN(ch["layer2"], self.do_batchnorm, pool=True)(x, train)
+        x = ConvBN(ch["layer3"], self.do_batchnorm, pool=True)(x, train)
+        x = Residual(ch["layer3"], self.do_batchnorm)(x, train)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, use_bias=False,
+                     kernel_init=_conv_init)(x)
+        return x * self.weight
+
+    @staticmethod
+    def test_config(num_classes: int = 10) -> Dict[str, Any]:
+        """--test shrink: 1 channel per layer (cv_train.py:329-336)."""
+        return dict(channels={"prep": 1, "layer1": 1,
+                              "layer2": 1, "layer3": 1},
+                    num_classes=num_classes)

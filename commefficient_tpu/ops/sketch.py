@@ -4,28 +4,44 @@ In-tree replacement for the reference's external CUDA `csvec` library
 (used at fed_aggregator.py:5,466-469,586-597 and fed_worker.py:315-322;
 API surface documented in SURVEY.md §2.9). Semantics:
 
-- An ``(r, c)`` table of buckets. Coordinate ``i`` of a d-dim vector is
-  hashed by each of the r rows to a column ``h_r(i)`` and a sign
-  ``s_r(i) ∈ {±1}``; sketching scatter-adds ``s_r(i)·v[i]`` into
-  ``table[r, h_r(i)]``.
+- An ``(r, c)`` table of buckets. Coordinate ``i`` is hashed by each of
+  the r rows to a column ``h_r(i)`` and a sign ``s_r(i) ∈ {±1}``;
+  sketching adds ``s_r(i)·v[i]`` into ``table[r, h_r(i)]``.
 - Recovery estimates ``v[i] ≈ median_r(s_r(i)·table[r, h_r(i)])``;
   ``unsketch(k)`` returns a dense vector keeping only the k
   largest-magnitude estimates (heavy hitters).
 - ``l2estimate() = sqrt(median_r ‖table[r]‖²)``.
 
-Design notes (TPU-first, not a CUDA translation):
+**TPU-first hash design — the rotation (circulant) sketch.** A CUDA
+count-sketch scatter-adds to random buckets; random scatter/gather is
+the worst workload for a TPU's vector units (measured: >200 ms for the
+ResNet9-sized sketch via XLA scatter). Instead, the padded coordinate
+space is split into ``m = ceil(d/c)`` contiguous chunks of width c,
+and row r assigns coordinate ``i`` (chunk ``t = i // c``, offset
+``j = i % c``) the bucket
 
-- Hashes/signs are **counter-based**: a murmur3-style integer mixer of
-  (coordinate index XOR per-row seed), computed in-register. No stored
-  hash tables, so the operator has zero state to ship across devices
-  and is bit-deterministic on every replica — which makes
-  ``psum(table)`` over the mesh exactly equal to the sketch of the
-  summed vector (sketching is linear in v for *fixed* hashes).
-- Both sketching and recovery stream over fixed-size coordinate blocks
-  with ``lax.scan`` so peak memory is O(block + r·c), never O(r·d).
-  ``num_blocks`` (same flag as the reference's CUDA memory knob) sets
-  the block count.
-- All shapes are static; everything here is jit/vmap/pjit-compatible.
+    h_r(i) = (j + o[r, t]) mod c
+
+with a pseudorandom per-(row, chunk) rotation ``o[r, t]`` and
+per-coordinate murmur signs. Then:
+
+- sketching row r = sign-multiply + per-chunk ``roll`` + chunk-sum —
+  aligned VPU ops, zero scatter;
+- recovery row r = per-chunk inverse ``roll`` of the table row —
+  zero gather.
+
+Collision analysis (why CS guarantees survive): two coords in the same
+chunk keep their offset distance under rotation, so they **never**
+collide (better than the classic 1/c); coords in chunks t ≠ t' collide
+iff ``o[r,t] - o[r,t'] ≡ j' - j (mod c)`` — probability 1/c,
+independent across rows. Per-pair collision probability ≤ 1/c
+throughout, which is the only property the count-sketch variance bound
+uses; signs are iid per coordinate, so estimates stay unbiased.
+
+Rotations and signs are counter-based (murmur3 mixer of the seed), so
+the operator is stateless and bit-deterministic on every replica —
+``psum(table)`` over the mesh equals the sketch of the summed vector
+exactly (linearity + fixed hashes).
 """
 
 from __future__ import annotations
@@ -40,15 +56,31 @@ import numpy as np
 _M1 = np.uint32(0x85EBCA6B)
 _M2 = np.uint32(0xC2B2AE35)
 
+# chunk counts up to this get fully unrolled static-shift rolls (fast
+# path); above it, a scan with dynamic shifts keeps the emitted XLA
+# program constant-size (tiny-c configs like --num_cols 1000 at
+# grad_size 1e6 would otherwise unroll thousands of ops)
+_UNROLL_LIMIT = 128
+
 
 def _mix(x: jax.Array) -> jax.Array:
-    """murmur3 fmix32 finalizer — a cheap, well-dispersed bijection on
-    uint32, vectorisable on the VPU."""
+    """murmur3 fmix32 finalizer — cheap, well-dispersed, VPU-friendly."""
     x = x ^ (x >> 16)
     x = x * _M1
     x = x ^ (x >> 13)
     x = x * _M2
     x = x ^ (x >> 16)
+    return x
+
+
+def _np_mix(x: np.ndarray) -> np.ndarray:
+    """numpy twin of _mix (identical uint32 wraparound semantics)."""
+    x = np.asarray(x, np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = x * _M1
+    x = x ^ (x >> np.uint32(13))
+    x = x * _M2
+    x = x ^ (x >> np.uint32(16))
     return x
 
 
@@ -58,7 +90,10 @@ class CountSketch:
 
     Mirrors ``CSVec(d, c, r, numBlocks)`` (reference
     fed_aggregator.py:466-469) minus the device argument — placement is
-    the mesh's job. Instances are hashable and static under jit.
+    the mesh's job. ``num_blocks`` is accepted for CLI parity (it was
+    the reference CUDA library's memory knob) but unused: the rotation
+    formulation has no memory blow-up to manage. Instances are
+    hashable and static under jit.
     """
 
     d: int
@@ -73,110 +108,134 @@ class CountSketch:
     # --- hashing ---------------------------------------------------------
 
     @property
-    def _block(self) -> int:
-        return -(-self.d // max(self.num_blocks, 1))  # ceil
+    def _m(self) -> int:
+        """number of coordinate chunks"""
+        return -(-self.d // self.c)  # ceil
 
     @property
     def _padded_d(self) -> int:
-        return self._block * max(self.num_blocks, 1)
+        return self._m * self.c
 
-    def _row_seeds(self):
-        """Two distinct uint32 seeds per row (bucket and sign)."""
-        rows = np.arange(self.r, dtype=np.uint64)
-        base = self.seed & 0xFFFFFFFF
+    def _seeds(self):
+        base = np.uint64(self.seed & 0xFFFFFFFF)
         mask = np.uint64(0xFFFFFFFF)
-        bucket_seed = ((base * 0x9E3779B9 + rows * 0x7FEB352D + 1) & mask)
-        sign_seed = ((base * 0x6C62272E + rows * 0x846CA68B + 2) & mask)
-        return (jnp.asarray(bucket_seed.astype(np.uint32)),
-                jnp.asarray(sign_seed.astype(np.uint32)))
+        rot = np.uint32((base * np.uint64(0x9E3779B9) + np.uint64(1)) & mask)
+        sign = np.uint32((base * np.uint64(0x6C62272E) + np.uint64(2)) & mask)
+        return rot, sign
+
+    def _rotations(self) -> np.ndarray:
+        """(r, m) rotations in [0, c) — computed host-side in numpy so
+        the rolls below get *static* shifts (XLA lowers them to plain
+        slice+concat instead of dynamic-slice chains)."""
+        rot_seed, _ = self._seeds()
+        rows = np.arange(self.r, dtype=np.uint32)[:, None]
+        chunks = np.arange(self._m, dtype=np.uint32)[None, :]
+        with np.errstate(over="ignore"):
+            h = _np_mix(rows * np.uint32(0x7FEB352D)
+                        ^ chunks * np.uint32(0x846CA68B)
+                        ^ rot_seed)
+        return (h % np.uint32(self.c)).astype(np.int64)
+
+    def _signs_row(self, row: int | jax.Array) -> jax.Array:
+        """(padded_d,) float32 signs for one row."""
+        _, sign_seed = self._seeds()
+        idx = jnp.arange(self._padded_d, dtype=jnp.uint32)
+        h = _mix(idx ^ (jnp.uint32(row) * jnp.uint32(0x9E3779B9))
+                 ^ sign_seed)
+        return 1.0 - 2.0 * ((h >> 16) & 1).astype(jnp.float32)
 
     def hashes(self, idx: jax.Array):
-        """(buckets, signs) for int32 coordinate indices ``idx``:
-        buckets uint32 (r, n) in [0, c); signs float32 (r, n) in {±1}."""
-        bucket_seed, sign_seed = self._row_seeds()
-        x = idx.astype(jnp.uint32)[None, :]
-        b = _mix(x ^ bucket_seed[:, None]) % jnp.uint32(self.c)
-        s = 1.0 - 2.0 * ((_mix(x ^ sign_seed[:, None]) >> 16) & 1).astype(
-            jnp.float32)
-        return b, s
+        """(buckets, signs) for int32 coordinate indices: buckets
+        uint32 (r, n) in [0, c); signs float32 (r, n) in {±1}."""
+        rot = jnp.asarray(self._rotations(), jnp.uint32)
+        _, sign_seed = self._seeds()
+        i = idx.astype(jnp.uint32)[None, :]
+        t = (i // jnp.uint32(self.c)).astype(jnp.int32)
+        j = i % jnp.uint32(self.c)
+        rows = jnp.arange(self.r, dtype=jnp.uint32)[:, None]
+        buckets = (j + jnp.take_along_axis(
+            jnp.broadcast_to(rot, (self.r, self._m)), t, axis=1)) \
+            % jnp.uint32(self.c)
+        h = _mix(i ^ (rows * jnp.uint32(0x9E3779B9)) ^ sign_seed)
+        signs = 1.0 - 2.0 * ((h >> 16) & 1).astype(jnp.float32)
+        return buckets, signs
 
     # --- sketching (accumulateVec) --------------------------------------
 
     def sketch(self, v: jax.Array) -> jax.Array:
-        """Dense (d,) vector -> (r, c) sketch table.
-
-        Blocked scatter-add: scan over coordinate blocks; within a
-        block, each row's signed values are summed into a flattened
-        (r·c,) table with one scatter-add.
-        """
+        """Dense (d,) vector -> (r, c) sketch table, scatter-free."""
         assert v.shape == (self.d,), v.shape
-        block, nblocks = self._block, max(self.num_blocks, 1)
-        v = jnp.pad(v.astype(jnp.float32), (0, self._padded_d - self.d))
-        vb = v.reshape(nblocks, block)
-        offs = jnp.arange(nblocks, dtype=jnp.int32) * block
-        row_base = jnp.arange(self.r, dtype=jnp.uint32)[:, None] * jnp.uint32(self.c)
+        m, c = self._m, self.c
+        vp = jnp.pad(v.astype(jnp.float32), (0, self._padded_d - self.d))
+        rot = self._rotations()  # host constants -> static rolls
 
-        def body(table, inp):
-            off, vals = inp
-            idx = off + jnp.arange(block, dtype=jnp.int32)
-            buckets, signs = self.hashes(idx)
-            flat_idx = (row_base + buckets).reshape(-1)
-            contrib = (signs * vals[None, :]).reshape(-1)
-            table = table.at[flat_idx].add(contrib, mode="promise_in_bounds")
-            return table, None
+        if m <= _UNROLL_LIMIT:
+            rows = []
+            for row in range(self.r):
+                signed = (vp * self._signs_row(row)).reshape(m, c)
+                rolled = jnp.stack([
+                    jnp.roll(signed[t], int(rot[row, t]))
+                    for t in range(m)])
+                rows.append(jnp.sum(rolled, axis=0))
+            return jnp.stack(rows)
 
-        table, _ = jax.lax.scan(
-            body, jnp.zeros(self.r * self.c, jnp.float32), (offs, vb))
-        return table.reshape(self.r, self.c)
+        # many-chunk regime (small c): scan over chunks with dynamic
+        # rolls to keep the emitted program constant-size
+        rot_dev = jnp.asarray(rot, jnp.int32)
+
+        def one_row(row, rots):
+            signed = (vp * self._signs_row(row)).reshape(m, c)
+
+            def body(acc, inp):
+                chunk, o = inp
+                return acc + jnp.roll(chunk, o), None
+
+            out, _ = jax.lax.scan(body, jnp.zeros(c, jnp.float32),
+                                  (signed, rots))
+            return out
+
+        return jax.vmap(one_row)(jnp.arange(self.r, dtype=jnp.uint32),
+                                 rot_dev)
 
     # --- recovery --------------------------------------------------------
 
-    def _estimate_block(self, table: jax.Array, idx: jax.Array) -> jax.Array:
-        """Median-of-rows estimates for coordinate indices ``idx``."""
-        buckets, signs = self.hashes(idx)
-        ests = signs * table[jnp.arange(self.r)[:, None],
-                             buckets.astype(jnp.int32)]
-        return jnp.median(ests, axis=0)
-
     def estimates(self, table: jax.Array) -> jax.Array:
-        """All-coordinate estimates (d,). O(r·d) memory — use only for
-        small d (tests); ``unsketch`` streams instead."""
-        return self._estimate_block(
-            table, jnp.arange(self.d, dtype=jnp.int32))
+        """Median-of-rows estimates for all d coordinates — gather-free
+        (per-chunk inverse rolls of the table rows). Materialises
+        (r, padded_d): fine up to tens of millions of coords."""
+        assert table.shape == (self.r, self.c), table.shape
+        m, c = self._m, self.c
+        rot = self._rotations()
+
+        if m <= _UNROLL_LIMIT:
+            ests = []
+            for row in range(self.r):
+                unrolled = jnp.stack([
+                    jnp.roll(table[row], -int(rot[row, t]))
+                    for t in range(m)])  # (m, c): chunk t's table view
+                ests.append(unrolled.reshape(-1) * self._signs_row(row))
+            return jnp.median(jnp.stack(ests), axis=0)[: self.d]
+
+        rot_dev = jnp.asarray(rot, jnp.int32)
+
+        def one_row(row, trow, rots):
+            unrolled = jax.lax.map(lambda o: jnp.roll(trow, -o), rots)
+            return unrolled.reshape(-1) * self._signs_row(row)
+
+        ests = jax.vmap(one_row)(jnp.arange(self.r, dtype=jnp.uint32),
+                                 table, rot_dev)
+        return jnp.median(ests, axis=0)[: self.d]
 
     @partial(jax.jit, static_argnums=(0, 2))
     def unsketch(self, table: jax.Array, k: int) -> jax.Array:
-        """(r, c) table -> dense (d,) vector containing only the k
+        """(r, c) table -> dense (d,) vector keeping only the k
         largest-magnitude estimated coordinates (reference
-        ``CSVec.unSketch(k)``; server use at fed_aggregator.py:592).
-
-        Streams blocks, carrying a running top-k: per block, merge the
-        block's estimates with the carry and re-select top-k, so peak
-        memory is O(k + block) instead of O(d).
-        """
-        assert table.shape == (self.r, self.c), table.shape
+        ``CSVec.unSketch(k)``; server use at fed_aggregator.py:592)."""
         k = min(k, self.d)
-        block, nblocks = self._block, max(self.num_blocks, 1)
-        offs = jnp.arange(nblocks, dtype=jnp.int32) * block
-
-        def body(carry, off):
-            top_vals, top_idx = carry
-            idx = off + jnp.arange(block, dtype=jnp.int32)
-            est = self._estimate_block(table, idx)
-            # padded coords (>= d) must never win
-            est = jnp.where(idx < self.d, est, 0.0)
-            cand_vals = jnp.concatenate([top_vals, est])
-            cand_idx = jnp.concatenate([top_idx, idx])
-            _, sel = jax.lax.top_k(jax.lax.square(cand_vals), k)
-            return (cand_vals[sel], cand_idx[sel]), None
-
-        init = (jnp.zeros(k, jnp.float32),
-                jnp.full(k, self.d, dtype=jnp.int32))  # sentinel idx
-        (top_vals, top_idx), _ = jax.lax.scan(body, init, offs)
-
-        out = jnp.zeros(self.d + 1, jnp.float32)  # slot d absorbs sentinels
-        out = out.at[top_idx].set(top_vals, mode="promise_in_bounds")
-        return out[: self.d]
+        est = self.estimates(table)
+        _, idx = jax.lax.top_k(jax.lax.square(est), k)
+        return jnp.zeros(self.d, jnp.float32).at[idx].set(
+            est[idx], mode="promise_in_bounds")
 
     # --- norms -----------------------------------------------------------
 

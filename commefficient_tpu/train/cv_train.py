@@ -1,0 +1,238 @@
+"""CV experiment driver — counterpart of reference cv_train.py.
+
+Same CLI, same round loop structure (LR scheduler stepped *before*
+the round, the LR==0 "HACK STEP" alignment quirk, NaN abort, fractional
+epochs, byte-accounting totals, TableLogger rows), driving the SPMD
+runtime instead of a process fleet.
+
+Run e.g.:
+    python -m commefficient_tpu.train.cv_train --dataset_name Synthetic \
+        --mode sketch --error_type virtual --local_momentum 0 \
+        --num_clients 10 --num_workers 2 --num_epochs 2
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import (Config, num_classes_of_dataset,
+                                      parse_args)
+from commefficient_tpu.data import (FedLoader, FedSampler, ValLoader,
+                                    get_dataset_cls)
+from commefficient_tpu.data import transforms as T
+from commefficient_tpu.models import get_model
+from commefficient_tpu.runtime import FedModel, FedOptimizer, LambdaLR
+from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
+                                     TSVLogger, Timer, steps_per_epoch)
+
+
+def masked_mean(values, mask):
+    return jnp.sum(values * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_compute_loss(module, init_stats=None):
+    """CE loss + accuracy (reference compute_loss_ce,
+    cv_train.py:32-50), masked-mean over real samples."""
+
+    def compute_loss(params, batch, args):
+        variables = {"params": params}
+        if init_stats is not None:
+            variables["batch_stats"] = init_stats
+            logits, _ = module.apply(variables, batch["x"],
+                                     mutable=["batch_stats"])
+        else:
+            logits = module.apply(variables, batch["x"])
+        labels = batch["y"]
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[..., None],
+                                   axis=-1)[..., 0]
+        loss = masked_mean(nll, batch["mask"])
+        acc = masked_mean(
+            (jnp.argmax(logits, -1) == labels).astype(jnp.float32),
+            batch["mask"])
+        return loss, (acc,)
+
+    return compute_loss
+
+
+def run_batches(model, opt, lr_scheduler, loader, args, training,
+                logger=None, epoch_fraction=1.0):
+    """(reference cv_train.py:171-252)"""
+    if training:
+        model.train(True)
+        losses, accs = [], []
+        download_total = np.zeros(model.num_clients)
+        upload_total = np.zeros(model.num_clients)
+        spe = len(loader)
+        max_batches = max(1, int(spe * epoch_fraction))
+        for i, batch in enumerate(loader):
+            if i >= max_batches:
+                break
+            lr_scheduler.step()
+            if opt.param_groups[0]["lr"] == 0:
+                # "HACK STEP": keep FedAvg's schedule aligned when the
+                # triangular LR hits 0 (reference cv_train.py:198-203)
+                opt.param_groups[0]["lr"] = 1e-10
+            metrics = model(batch)
+            opt.step()
+            loss, acc, download, upload = (metrics[0], metrics[1],
+                                           metrics[-2], metrics[-1])
+            download_total += download
+            upload_total += upload
+            losses.append(float(np.mean(loss)))
+            accs.append(float(np.mean(acc)))
+            if not math.isfinite(losses[-1]) or \
+                    losses[-1] > args.nan_threshold:
+                print(f"Stopping at batch {i}: diverged "
+                      f"(loss {losses[-1]})")
+                return None
+            if args.do_test and i >= 0:
+                break
+        return (np.mean(losses), np.mean(accs),
+                download_total, upload_total)
+    else:
+        model.train(False)
+        losses, accs = [], []
+        for i, batch in enumerate(loader):
+            shard_metrics = model(batch)
+            losses.extend(shard_metrics[0].tolist())
+            accs.extend(shard_metrics[1].tolist())
+            if args.do_test:
+                break
+        return np.mean(losses), np.mean(accs)
+
+
+def train(model, opt, lr_scheduler, train_loader, val_loader, args,
+          logger=None, timer=None):
+    """Epoch loop (reference cv_train.py:85-168)."""
+    timer = timer or Timer()
+    logger = logger or TableLogger()
+    tsv = TSVLogger()
+    results = []
+    num_epochs = args.num_epochs
+    for epoch in range(math.ceil(num_epochs)):
+        epoch_fraction = min(1.0, num_epochs - epoch)
+        out = run_batches(model, opt, lr_scheduler, train_loader, args,
+                          training=True, epoch_fraction=epoch_fraction)
+        if out is None:
+            print("NaN detected, aborting training")
+            return results
+        train_loss, train_acc, download, upload = out
+        train_time = timer()
+        val_loss, val_acc = run_batches(model, opt, lr_scheduler,
+                                        val_loader, args,
+                                        training=False)
+        val_time = timer()
+        row = {
+            "epoch": epoch + 1,
+            "lr": float(opt.param_groups[0]["lr"]),
+            "train_time": train_time,
+            "train_loss": float(train_loss),
+            "train_acc": float(train_acc),
+            "test_time": val_time,
+            "test_loss": float(val_loss),
+            "test_acc": float(val_acc),
+            "down (MiB)": float(download.sum() / (1024 * 1024)),
+            "up (MiB)": float(upload.sum() / (1024 * 1024)),
+            "total_time": timer.total_time,
+        }
+        logger.append(row)
+        tsv.append(row)
+        results.append(row)
+    return results
+
+
+def get_data_loaders(args: Config):
+    """(reference cv_train.py:254-287)"""
+    name = args.dataset_name
+    train_t, val_t = None, None
+    if name in ("CIFAR10", "CIFAR100"):
+        mean = T.CIFAR10_MEAN if name == "CIFAR10" else T.CIFAR100_MEAN
+        std = T.CIFAR10_STD if name == "CIFAR10" else T.CIFAR100_STD
+        train_t = T.cifar_train_transform(mean, std)
+        val_t = T.cifar_val_transform(mean, std)
+
+    cls = get_dataset_cls(name)
+    common = dict(do_iid=args.do_iid, num_clients=args.num_clients,
+                  seed=args.seed)
+    train_ds = cls(args.dataset_dir, name, transform=train_t,
+                   train=True, **common)
+    val_ds = cls(args.dataset_dir, name, transform=val_t, train=False,
+                 **common)
+    sampler = FedSampler(train_ds, args.num_workers,
+                         args.local_batch_size,
+                         seed=args.seed)
+    train_loader = FedLoader(train_ds, sampler)
+    val_loader = ValLoader(val_ds, args.valid_batch_size,
+                           shards_per_step=max(1, args.num_workers))
+    return train_loader, val_loader, train_ds
+
+
+def build_model(args: Config, rng=None):
+    num_classes = num_classes_of_dataset(args.dataset_name)
+    model_cls = get_model(args.model)
+    kw = dict(num_classes=num_classes)
+    if args.model == "ResNet9":
+        kw["do_batchnorm"] = args.do_batchnorm
+        if args.do_test:
+            kw.update(model_cls.test_config(num_classes))
+    module = model_cls(**kw)
+    rng = rng if rng is not None else jax.random.PRNGKey(args.seed)
+    sample_shape = (1, 32, 32, 3)
+    variables = module.init(rng, jnp.zeros(sample_shape), train=True)
+    params = variables["params"]
+    init_stats = variables.get("batch_stats")
+    return module, params, init_stats
+
+
+def main(argv=None):
+    args = parse_args(default_lr=0.4, argv=argv)
+    np.random.seed(args.seed)
+
+    if args.do_test:
+        # tiny sketch like the reference smoke mode (cv_train.py:329-336)
+        args.k = 10
+        args.num_cols = 10
+        args.num_rows = 1
+        args.num_blocks = 1
+
+    train_loader, val_loader, train_ds = get_data_loaders(args)
+    if args.num_clients is None:
+        args.num_clients = int(train_ds.num_clients)
+
+    module, params, init_stats = build_model(args)
+    compute_loss = make_compute_loss(module, init_stats)
+
+    model = FedModel(module, params, compute_loss, args,
+                     padded_batch_size=train_loader.B)
+    opt = FedOptimizer([{"lr": 1.0}], args)
+
+    spe = steps_per_epoch(args.local_batch_size, train_ds,
+                          args.num_workers)
+    lambda_step = PiecewiseLinear(
+        [0, args.pivot_epoch * spe, args.num_epochs * spe],
+        [0, args.lr_scale, 0])
+    lr_scheduler = LambdaLR(opt, lambda x: lambda_step(x))
+
+    results = train(model, opt, lr_scheduler, train_loader, val_loader,
+                    args)
+    model.finalize()
+
+    if args.do_checkpoint:
+        import os
+        import pickle
+        os.makedirs(args.checkpoint_path, exist_ok=True)
+        path = os.path.join(args.checkpoint_path, args.model + ".pkl")
+        with open(path, "wb") as f:
+            pickle.dump(jax.device_get(model.params()), f)
+        print(f"saved checkpoint to {path}")
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -268,6 +268,26 @@ class TestModeLattice:
         np.testing.assert_allclose(got[-1], want[-1], rtol=1e-4,
                                    atol=1e-5)
 
+    def test_dp_server_noise_persists_in_momentum(self):
+        """Reference aliasing (fed_aggregator.py:506-510): server-mode
+        DP noise lands in Vvelocity and persists across rounds."""
+        import dataclasses as dc
+        import jax
+        from commefficient_tpu.core.server import (ServerState,
+                                                   server_update)
+        cfg = dc.replace(make_cfg(do_dp=True, dp_mode="server",
+                                  noise_multiplier=0.5,
+                                  virtual_momentum=0.9), grad_size=8)
+        state = ServerState.init(cfg)
+        g = jnp.ones(8)
+        res = server_update(cfg, g, state, 1.0,
+                            noise_rng=jax.random.PRNGKey(0))
+        # Vvelocity must include the noise (not just the update)
+        assert not np.allclose(np.asarray(res.state.Vvelocity),
+                               np.ones(8))
+        np.testing.assert_allclose(np.asarray(res.weight_update),
+                                   np.asarray(res.state.Vvelocity))
+
     def test_microbatched_grad_accumulation(self):
         """Sum-of-microbatch-mean-gradients semantics
         (fed_worker.py:268-289)."""

@@ -1,0 +1,51 @@
+"""End-to-end CV trainer tests on synthetic data (fast, tiny model)."""
+
+import numpy as np
+
+from commefficient_tpu.train import cv_train
+
+
+class TestCvTrainSmoke:
+    def test_smoke_sketch_mode(self):
+        """--test smoke: tiny model, tiny sketch, 1 round per epoch
+        (the reference's de-facto integration test, SURVEY.md §4)."""
+        results = cv_train.main([
+            "--test", "--dataset_name", "Synthetic",
+            "--mode", "sketch", "--error_type", "virtual",
+            "--local_momentum", "0",
+            "--num_clients", "10", "--num_workers", "2",
+            "--local_batch_size", "4", "--num_epochs", "2",
+            "--lr_scale", "0.1", "--pivot_epoch", "1",
+        ])
+        assert len(results) == 2
+        assert np.isfinite(results[-1]["train_loss"])
+        assert np.isfinite(results[-1]["test_acc"])
+        assert results[-1]["up (MiB)"] > 0
+
+    def test_smoke_fedavg(self):
+        results = cv_train.main([
+            "--test", "--dataset_name", "Synthetic",
+            "--mode", "fedavg", "--local_momentum", "0",
+            "--local_batch_size", "-1", "--fedavg_batch_size", "4",
+            "--num_clients", "10", "--num_workers", "2",
+            "--num_epochs", "1", "--lr_scale", "0.1",
+            "--pivot_epoch", "0.5",
+        ])
+        assert len(results) == 1
+        assert np.isfinite(results[-1]["train_loss"])
+
+    def test_learns_uncompressed(self):
+        """A real (non---test) run on an easy synthetic task must beat
+        chance accuracy within a few epochs."""
+        results = cv_train.main([
+            "--dataset_name", "Synthetic",
+            "--mode", "uncompressed", "--error_type", "none",
+            "--local_momentum", "0", "--virtual_momentum", "0.9",
+            "--num_clients", "10", "--num_workers", "2",
+            "--local_batch_size", "8", "--num_epochs", "3",
+            "--lr_scale", "1.0", "--pivot_epoch", "1",
+            "--model", "ResNet9", "--test",
+        ])
+        # --test shrinks the model; blobs are separable, so even the
+        # 1-channel net should move off chance by the last epoch
+        assert results[-1]["train_loss"] < results[0]["train_loss"] + 0.5
