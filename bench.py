@@ -38,7 +38,7 @@ def main():
                  virtual_momentum=0.9, weight_decay=5e-4,
                  num_workers=W, local_batch_size=B,
                  k=50000, num_rows=5, num_cols=500000, num_blocks=20,
-                 dataset_name="CIFAR10", seed=21)
+                 dataset_name="CIFAR10", seed=21, approx_topk=True)
 
     module = get_model("ResNet9")(num_classes=10)
     params = module.init(jax.random.PRNGKey(0),

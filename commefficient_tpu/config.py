@@ -117,6 +117,7 @@ class Config:
     mesh_shape: Optional[Sequence[int]] = None  # default: all local devices
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # set bfloat16 for MXU throughput
+    approx_topk: bool = False  # lax.approx_max_k in unsketch (faster)
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -293,6 +294,7 @@ def build_parser(default_lr: Optional[float] = None,
     # TPU-native additions
     parser.add_argument("--param_dtype", type=str, default="float32")
     parser.add_argument("--compute_dtype", type=str, default="float32")
+    parser.add_argument("--approx_topk", action="store_true")
 
     return parser
 

@@ -47,7 +47,12 @@ class FedDataset:
 
     @property
     def data_per_client(self):
-        """(reference fed_dataset.py:31-48)"""
+        """(reference fed_dataset.py:31-48); cached — immutable after
+        _load_meta, and the sampler/__getitem__ hot paths consult it
+        per item."""
+        cached = getattr(self, "_dpc_cache", None)
+        if cached is not None:
+            return cached
         if self.do_iid:
             num_data = len(self)
             ipc = (np.ones(self.num_clients, dtype=int)
@@ -55,21 +60,28 @@ class FedDataset:
             extra = num_data % self.num_clients
             if extra:
                 ipc[self.num_clients - extra:] += 1
-            return ipc
-        if (self._num_clients is not None
-                and self._num_clients < len(self.images_per_client)):
-            raise ValueError(
-                f"non-iid needs num_clients >= "
-                f"{len(self.images_per_client)} natural partitions "
-                f"(got {self._num_clients}); pass --iid to re-split")
-        new_ipc = []
-        for num_images in self.images_per_client:
-            n_per_class = self._num_clients // len(self.images_per_client)
-            extra = num_images % n_per_class
-            split = [num_images // n_per_class for _ in range(n_per_class)]
-            split[-1] += extra
-            new_ipc.extend(split)
-        return np.array(new_ipc)
+        elif self._num_clients is None:
+            # natural partition: one client per natural unit
+            ipc = np.asarray(self.images_per_client)
+        else:
+            if self._num_clients < len(self.images_per_client):
+                raise ValueError(
+                    f"non-iid needs num_clients >= "
+                    f"{len(self.images_per_client)} natural partitions "
+                    f"(got {self._num_clients}); pass --iid to re-split")
+            new_ipc = []
+            n_natural = len(self.images_per_client)
+            for num_images in self.images_per_client:
+                n_per_class = self._num_clients // n_natural
+                extra = num_images % n_per_class
+                split = [num_images // n_per_class
+                         for _ in range(n_per_class)]
+                split[-1] += extra
+                new_ipc.extend(split)
+            ipc = np.array(new_ipc)
+        self._dpc_cache = ipc
+        self._dpc_cumsum = np.cumsum(ipc)
+        return ipc
 
     @property
     def num_clients(self):
@@ -82,6 +94,14 @@ class FedDataset:
             self.images_per_client = np.array(stats["images_per_client"])
             self.num_val_images = stats["num_val_images"]
 
+    @property
+    def _ipc_cumsum(self):
+        cached = getattr(self, "_ipc_cumsum_cache", None)
+        if cached is None:
+            cached = np.cumsum(self.images_per_client)
+            self._ipc_cumsum_cache = cached
+        return cached
+
     def __len__(self):
         if self.type == "train":
             return int(sum(self.images_per_client))
@@ -92,16 +112,16 @@ class FedDataset:
             orig_idx = idx
             if self.do_iid:
                 idx = self.iid_shuffle[idx]
-            cumsum = np.cumsum(self.images_per_client)
+            cumsum = self._ipc_cumsum
             natural_client = np.searchsorted(cumsum, idx, side="right")
-            cumsum = np.hstack([[0], cumsum[:-1]])
-            idx_within = idx - cumsum[natural_client]
+            start = cumsum[natural_client - 1] if natural_client else 0
+            idx_within = idx - start
             image, target = self._get_train_item(natural_client,
                                                  idx_within)
             # the *reported* client id comes from data_per_client over
             # the original index (fed_dataset.py:84-85)
-            cumsum = np.cumsum(self.data_per_client)
-            client_id = int(np.searchsorted(cumsum, orig_idx,
+            self.data_per_client  # ensure _dpc_cumsum
+            client_id = int(np.searchsorted(self._dpc_cumsum, orig_idx,
                                             side="right"))
         else:
             image, target = self._get_val_item(idx)

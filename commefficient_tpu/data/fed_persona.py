@@ -1,0 +1,341 @@
+"""Federated PersonaChat: client = distinct personality.
+
+Counterpart of reference data_utils/fed_persona.py. Same on-disk
+layout (per-client ``client{i}.json`` + ``validation.json`` +
+``stats.json`` split from the personachat archive), same item
+semantics:
+
+- an item is one utterance: ``num_candidates`` candidate sequences
+  (gold last), built as
+  ``[bos persona] [<speaker1/2> turn]... [<speaker2> reply eos]``
+  with speaker-alternating token types, LM labels only on the gold
+  reply, mc_token_id at the last position, mc_label = gold index
+  (fed_persona.py:330-358);
+- history truncated to ``2*max_history + 1`` turns;
+- ``personality_permutations`` random persona shufflings per item.
+
+Differences by design: no S3 download (zero-egress environment — place
+``personachat_self_original.json`` in the dataset dir, or use
+``generate_synthetic_personachat`` for offline runs), and the collate
+pads to a **static** ``max_seq_len`` so the jitted round never
+recompiles on batch shape (the reference pads per-batch,
+fed_persona.py:360-392 — a dynamic shape the TPU runtime must avoid).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from collections import defaultdict
+from itertools import chain
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.data.tokenizer import SPECIAL_TOKENS
+
+__all__ = ["FedPERSONA", "persona_collate",
+           "generate_synthetic_personachat"]
+
+MODEL_INPUTS = ["input_ids", "mc_token_ids", "lm_labels", "mc_labels",
+                "token_type_ids"]
+
+RAW_NAME = "personachat_self_original.json"
+
+
+class FedPERSONA(FedDataset):
+    def __init__(self, tokenizer, num_candidates, max_history,
+                 personality_permutations, *args, **kwargs):
+        self.tokenizer = tokenizer
+        self.num_candidates = num_candidates
+        self.max_history = max_history
+        self.personality_permutations = personality_permutations
+        super().__init__(*args, **kwargs)
+        if self.type == "val":
+            with open(self.validation_fn()) as f:
+                self.raw_val_set = json.load(f)
+        self._rng = random.Random(kwargs.get("seed", 0))
+        self._client_cache = {}
+
+    # --- partitioning (reference fed_persona.py:46-75) -------------------
+
+    @property
+    def data_per_client(self):
+        if self.do_iid:
+            n = len(self)
+            upc = (np.ones(self.num_clients, dtype=int) * n
+                   // self.num_clients)
+            extra = n % self.num_clients
+            if extra:
+                upc[self.num_clients - extra:] += 1
+            return upc
+        cumsum = np.hstack([[0], np.cumsum(self.dialogs_per_client)])
+        return np.array([
+            sum(self.train_utterances_per_dialog[s:s + dpc])
+            for s, dpc in zip(cumsum, self.dialogs_per_client)])
+
+    @property
+    def num_clients(self):
+        if self.do_iid:
+            return (self._num_clients if self._num_clients is not None
+                    else len(self.dialogs_per_client))
+        return len(self.dialogs_per_client)
+
+    def _load_meta(self, train):
+        with open(self.stats_fn()) as f:
+            stats = json.load(f)
+        self.dialogs_per_client = stats["dialogs_per_client"]
+        self.train_utterances_per_dialog = \
+            stats["train_utterances_per_dialog"]
+        self.val_utterances_per_dialog = \
+            stats["val_utterances_per_dialog"]
+
+    def __len__(self):
+        if self.type == "train":
+            return int(sum(self.train_utterances_per_dialog))
+        return int(sum(self.val_utterances_per_dialog))
+
+    # --- split (reference fed_persona.py:87-167) -------------------------
+
+    def prepare_datasets(self, download=False):
+        os.makedirs(self.dataset_dir, exist_ok=True)
+        raw_path = os.path.join(self.dataset_dir, RAW_NAME)
+        if not os.path.exists(raw_path):
+            raise FileNotFoundError(
+                f"{raw_path} not found (no download in this "
+                "environment); place the personachat archive there or "
+                "use generate_synthetic_personachat()")
+        with open(raw_path) as f:
+            raw = json.load(f)
+
+        val_set = raw["valid"]
+        val_upd = [len(d["utterances"]) for d in val_set]
+
+        client_datasets = defaultdict(list)
+        for dialog in raw["train"]:
+            client_datasets[tuple(dialog["personality"])].append(dialog)
+
+        personalities = list(client_datasets.keys())
+        dialogs_per_client, train_upd = [], []
+        for p in personalities:
+            dialogs = client_datasets[p]
+            dialogs_per_client.append(len(dialogs))
+            train_upd.extend(len(d["utterances"]) for d in dialogs)
+
+        for cid, p in enumerate(personalities):
+            with open(self.client_fn(cid), "w") as f:
+                json.dump(client_datasets[p], f)
+        with open(self.validation_fn(), "w") as f:
+            json.dump(val_set, f)
+        with open(self.stats_fn(), "w") as f:
+            json.dump({"dialogs_per_client": dialogs_per_client,
+                       "train_utterances_per_dialog": train_upd,
+                       "val_utterances_per_dialog": val_upd}, f)
+
+    # --- items (reference fed_persona.py:180-260) ------------------------
+
+    def __getitem__(self, idx):
+        if self.type == "train":
+            return self._get_train_item_full(idx)
+        return self._get_val_item_full(idx)
+
+    def _get_train_item_full(self, idx):
+        orig_idx = idx
+        if self.do_iid:
+            idx = self.iid_shuffle[idx]
+
+        cumsum = np.cumsum(self.train_utterances_per_dialog)
+        dialog_id = int(np.searchsorted(cumsum, idx, side="right"))
+        cumsum = np.hstack([[0], cumsum[:-1]])
+        idx_within_dialog = int(idx - cumsum[dialog_id])
+
+        cumsum = np.cumsum(self.dialogs_per_client)
+        client_id = int(np.searchsorted(cumsum, dialog_id,
+                                        side="right"))
+        cumsum = np.hstack([[0], cumsum[:-1]])
+        idx_within_client = int(dialog_id - cumsum[client_id])
+
+        dataset = self._load_client(client_id)
+        dialog = dataset[idx_within_client]
+        personality = list(dialog["personality"])
+        utterance = dialog["utterances"][idx_within_dialog]
+
+        model_input = None
+        for _ in range(self.personality_permutations):
+            self._rng.shuffle(personality)
+            model_input = self.utterance_to_input(personality,
+                                                  utterance)
+
+        if self.do_iid:
+            cumsum = np.cumsum(self.data_per_client)
+            client_id = int(np.searchsorted(cumsum, orig_idx,
+                                            side="right"))
+        return (client_id,) + model_input
+
+    def _get_val_item_full(self, idx):
+        cumsum = np.cumsum(self.val_utterances_per_dialog)
+        dialog_id = int(np.searchsorted(cumsum, idx, side="right"))
+        cumsum = np.hstack([[0], cumsum[:-1]])
+        idx_within = int(idx - cumsum[dialog_id])
+        dialog = self.raw_val_set[dialog_id]
+        return (-1,) + self.utterance_to_input(
+            list(dialog["personality"]),
+            dialog["utterances"][idx_within])
+
+    def _load_client(self, client_id):
+        if client_id not in self._client_cache:
+            if len(self._client_cache) > 256:
+                self._client_cache.clear()
+            with open(self.client_fn(client_id)) as f:
+                self._client_cache[client_id] = json.load(f)
+        return self._client_cache[client_id]
+
+    def utterance_to_input(self, personality, utterance):
+        history = utterance["history"][-(2 * self.max_history + 1):]
+        candidates = utterance["candidates"]
+        num_candidates = len(candidates)
+        if self.num_candidates > 0 and self.type == "train":
+            num_candidates = min(self.num_candidates, num_candidates)
+        candidates = candidates[-num_candidates:]
+        return raw_to_input(self.tokenizer, personality, history,
+                            candidates)
+
+    def client_fn(self, client_id):
+        return os.path.join(self.dataset_dir,
+                            f"client{client_id}.json")
+
+    def validation_fn(self):
+        return os.path.join(self.dataset_dir, "validation.json")
+
+
+def tokenize_obj(obj, tokenizer):
+    if isinstance(obj, str):
+        return tokenizer.encode(obj)
+    if isinstance(obj, dict):
+        return {n: tokenize_obj(o, tokenizer) for n, o in obj.items()}
+    return [tokenize_obj(o, tokenizer) for o in obj]
+
+
+def raw_to_input(tokenizer, personality, history, candidates):
+    """strings -> per-candidate model inputs
+    (reference fed_persona.py:283-316)."""
+    personality = tokenize_obj(personality, tokenizer)
+    history = tokenize_obj(history, tokenizer)
+    candidates = tokenize_obj(candidates, tokenizer)
+
+    model_input = defaultdict(list)
+    n = len(candidates)
+    for j, candidate in enumerate(candidates):
+        instance = build_input_from_segments(
+            personality, history, candidate, tokenizer,
+            lm_labels=(j == n - 1))
+        for name, arr in instance.items():
+            model_input[name].append(arr)
+    model_input["mc_labels"] = n - 1
+    return tuple(model_input[name] for name in MODEL_INPUTS)
+
+
+def build_input_from_segments(persona, history, reply, tokenizer,
+                              lm_labels=False, with_eos=True):
+    """(reference fed_persona.py:330-358) — lm label padding is -1."""
+    bos, eos, speaker1, speaker2 = tokenizer.convert_tokens_to_ids(
+        SPECIAL_TOKENS[:-1])
+    instance = {}
+    sequence = [[bos] + list(chain(*persona))] + history
+    sequence += [reply + ([eos] if with_eos else [])]
+    sequence = [sequence[0]] + [
+        [speaker2 if (len(sequence) - i) % 2 == 0 else speaker1] + s
+        for i, s in enumerate(sequence[1:])]
+    instance["input_ids"] = list(chain(*sequence))
+    instance["token_type_ids"] = [speaker2 if i % 2 else speaker1
+                                  for i, s in enumerate(sequence)
+                                  for _ in s]
+    instance["mc_token_ids"] = len(instance["input_ids"]) - 1
+    instance["lm_labels"] = [-1] * len(instance["input_ids"])
+    if lm_labels:
+        instance["lm_labels"] = \
+            [-1] * sum(len(s) for s in sequence[:-1])
+        instance["lm_labels"] += [-1] + sequence[-1][1:]
+    return instance
+
+
+def persona_collate(records, num_candidates, max_seq_len, pad_id=0):
+    """List of (client_id,)+MODEL_INPUTS tuples -> static-shape arrays:
+    input_ids/token_type_ids/lm_labels (B, N, T), mc_token_ids (B, N),
+    mc_labels (B,). Sequences beyond ``max_seq_len`` are truncated
+    from the *front* (keeps the reply + eos, which carry the LM
+    labels); lm_labels pad with -1 (reference pad values,
+    fed_persona.py:379)."""
+    B, N, T = len(records), num_candidates, max_seq_len
+    out = {
+        "input_ids": np.full((B, N, T), pad_id, np.int32),
+        "token_type_ids": np.full((B, N, T), pad_id, np.int32),
+        "lm_labels": np.full((B, N, T), -1, np.int32),
+        "mc_token_ids": np.zeros((B, N), np.int32),
+        "mc_labels": np.zeros((B,), np.int32),
+    }
+    client_ids = np.zeros((B,), np.int32)
+    for b, rec in enumerate(records):
+        cid, input_ids, mc_tok, lm_lab, mc_lab, tt = rec
+        client_ids[b] = cid
+        # if the record has more candidates than N (val items carry all
+        # ~20), keep the LAST N — the gold candidate is always last by
+        # construction (fed_persona.py:305), so the label stays N-1
+        if len(input_ids) > N:
+            input_ids, mc_tok = input_ids[-N:], mc_tok[-N:]
+            lm_lab, tt = lm_lab[-N:], tt[-N:]
+            mc_lab = N - 1
+        out["mc_labels"][b] = mc_lab
+        for j in range(min(N, len(input_ids))):
+            seq = input_ids[j][-T:]
+            ttj = tt[j][-T:]
+            lab = lm_lab[j][-T:]
+            L = len(seq)
+            out["input_ids"][b, j, :L] = seq
+            out["token_type_ids"][b, j, :L] = ttj
+            out["lm_labels"][b, j, :L] = lab
+            out["mc_token_ids"][b, j] = min(mc_tok[j], L - 1)
+    return client_ids, out
+
+
+def generate_synthetic_personachat(path, num_personalities=8,
+                                   dialogs_per_personality=2,
+                                   utterances_per_dialog=3,
+                                   num_candidates=2, seed=0):
+    """Write a tiny synthetic personachat-format archive for offline
+    tests/smoke (same JSON schema as the S3 original)."""
+    rng = random.Random(seed)
+    words = ["i", "like", "cats", "dogs", "music", "food", "sports",
+             "reading", "travel", "coding", "you", "me", "the", "a"]
+
+    def sentence():
+        return " ".join(rng.choice(words)
+                        for _ in range(rng.randint(3, 7)))
+
+    def dialog():
+        utterances = []
+        history = [sentence()]
+        for _ in range(utterances_per_dialog):
+            utterances.append({
+                "history": list(history),
+                "candidates": [sentence()
+                               for _ in range(num_candidates)],
+            })
+            history.append(sentence())
+            history.append(sentence())
+        return utterances
+
+    data = {"train": [], "valid": []}
+    for p in range(num_personalities):
+        personality = [f"persona {p} " + sentence() for _ in range(3)]
+        for _ in range(dialogs_per_personality):
+            data["train"].append({"personality": personality,
+                                  "utterances": dialog()})
+    for _ in range(4):
+        data["valid"].append({
+            "personality": [sentence() for _ in range(3)],
+            "utterances": dialog()})
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, RAW_NAME), "w") as f:
+        json.dump(data, f)

@@ -3,10 +3,14 @@ engine batches.
 
 The reference ships a flat concatenated tensor batch to the server,
 which re-groups rows by client id and queues them to worker processes
-(fed_aggregator.py:214-238). Here the loader itself emits the static
-(W, B, ...) layout the jitted round wants — client axis first, a
-(W, B) mask for ragged clients — so the device never sees a dynamic
+(fed_aggregator.py:214-238). Here the loaders themselves emit the
+static (W, B, ...) layout the jitted round wants — client axis first,
+a (W, B) mask for ragged clients — so the device never sees a dynamic
 shape (SURVEY.md §7).
+
+``_RoundLoaderBase`` holds the shared mechanics (B/W resolution,
+incomplete-round skipping, epoch length); subclasses provide only
+``collate``. Same split for the sharded validation loaders.
 """
 
 from __future__ import annotations
@@ -15,19 +19,17 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-__all__ = ["FedLoader", "ValLoader"]
+__all__ = ["FedLoader", "ValLoader", "PersonaFedLoader",
+           "PersonaValLoader"]
 
 
-class FedLoader:
-    """Iterate federated train rounds.
-
-    Yields dicts: ``client_ids`` (W,) int32, ``x`` (W, B, ...) f32,
-    ``y`` (W, B) i32, ``mask`` (W, B) f32. Rounds with fewer than
+class _RoundLoaderBase:
+    """Iterate federated train rounds. Rounds with fewer than
     ``num_workers`` distinct clients are skipped, matching the
-    reference's run_batches guard (cv_train.py:205-219).
-    """
+    reference's run_batches guard (cv_train.py:205-219)."""
 
-    def __init__(self, dataset, sampler, max_batch_size: Optional[int] = None):
+    def __init__(self, dataset, sampler,
+                 max_batch_size: Optional[int] = None):
         self.dataset = dataset
         self.sampler = sampler
         if max_batch_size is not None:
@@ -45,9 +47,28 @@ class FedLoader:
             yield self.collate(round_spec)
 
     def collate(self, round_spec) -> dict:
+        raise NotImplementedError
+
+    def __len__(self):
+        from commefficient_tpu.utils import steps_per_epoch
+        return steps_per_epoch(self.sampler.local_batch_size,
+                               self.dataset, self.W)
+
+
+class FedLoader(_RoundLoaderBase):
+    """CV rounds: ``client_ids`` (W,), ``x`` (W, B, ...) f32, ``y``
+    (W, B) i32, ``mask`` (W, B) f32."""
+
+    _img_shape = None
+
+    def _probe_shape(self, idx):
+        if self._img_shape is None:
+            self._img_shape = np.asarray(self.dataset[int(idx)][1]).shape
+        return self._img_shape
+
+    def collate(self, round_spec) -> dict:
         W, B = self.W, self.B
-        first = self.dataset[int(round_spec[0][1][0])]
-        img_shape = np.asarray(first[1]).shape
+        img_shape = self._probe_shape(round_spec[0][1][0])
         x = np.zeros((W, B) + img_shape, np.float32)
         y = np.zeros((W, B), np.int32)
         mask = np.zeros((W, B), np.float32)
@@ -62,17 +83,50 @@ class FedLoader:
                 mask[i, j] = 1.0
         return {"client_ids": ids, "x": x, "y": y, "mask": mask}
 
-    def __len__(self):
-        from commefficient_tpu.utils import steps_per_epoch
-        return steps_per_epoch(self.sampler.local_batch_size,
-                               self.dataset, self.W)
+
+class PersonaFedLoader(_RoundLoaderBase):
+    """PersonaChat rounds: adds the double-heads arrays
+    input_ids/token_type_ids/lm_labels (W, B, N, T), mc_token_ids
+    (W, B, N), mc_labels (W, B)."""
+
+    def __init__(self, dataset, sampler, num_candidates: int,
+                 max_seq_len: int, pad_id: int = 0,
+                 max_batch_size: Optional[int] = None):
+        super().__init__(dataset, sampler, max_batch_size)
+        self.N, self.T, self.pad_id = num_candidates, max_seq_len, pad_id
+
+    def collate(self, round_spec) -> dict:
+        from commefficient_tpu.data.fed_persona import persona_collate
+        W, B, N, T = self.W, self.B, self.N, self.T
+        batch = {
+            "input_ids": np.zeros((W, B, N, T), np.int32),
+            "token_type_ids": np.zeros((W, B, N, T), np.int32),
+            "lm_labels": np.full((W, B, N, T), -1, np.int32),
+            "mc_token_ids": np.zeros((W, B, N), np.int32),
+            "mc_labels": np.zeros((W, B), np.int32),
+            "mask": np.zeros((W, B), np.float32),
+        }
+        ids = np.zeros((W,), np.int32)
+        for i, (cid, idxs) in enumerate(round_spec):
+            ids[i] = cid
+            records = [self.dataset[int(ix)] for ix in idxs[:self.B]]
+            assert all(r[0] == cid for r in records)
+            _, arrs = persona_collate(records, N, T, self.pad_id)
+            n = len(records)
+            for k in ("input_ids", "token_type_ids", "lm_labels",
+                      "mc_token_ids", "mc_labels"):
+                batch[k][i, :n] = arrs[k]
+            batch["mask"][i, :n] = 1.0
+        batch["client_ids"] = ids
+        return batch
 
 
-class ValLoader:
-    """Validation shards: yields (S, B, ...) stacked shards of
+class _ShardedValBase:
+    """Validation shards: (S, B, ...) stacked shards of
     ``valid_batch_size`` each — the reference's _call_val splitting
-    (fed_aggregator.py:339-350) without the queue plumbing. The final
-    partial shard is padded and masked."""
+    (fed_aggregator.py:339-350) without the queue plumbing. Final
+    partial/empty shards are padded and masked; consumers weight
+    per-shard metrics by the mask counts the runtime returns."""
 
     def __init__(self, dataset, valid_batch_size: int,
                  shards_per_step: int = 8):
@@ -80,14 +134,25 @@ class ValLoader:
         self.B = valid_batch_size
         self.S = shards_per_step
 
-    def __iter__(self):
+    def _shard_indices(self):
         n = len(self.dataset)
         step = self.B * self.S
         for start in range(0, n, step):
-            idxs = np.arange(start, min(start + step, n))
-            first = self.dataset[0]
-            img_shape = np.asarray(first[1]).shape
-            x = np.zeros((self.S, self.B) + img_shape, np.float32)
+            yield np.arange(start, min(start + step, n))
+
+    def __len__(self):
+        return int(np.ceil(len(self.dataset) / (self.B * self.S)))
+
+
+class ValLoader(_ShardedValBase):
+    _img_shape = None
+
+    def __iter__(self):
+        for idxs in self._shard_indices():
+            if self._img_shape is None:
+                self._img_shape = np.asarray(
+                    self.dataset[int(idxs[0])][1]).shape
+            x = np.zeros((self.S, self.B) + self._img_shape, np.float32)
             y = np.zeros((self.S, self.B), np.int32)
             mask = np.zeros((self.S, self.B), np.float32)
             for pos, idx in enumerate(idxs):
@@ -98,5 +163,35 @@ class ValLoader:
                 mask[s, j] = 1.0
             yield {"x": x, "y": y, "mask": mask}
 
-    def __len__(self):
-        return int(np.ceil(len(self.dataset) / (self.B * self.S)))
+
+class PersonaValLoader(_ShardedValBase):
+    def __init__(self, dataset, valid_batch_size: int,
+                 num_candidates: int, max_seq_len: int,
+                 pad_id: int = 0, shards_per_step: int = 8):
+        super().__init__(dataset, valid_batch_size, shards_per_step)
+        self.N, self.T, self.pad_id = num_candidates, max_seq_len, pad_id
+
+    def __iter__(self):
+        from commefficient_tpu.data.fed_persona import persona_collate
+        for idxs in self._shard_indices():
+            batch = {
+                "input_ids": np.zeros((self.S, self.B, self.N, self.T),
+                                      np.int32),
+                "token_type_ids": np.zeros(
+                    (self.S, self.B, self.N, self.T), np.int32),
+                "lm_labels": np.full((self.S, self.B, self.N, self.T),
+                                     -1, np.int32),
+                "mc_token_ids": np.zeros((self.S, self.B, self.N),
+                                         np.int32),
+                "mc_labels": np.zeros((self.S, self.B), np.int32),
+                "mask": np.zeros((self.S, self.B), np.float32),
+            }
+            for pos, ix in enumerate(idxs):
+                s, j = divmod(pos, self.B)
+                _, arrs = persona_collate([self.dataset[int(ix)]],
+                                          self.N, self.T, self.pad_id)
+                for k in ("input_ids", "token_type_ids", "lm_labels",
+                          "mc_token_ids", "mc_labels"):
+                    batch[k][s, j] = arrs[k][0]
+                batch["mask"][s, j] = 1.0
+            yield batch

@@ -1,0 +1,220 @@
+"""GPT-2 with double heads (LM + multiple-choice), in flax.
+
+The reference imports ``GPT2DoubleHeadsModel`` from pytorch_transformers
+(gpt2_train.py:4-6, 262-273); here the transformer is in-tree and
+TPU-shaped:
+
+- causal attention via a single fused qkv projection feeding
+  ``jax.nn.dot_product_attention`` (lowered to a fused TPU kernel);
+- weight-tied LM head (logits = h @ wte.T), like GPT-2;
+- MC head: take the hidden state at ``mc_token_ids`` per candidate,
+  project to a scalar (the pytorch_transformers SequenceSummary with
+  cls_index behavior);
+- all shapes static; works under vmap over federated clients.
+
+Double-heads batch layout (matching the reference collate,
+fed_persona.py:360-392): input_ids / token_type_ids / lm_labels are
+(B, num_candidates, T), mc_token_ids (B, num_candidates),
+mc_labels (B,).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.models import register_model
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+
+    @staticmethod
+    def tiny() -> "GPT2Config":
+        """Test-scale config (the moral equivalent of --test's model
+        shrink, cv_train.py:329-336)."""
+        return GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                          n_layer=2, n_head=2)
+
+
+def _dense_init(cfg):
+    return nn.initializers.normal(stddev=cfg.initializer_range)
+
+
+class MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(4 * self.cfg.n_embd,
+                     kernel_init=_dense_init(self.cfg), name="c_fc")(x)
+        h = jax.nn.gelu(h, approximate=True)
+        return nn.Dense(self.cfg.n_embd,
+                        kernel_init=_dense_init(self.cfg),
+                        name="c_proj")(h)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None):
+        B, T, C = x.shape
+        H = self.cfg.n_head
+        qkv = nn.Dense(3 * C, kernel_init=_dense_init(self.cfg),
+                       name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, C // H)
+        k = k.reshape(B, T, H, C // H)
+        v = v.reshape(B, T, H, C // H)
+        out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        out = out.reshape(B, T, C)
+        return nn.Dense(C, kernel_init=_dense_init(self.cfg),
+                        name="c_proj")(out)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        eps = self.cfg.layer_norm_epsilon
+        x = x + CausalSelfAttention(self.cfg, name="attn")(
+            nn.LayerNorm(epsilon=eps, name="ln_1")(x))
+        x = x + MLP(self.cfg, name="mlp")(
+            nn.LayerNorm(epsilon=eps, name="ln_2")(x))
+        return x
+
+
+class GPT2Transformer(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.cfg
+        B, T = input_ids.shape
+        wte = self.param("wte", _dense_init(cfg),
+                         (cfg.vocab_size, cfg.n_embd))
+        wpe = self.param("wpe", _dense_init(cfg),
+                         (cfg.n_positions, cfg.n_embd))
+        h = wte[input_ids] + wpe[jnp.arange(T)][None]
+        if token_type_ids is not None:
+            # token types index the same embedding table, GPT-2 style
+            h = h + wte[token_type_ids]
+        for i in range(cfg.n_layer):
+            h = Block(cfg, name=f"h_{i}")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(h)
+        return h, wte
+
+
+@register_model("GPT2DoubleHeads")
+class GPT2DoubleHeads(nn.Module):
+    """LM logits + per-candidate MC logits."""
+    cfg: GPT2Config = GPT2Config()
+
+    @nn.compact
+    def __call__(self, input_ids, mc_token_ids, token_type_ids=None):
+        # flatten candidates into the batch axis
+        B, N, T = input_ids.shape
+        flat_ids = input_ids.reshape(B * N, T)
+        flat_tt = (token_type_ids.reshape(B * N, T)
+                   if token_type_ids is not None else None)
+        h, wte = GPT2Transformer(self.cfg, name="transformer")(
+            flat_ids, flat_tt)
+        lm_logits = h @ wte.T  # tied weights
+        lm_logits = lm_logits.reshape(B, N, T, -1)
+
+        h = h.reshape(B, N, T, -1)
+        idx = jnp.clip(mc_token_ids, 0, T - 1)
+        cls_h = jnp.take_along_axis(
+            h, idx[..., None, None], axis=2)[:, :, 0]  # (B, N, C)
+        mc_logits = nn.Dense(1, kernel_init=_dense_init(self.cfg),
+                             name="mc_head")(cls_h)[..., 0]  # (B, N)
+        return lm_logits, mc_logits
+
+
+def gpt2_double_heads_loss(lm_logits, mc_logits, lm_labels, mc_labels,
+                           lm_coef=1.0, mc_coef=1.0,
+                           ignore_index=-100):
+    """Training loss (reference gpt2_train.py:88-99): lm_coef*CE(LM,
+    shifted) + mc_coef*CE(MC). Returns (loss, lm_loss, mc_loss), each
+    a scalar mean over valid positions / examples."""
+    # shift: predict token t+1 from position t
+    logits = lm_logits[..., :-1, :]
+    labels = lm_labels[..., 1:]
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None],
+                               axis=-1)[..., 0]
+    lm_loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    mc_logp = jax.nn.log_softmax(mc_logits, axis=-1)
+    mc_nll = -jnp.take_along_axis(mc_logp, mc_labels[..., None],
+                                  axis=-1)[..., 0]
+    mc_loss = jnp.mean(mc_nll)
+    return lm_coef * lm_loss + mc_coef * mc_loss, lm_loss, mc_loss
+
+
+def convert_torch_gpt2(state_dict, cfg: GPT2Config):
+    """Convert a (pytorch_)transformers GPT2 state dict into this
+    module's params pytree, including the Conv1D (transposed linear)
+    layout and resized embeddings for added special tokens
+    (gpt2_train.py:101-112). Accepts a dict of numpy arrays."""
+    import numpy as np
+
+    def a(name):
+        return np.asarray(state_dict[name])
+
+    p = {"transformer": {}}
+    t = p["transformer"]
+    wte = a("transformer.wte.weight")
+    if wte.shape[0] < cfg.vocab_size:
+        # new special-token rows: mean-init like HF resize
+        extra = np.tile(wte.mean(0, keepdims=True),
+                        (cfg.vocab_size - wte.shape[0], 1))
+        wte = np.concatenate([wte, extra], 0)
+    t["wte"] = wte
+    t["wpe"] = a("transformer.wpe.weight")
+    for i in range(cfg.n_layer):
+        pre = f"transformer.h.{i}."
+        # HF GPT2 Conv1D stores (in, out) — same as flax Dense kernels
+        t[f"h_{i}"] = {
+            "ln_1": {"scale": a(pre + "ln_1.weight"),
+                     "bias": a(pre + "ln_1.bias")},
+            "attn": {
+                "c_attn": {"kernel": a(pre + "attn.c_attn.weight"),
+                           "bias": a(pre + "attn.c_attn.bias")},
+                "c_proj": {"kernel": a(pre + "attn.c_proj.weight"),
+                           "bias": a(pre + "attn.c_proj.bias")},
+            },
+            "ln_2": {"scale": a(pre + "ln_2.weight"),
+                     "bias": a(pre + "ln_2.bias")},
+            "mlp": {
+                "c_fc": {"kernel": a(pre + "mlp.c_fc.weight"),
+                         "bias": a(pre + "mlp.c_fc.bias")},
+                "c_proj": {"kernel": a(pre + "mlp.c_proj.weight"),
+                           "bias": a(pre + "mlp.c_proj.bias")},
+            },
+        }
+    t["ln_f"] = {"scale": a("transformer.ln_f.weight"),
+                 "bias": a("transformer.ln_f.bias")}
+    import numpy as np
+    rng = np.random.RandomState(0)
+    p["mc_head"] = {
+        "kernel": rng.normal(0, cfg.initializer_range,
+                             (cfg.n_embd, 1)).astype(np.float32),
+        "bias": np.zeros((1,), np.float32),
+    }
+    return p

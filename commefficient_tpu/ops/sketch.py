@@ -101,6 +101,11 @@ class CountSketch:
     r: int
     num_blocks: int = 20
     seed: int = 42
+    # TPU-native approximate top-k for recovery (lax.approx_max_k,
+    # ~3x faster at recall 0.95). Algorithmically safe for FetchSGD —
+    # error feedback re-surfaces missed heavy hitters next round — but
+    # off by default for exact reference parity.
+    approx_topk: bool = False
 
     def __post_init__(self):
         assert self.d > 0 and self.c > 0 and self.r > 0
@@ -233,7 +238,10 @@ class CountSketch:
         ``CSVec.unSketch(k)``; server use at fed_aggregator.py:592)."""
         k = min(k, self.d)
         est = self.estimates(table)
-        _, idx = jax.lax.top_k(jax.lax.square(est), k)
+        if self.approx_topk:
+            _, idx = jax.lax.approx_max_k(jax.lax.square(est), k)
+        else:
+            _, idx = jax.lax.top_k(jax.lax.square(est), k)
         return jnp.zeros(self.d, jnp.float32).at[idx].set(
             est[idx], mode="promise_in_bounds")
 

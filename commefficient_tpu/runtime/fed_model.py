@@ -106,7 +106,8 @@ class FedModel:
                                          batch, args)
 
         self._client_round = jax.jit(
-            build_client_round(args, loss_flat, padded_batch_size))
+            build_client_round(args, loss_flat, padded_batch_size,
+                               mesh=self.mesh))
         self._val_fn = jax.jit(build_val_fn(args, loss_flat_val))
 
         # pending round state consumed by FedOptimizer.step
@@ -180,8 +181,12 @@ class FedModel:
             jnp.asarray, batch))
         out = np.asarray(self._val_fn(self.ps_weights, dev_batch))
         # (S, n_metrics) -> per-shard metric arrays, like the
-        # reference's split_results (fed_aggregator.py:617-618)
-        return [out[:, i] for i in range(out.shape[1])]
+        # reference's split_results (fed_aggregator.py:617-618), plus
+        # per-shard real-sample counts so callers can weight out the
+        # padded/empty shards the fixed S-shard layout produces
+        counts = np.asarray(batch["mask"]).reshape(
+            batch["mask"].shape[0], -1).sum(axis=1)
+        return [out[:, i] for i in range(out.shape[1])] + [counts]
 
     def note_update(self, weight_update):
         """Record the server update's support for download accounting."""

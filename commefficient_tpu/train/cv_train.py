@@ -97,14 +97,17 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                 download_total, upload_total)
     else:
         model.train(False)
-        losses, accs = [], []
+        losses, accs, counts = [], [], []
         for i, batch in enumerate(loader):
             shard_metrics = model(batch)
             losses.extend(shard_metrics[0].tolist())
             accs.extend(shard_metrics[1].tolist())
+            counts.extend(shard_metrics[-1].tolist())
             if args.do_test:
                 break
-        return np.mean(losses), np.mean(accs)
+        counts = np.asarray(counts)
+        w = counts / max(counts.sum(), 1.0)
+        return float(np.sum(losses * w)), float(np.sum(accs * w))
 
 
 def train(model, opt, lr_scheduler, train_loader, val_loader, args,

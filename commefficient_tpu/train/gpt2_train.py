@@ -1,0 +1,262 @@
+"""GPT-2 / PersonaChat federated fine-tuning driver — counterpart of
+reference gpt2_train.py.
+
+Same structure: double-heads loss (lm_coef*LM + mc_coef*MC) for
+training (run with --num_results_train 1), NLL + multiple-choice
+accuracy + PPL for validation, linear LR decay
+PiecewiseLinear([0, epochs*spe], [lr_scale, 0]), same round loop.
+
+Offline notes: the PersonaChat archive and GPT-2 vocab must be on disk
+(zero egress); absent those, --test generates a synthetic archive and
+uses the byte-level fallback tokenizer with a tiny GPT-2 config.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config, parse_args
+from commefficient_tpu.data.fed_persona import (FedPERSONA,
+                                                generate_synthetic_personachat)
+from commefficient_tpu.data.fed_sampler import FedSampler
+from commefficient_tpu.data.loader import (PersonaFedLoader,
+                                           PersonaValLoader)
+from commefficient_tpu.data.tokenizer import (SPECIAL_TOKENS,
+                                              load_tokenizer)
+from commefficient_tpu.models.gpt2 import (GPT2Config, GPT2DoubleHeads,
+                                           gpt2_double_heads_loss)
+from commefficient_tpu.runtime import FedModel, FedOptimizer, LambdaLR
+from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
+                                     Timer, steps_per_epoch)
+
+MAX_SEQ_LEN = 256  # static pad length (persona sequences are short)
+
+
+def _apply(module, params, batch):
+    return module.apply({"params": params}, batch["input_ids"],
+                        batch["mc_token_ids"],
+                        batch["token_type_ids"])
+
+
+def make_compute_loss_train(module, args):
+    """(reference gpt2_train.py:88-99) — one result (the combined
+    loss); run with --num_results_train 1."""
+
+    def compute_loss(params, batch, cfg):
+        lm_logits, mc_logits = _apply(module, params, batch)
+        B = batch["mc_labels"].shape[0]
+        m = batch["mask"]
+
+        def per_example(lm_l, mc_l, lm_lab, mc_lab):
+            loss, _, _ = gpt2_double_heads_loss(
+                lm_l[None], mc_l[None], lm_lab[None], mc_lab[None],
+                lm_coef=cfg.lm_coef, mc_coef=cfg.mc_coef,
+                ignore_index=-1)
+            return loss
+
+        losses = jax.vmap(per_example)(lm_logits, mc_logits,
+                                       batch["lm_labels"],
+                                       batch["mc_labels"])
+        loss = jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return loss, ()
+
+    return compute_loss
+
+
+def make_compute_loss_val(module, args):
+    """(reference gpt2_train.py:55-86): token-mean NLL + MC accuracy."""
+
+    def compute_loss(params, batch, cfg):
+        lm_logits, mc_logits = _apply(module, params, batch)
+        m = batch["mask"]
+
+        labels = batch["lm_labels"][..., 1:]
+        logits = lm_logits[..., :-1, :]
+        valid = (labels != -1).astype(jnp.float32) \
+            * m[..., None, None]
+        safe = jnp.where(labels != -1, labels, 0)
+        logp = jax.nn.log_softmax(logits)
+        tok_nll = -jnp.take_along_axis(logp, safe[..., None],
+                                       axis=-1)[..., 0]
+        nll = jnp.sum(tok_nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+        pred = jnp.argmax(mc_logits, axis=-1)
+        acc = jnp.sum((pred == batch["mc_labels"]) * m) \
+            / jnp.maximum(jnp.sum(m), 1.0)
+        return nll, (acc,)
+
+    return compute_loss
+
+
+def run_batches(model, opt, lr_scheduler, loader, args, training):
+    """(reference gpt2_train.py:169-253)"""
+    if training:
+        model.train(True)
+        losses = []
+        for i, batch in enumerate(loader):
+            lr_scheduler.step()
+            metrics = model(batch)
+            opt.step()
+            loss = float(np.mean(metrics[0]))
+            losses.append(loss)
+            if not math.isfinite(loss) or loss > args.nan_threshold:
+                print(f"diverged at round {i} (loss {loss})")
+                return None
+            if args.do_test and i >= 0:
+                break
+        return float(np.mean(losses))
+    else:
+        model.train(False)
+        nlls, accs, counts = [], [], []
+        for i, batch in enumerate(loader):
+            shard_metrics = model(batch)
+            nlls.extend(shard_metrics[0].tolist())
+            accs.extend(shard_metrics[1].tolist())
+            counts.extend(shard_metrics[-1].tolist())
+            if args.do_test:
+                break
+        counts = np.asarray(counts)
+        w = counts / max(counts.sum(), 1.0)
+        nll = float(np.sum(nlls * w))
+        return nll, float(np.sum(accs * w)), float(np.exp(nll))
+
+
+def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
+               args, logger=None):
+    """(reference gpt2_train.py:115-147)"""
+    logger = logger or TableLogger()
+    timer = Timer()
+    results = []
+    for epoch in range(math.ceil(args.num_epochs)):
+        train_loss = run_batches(model, opt, lr_scheduler,
+                                 train_loader, args, training=True)
+        if train_loss is None:
+            print("NaN detected, aborting")
+            return results
+        train_time = timer()
+        nll, acc, ppl = run_batches(model, opt, lr_scheduler,
+                                    val_loader, args, training=False)
+        val_time = timer()
+        row = {"epoch": epoch + 1,
+               "lr": float(opt.param_groups[0]["lr"]),
+               "train_time": train_time, "train_loss": train_loss,
+               "val_time": val_time, "val_nll": nll, "val_acc": acc,
+               "val_ppl": ppl, "total_time": timer.total_time}
+        logger.append(row)
+        results.append(row)
+    return results
+
+
+def build_model_and_tokenizer(args: Config):
+    tokenizer = load_tokenizer(args.model_checkpoint)
+    tokenizer.add_special_tokens(SPECIAL_TOKENS)
+    if args.do_test or tokenizer.__class__.__name__ == "ByteTokenizer":
+        cfg = GPT2Config.tiny()
+        cfg = GPT2Config(
+            vocab_size=max(len(tokenizer), cfg.vocab_size),
+            n_positions=max(MAX_SEQ_LEN, cfg.n_positions),
+            n_embd=cfg.n_embd, n_layer=cfg.n_layer,
+            n_head=cfg.n_head)
+    else:
+        cfg = GPT2Config(vocab_size=len(tokenizer),
+                         n_positions=1024)
+    module = GPT2DoubleHeads(cfg)
+    dummy = jnp.zeros((1, args.num_candidates, 8), jnp.int32)
+    params = module.init(jax.random.PRNGKey(args.seed), dummy,
+                         jnp.zeros((1, args.num_candidates),
+                                   jnp.int32), dummy)["params"]
+
+    ckpt = os.path.join(args.model_checkpoint, "pytorch_model.bin") \
+        if os.path.isdir(args.model_checkpoint) else None
+    if ckpt and os.path.exists(ckpt):
+        import torch
+        from commefficient_tpu.models.gpt2 import convert_torch_gpt2
+        sd = {k: v.numpy() for k, v in
+              torch.load(ckpt, map_location="cpu").items()}
+        params = convert_torch_gpt2(sd, cfg)
+        print(f"loaded GPT-2 weights from {ckpt}")
+    return module, params, tokenizer
+
+
+def get_data_loaders(args: Config, tokenizer):
+    """(reference gpt2_train.py:315-355)"""
+    if args.do_test and not os.path.exists(
+            os.path.join(args.dataset_dir,
+                         "personachat_self_original.json")):
+        if not os.path.exists(os.path.join(args.dataset_dir,
+                                           "stats.json")):
+            generate_synthetic_personachat(args.dataset_dir)
+
+    common = dict(do_iid=args.do_iid, num_clients=args.num_clients,
+                  seed=args.seed)
+    train_ds = FedPERSONA(tokenizer, args.num_candidates,
+                          args.max_history,
+                          args.personality_permutations,
+                          args.dataset_dir, "PERSONA", train=True,
+                          **common)
+    val_ds = FedPERSONA(tokenizer, -1, args.max_history, 1,
+                        args.dataset_dir, "PERSONA", train=False,
+                        **common)
+    pad_id = tokenizer.convert_tokens_to_ids(["<pad>"])[0]
+    sampler = FedSampler(train_ds, args.num_workers,
+                         args.local_batch_size, seed=args.seed)
+    train_loader = PersonaFedLoader(
+        train_ds, sampler, args.num_candidates, MAX_SEQ_LEN, pad_id)
+    val_loader = PersonaValLoader(
+        val_ds, args.valid_batch_size, max(args.num_candidates, 2),
+        MAX_SEQ_LEN, pad_id,
+        shards_per_step=max(1, args.num_workers))
+    return train_loader, val_loader, train_ds
+
+
+def main(argv=None):
+    args = parse_args(default_lr=4e-2, argv=argv)
+    np.random.seed(args.seed)
+    args.num_results_train = 1
+
+    if args.do_test:
+        args.k = 10
+        args.num_cols = 100
+        args.num_rows = 1
+        args.num_blocks = 1
+
+    module, params, tokenizer = build_model_and_tokenizer(args)
+    train_loader, val_loader, train_ds = get_data_loaders(args,
+                                                          tokenizer)
+    if args.num_clients is None:
+        args.num_clients = int(train_ds.num_clients)
+
+    model = FedModel(module, params,
+                     make_compute_loss_train(module, args), args,
+                     compute_loss_val=make_compute_loss_val(module,
+                                                            args),
+                     padded_batch_size=train_loader.B)
+    opt = FedOptimizer([{"lr": 1.0}], args)
+
+    spe = steps_per_epoch(args.local_batch_size, train_ds,
+                          args.num_workers)
+    lambda_step = PiecewiseLinear([0, args.num_epochs * spe],
+                                  [args.lr_scale, 0])
+    lr_scheduler = LambdaLR(opt, lambda x: lambda_step(x))
+
+    if args.do_finetune:
+        # --finetune = eval only (reference gpt2_train.py:308-312)
+        out = run_batches(model, opt, lr_scheduler, val_loader, args,
+                          training=False)
+        print({"val_nll": out[0], "val_acc": out[1], "val_ppl": out[2]})
+        return out
+
+    results = train_gpt2(model, opt, lr_scheduler, train_loader,
+                         val_loader, args)
+    model.finalize()
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,151 @@
+"""GPT-2 double-heads tests: shapes, loss masking, torch parity,
+persona input building, end-to-end smoke."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.models.gpt2 import (GPT2Config, GPT2DoubleHeads,
+                                           convert_torch_gpt2,
+                                           gpt2_double_heads_loss)
+
+
+class TestModel:
+    def test_shapes(self):
+        cfg = GPT2Config.tiny()
+        m = GPT2DoubleHeads(cfg)
+        B, N, T = 2, 2, 16
+        ids = jnp.zeros((B, N, T), jnp.int32)
+        mc = jnp.full((B, N), T - 1, jnp.int32)
+        params = m.init(jax.random.PRNGKey(0), ids, mc, ids)["params"]
+        lm, mcl = m.apply({"params": params}, ids, mc, ids)
+        assert lm.shape == (B, N, T, cfg.vocab_size)
+        assert mcl.shape == (B, N)
+
+    def test_loss_ignores_masked_labels(self):
+        lm = jnp.zeros((1, 1, 4, 8))
+        mc = jnp.zeros((1, 1))
+        labels_all_ignored = jnp.full((1, 1, 4), -1, jnp.int32)
+        loss, lm_loss, _ = gpt2_double_heads_loss(
+            lm, mc, labels_all_ignored, jnp.zeros((1,), jnp.int32),
+            ignore_index=-1)
+        assert float(lm_loss) == 0.0
+
+    def test_causality(self):
+        """Changing a future token must not affect past LM logits."""
+        cfg = GPT2Config.tiny()
+        m = GPT2DoubleHeads(cfg)
+        B, N, T = 1, 1, 8
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, N, T)),
+                          jnp.int32)
+        mc = jnp.full((B, N), T - 1, jnp.int32)
+        params = m.init(jax.random.PRNGKey(0), ids, mc, ids)["params"]
+        lm1, _ = m.apply({"params": params}, ids, mc, ids)
+        ids2 = ids.at[0, 0, -1].set((ids[0, 0, -1] + 1)
+                                    % cfg.vocab_size)
+        lm2, _ = m.apply({"params": params}, ids2, mc, ids2)
+        np.testing.assert_allclose(lm1[0, 0, :-1], lm2[0, 0, :-1],
+                                   atol=1e-5)
+
+
+class TestTorchParity:
+    def test_transformer_matches_hf_gpt2(self):
+        """Random-init HF torch GPT-2 -> convert -> identical LM
+        logits. Proves the checkpoint conversion path and the
+        transformer math (layout, LN eps, gelu, causal mask)."""
+        torch = pytest.importorskip("torch")
+        from transformers import GPT2Config as HFConfig
+        from transformers import GPT2LMHeadModel
+
+        hf_cfg = HFConfig(vocab_size=128, n_positions=32, n_embd=16,
+                          n_layer=2, n_head=2)
+        torch.manual_seed(0)
+        hf = GPT2LMHeadModel(hf_cfg).eval()
+
+        cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=16,
+                         n_layer=2, n_head=2)
+        sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+        params = convert_torch_gpt2(sd, cfg)
+
+        m = GPT2DoubleHeads(cfg)
+        rng = np.random.RandomState(1)
+        ids_np = rng.randint(0, 128, (2, 1, 16))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids_np.reshape(2, 16))
+                      ).logits.numpy()
+        ids = jnp.asarray(ids_np, jnp.int32)
+        mc = jnp.full((2, 1), 15, jnp.int32)
+        lm, _ = m.apply({"params": {"params": params}["params"]},
+                        ids, mc, None)
+        got = np.asarray(lm[:, 0])
+        np.testing.assert_allclose(got, want.reshape(2, 16, 128),
+                                   rtol=2e-3, atol=2e-3)
+
+
+class TestPersonaInputs:
+    def test_build_input_from_segments(self):
+        from commefficient_tpu.data.fed_persona import \
+            build_input_from_segments
+        from commefficient_tpu.data.tokenizer import (ByteTokenizer,
+                                                      SPECIAL_TOKENS)
+        tok = ByteTokenizer()
+        tok.add_special_tokens(SPECIAL_TOKENS)
+        bos, eos, s1, s2 = tok.convert_tokens_to_ids(
+            SPECIAL_TOKENS[:-1])
+        persona = [[10, 11]]
+        history = [[20], [21]]
+        reply = [30, 31]
+        inst = build_input_from_segments(persona, history, reply, tok,
+                                         lm_labels=True)
+        # layout: [bos p p] [s1 20] [s2 21]... wait — speaker parity:
+        # last segment (reply) gets speaker2, alternating backwards
+        ids = inst["input_ids"]
+        assert ids[0] == bos
+        assert ids[-1] == eos
+        assert inst["mc_token_ids"] == len(ids) - 1
+        # lm labels: -1 everywhere except the reply tokens + eos
+        # (reference fed_persona.py:354-357: [-1]*prefix + [-1] +
+        # sequence[-1][1:], where sequence[-1] = [spk, *reply, eos])
+        labels = inst["lm_labels"]
+        n_prefix = len(ids) - (len(reply) + 1)
+        assert all(l == -1 for l in labels[:n_prefix])
+        assert labels[-(len(reply) + 1):] == [30, 31, eos]
+
+    def test_synthetic_archive_and_dataset(self, tmp_path):
+        from commefficient_tpu.data.fed_persona import (
+            FedPERSONA, generate_synthetic_personachat)
+        from commefficient_tpu.data.tokenizer import (ByteTokenizer,
+                                                      SPECIAL_TOKENS)
+        generate_synthetic_personachat(str(tmp_path))
+        tok = ByteTokenizer()
+        tok.add_special_tokens(SPECIAL_TOKENS)
+        ds = FedPERSONA(tok, 2, 2, 1, str(tmp_path), "PERSONA",
+                        train=True)
+        assert ds.num_clients == 8
+        cid, *rest = ds[0]
+        assert cid == 0
+        assert len(rest) == 5
+        val = FedPERSONA(tok, -1, 2, 1, str(tmp_path), "PERSONA",
+                         train=False)
+        assert val[0][0] == -1
+
+
+class TestGpt2TrainSmoke:
+    def test_end_to_end(self, tmp_path):
+        from commefficient_tpu.train import gpt2_train
+        results = gpt2_train.main([
+            "--test", "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path),
+            "--mode", "uncompressed", "--error_type", "none",
+            "--local_momentum", "0", "--num_workers", "2",
+            "--local_batch_size", "2", "--num_epochs", "1",
+            "--lr_scale", "0.01",
+        ])
+        assert len(results) == 1
+        assert np.isfinite(results[0]["train_loss"])
+        assert np.isfinite(results[0]["val_ppl"])

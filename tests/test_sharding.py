@@ -59,6 +59,34 @@ def _run_round(cfg, batch, ids, shard=False):
 
 
 class TestShardingInvariance:
+    def test_sketch_late_shard_map_equals_per_client(self, devices):
+        """The device-local-sum-then-sketch fast path (shard_map +
+        psum of tables) must equal per-client sketching exactly (the
+        FetchSGD linearity identity)."""
+        cfg = _setup("sketch")
+        batch, ids = _batch(seed=7)
+        mesh = make_mesh()
+
+        # fast path with mesh
+        fast = jax.jit(build_client_round(
+            cfg, linear_loss, batch["x"].shape[1], mesh=mesh))
+        # slow path: max_grad_norm forces per-client sketching (its
+        # huge value makes the per-sketch clip a no-op)
+        slow_cfg = _setup("sketch", max_grad_norm=1e9)
+        slow = jax.jit(build_client_round(
+            slow_cfg, linear_loss, batch["x"].shape[1]))
+
+        ps = jnp.zeros(cfg.grad_size, jnp.float32).at[0].set(0.5)
+        cs = ClientStates.init(cfg, 16, ps)
+        sh = client_sharding(mesh)
+        sharded = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), batch)
+        r_fast = fast(ps, cs, sharded, ids, jax.random.PRNGKey(0), 1.0)
+        r_slow = slow(ps, cs, batch, ids, jax.random.PRNGKey(0), 1.0)
+        np.testing.assert_allclose(np.asarray(r_fast.aggregated),
+                                   np.asarray(r_slow.aggregated),
+                                   rtol=1e-4, atol=1e-5)
+
     def test_sketch_mode(self, devices):
         cfg = _setup("sketch")
         batch, ids = _batch()
