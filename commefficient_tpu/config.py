@@ -152,6 +152,18 @@ class Config:
         failing here at setup is the friendlier equivalent.
         """
         self.validate()
+        if self.do_test:
+            # the reference's --test short-circuits the worker before
+            # any of these asserts run (fed_worker.py:118-123), so its
+            # smoke mode works at default flags; normalize the default
+            # combo here so ours does too
+            if self.mode == "sketch" and self.local_momentum:
+                self.virtual_momentum = max(self.virtual_momentum,
+                                            self.local_momentum)
+                self.local_momentum = 0.0
+            if self.mode in ("sketch", "uncompressed") \
+                    and self.error_type == "local":
+                self.error_type = "virtual"
         if self.mode == "sketch":
             # sketched SGD with local error/momentum is undefined: we
             # can't know which part of a sketch is "error"

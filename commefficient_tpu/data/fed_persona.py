@@ -161,11 +161,12 @@ class FedPERSONA(FedDataset):
         personality = list(dialog["personality"])
         utterance = dialog["utterances"][idx_within_dialog]
 
-        model_input = None
+        # the reference shuffles P times and returns only the last
+        # tokenization (fed_persona.py:231-241 — model_inputs is built
+        # then discarded); same semantics, but tokenize just once
         for _ in range(self.personality_permutations):
             self._rng.shuffle(personality)
-            model_input = self.utterance_to_input(personality,
-                                                  utterance)
+        model_input = self.utterance_to_input(personality, utterance)
 
         if self.do_iid:
             cumsum = np.cumsum(self.data_per_client)

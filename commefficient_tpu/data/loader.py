@@ -186,12 +186,16 @@ class PersonaValLoader(_ShardedValBase):
                 "mc_labels": np.zeros((self.S, self.B), np.int32),
                 "mask": np.zeros((self.S, self.B), np.float32),
             }
-            for pos, ix in enumerate(idxs):
-                s, j = divmod(pos, self.B)
-                _, arrs = persona_collate([self.dataset[int(ix)]],
-                                          self.N, self.T, self.pad_id)
+            for s in range(self.S):
+                rows = idxs[s * self.B:(s + 1) * self.B]
+                if len(rows) == 0:
+                    break
+                records = [self.dataset[int(ix)] for ix in rows]
+                _, arrs = persona_collate(records, self.N, self.T,
+                                          self.pad_id)
+                n = len(records)
                 for k in ("input_ids", "token_type_ids", "lm_labels",
                           "mc_token_ids", "mc_labels"):
-                    batch[k][s, j] = arrs[k][0]
-                batch["mask"][s, j] = 1.0
+                    batch[k][s, :n] = arrs[k]
+                batch["mask"][s, :n] = 1.0
             yield batch

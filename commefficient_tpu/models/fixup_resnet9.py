@@ -1,0 +1,218 @@
+"""Fixup-initialized BN-free ResNets: FixupResNet9 (CIFAR) and
+FixupResNet50 (ImageNet).
+
+The reference imports Fixup blocks from an external ``fixup`` git
+submodule (reference models/fixup_resnet9.py:6, fixup_resnet.py:4;
+.gitmodules:1-3); here the blocks are in-tree. Fixup (Zhang et al.,
+ICLR'19) removes normalization entirely: residual-branch convs are
+rescaled at init (first conv std x L^{-1/(2m-2)}, last conv zero) and
+scalar bias/scale parameters are inserted around each conv. BN-free
+models are the better fit for federated simulation — no batch
+statistics to mix across clients (SURVEY.md §2.6).
+
+TPU notes: NHWC; scalar bias/scale params broadcast for free on VPU;
+all-conv + matmul graph maps cleanly onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.models import register_model
+
+
+def _fixup_conv_init(scale: float = 1.0):
+    """He-style normal init, std = scale * sqrt(2 / (k*k*c_out)).
+
+    Matches the reference's fan measure ``shape[0] * prod(shape[2:])``
+    (out_channels * kernel area; reference fixup_resnet9.py:59-78) on
+    flax's (kh, kw, c_in, c_out) kernel layout.
+    """
+    def init(key, shape, dtype=jnp.float32):
+        import jax
+        fan = shape[-1] * int(np.prod(shape[:-2]))
+        std = scale * np.sqrt(2.0 / fan)
+        return (std * jax.random.normal(key, shape)).astype(dtype)
+    return init
+
+
+def _conv3x3(c_out, stride=1, init_scale=1.0):
+    return nn.Conv(c_out, (3, 3), strides=(stride, stride), padding=1,
+                   use_bias=False, kernel_init=_fixup_conv_init(init_scale))
+
+
+def _conv1x1(c_out, stride=1, init_scale=1.0):
+    return nn.Conv(c_out, (1, 1), strides=(stride, stride), padding=0,
+                   use_bias=False, kernel_init=_fixup_conv_init(init_scale))
+
+
+class FixupBasicBlock(nn.Module):
+    """Two-conv fixup residual block (the submodule's
+    fixup_resnet_cifar.FixupBasicBlock, used at reference
+    fixup_resnet9.py:19-22): conv1 std scaled by num_layers^-0.5,
+    conv2 zero-init; scalar biases around convs, scale after conv2."""
+    c_out: int
+    num_layers: int  # total residual blocks in the network (for init)
+    stride: int = 1
+    downsample: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        b1a = self.param("bias1a", nn.initializers.zeros, (1,))
+        b1b = self.param("bias1b", nn.initializers.zeros, (1,))
+        b2a = self.param("bias2a", nn.initializers.zeros, (1,))
+        b2b = self.param("bias2b", nn.initializers.zeros, (1,))
+        scale = self.param("scale", nn.initializers.ones, (1,))
+
+        out = _conv3x3(self.c_out, self.stride,
+                       self.num_layers ** -0.5)(x + b1a)
+        out = nn.relu(out + b1b)
+        out = _conv3x3(self.c_out, 1, 0.0)(out + b2a)  # zero-init
+        out = out * scale + b2b
+        if self.downsample:
+            identity = nn.avg_pool(x + b1a, (1, 1),
+                                   strides=(self.stride, self.stride))
+            identity = jnp.concatenate([identity,
+                                        jnp.zeros_like(identity)], -1)
+        else:
+            identity = x
+        return nn.relu(out + identity)
+
+
+class FixupLayer(nn.Module):
+    """conv, bias, relu, pool, then num_blocks FixupBasicBlocks
+    (reference fixup_resnet9.py:10-31)."""
+    c_out: int
+    num_blocks: int
+    net_num_layers: int
+    pool: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        b1a = self.param("bias1a", nn.initializers.zeros, (1,))
+        b1b = self.param("bias1b", nn.initializers.zeros, (1,))
+        scale = self.param("scale", nn.initializers.ones, (1,))
+        x = _conv3x3(self.c_out)(x + b1a) * scale + b1b
+        x = nn.relu(x)
+        if self.pool:
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        for _ in range(self.num_blocks):
+            x = FixupBasicBlock(self.c_out,
+                                num_layers=self.net_num_layers)(x)
+        return x
+
+
+@register_model("FixupResNet9")
+class FixupResNet9(nn.Module):
+    """BN-free ResNet9 (reference fixup_resnet9.py:33-91): prep conv,
+    three FixupLayers (1/0/1 residual blocks), 4x4 max-pool, zero-init
+    linear head with a scalar pre-bias."""
+    num_classes: int = 10
+    channels: Optional[Dict[str, int]] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        ch = self.channels or {"prep": 64, "layer1": 128,
+                               "layer2": 256, "layer3": 512}
+        num_layers = 2  # reference fixup_resnet9.py:36
+        b1a = self.param("bias1a", nn.initializers.zeros, (1,))
+        b1b = self.param("bias1b", nn.initializers.zeros, (1,))
+        scale = self.param("scale", nn.initializers.ones, (1,))
+        out = _conv3x3(ch["prep"])(x + b1a) * scale + b1b
+        out = nn.relu(out)
+        out = FixupLayer(ch["layer1"], 1, num_layers)(out)
+        out = FixupLayer(ch["layer2"], 0, num_layers)(out)
+        out = FixupLayer(ch["layer3"], 1, num_layers)(out)
+        out = nn.max_pool(out, (4, 4), strides=(4, 4))
+        out = out.reshape((out.shape[0], -1))
+        b2 = self.param("bias2", nn.initializers.zeros, (1,))
+        out = nn.Dense(self.num_classes,
+                       kernel_init=nn.initializers.zeros,
+                       bias_init=nn.initializers.zeros)(out + b2)
+        return out
+
+    @staticmethod
+    def test_config(num_classes: int = 10) -> Dict[str, Any]:
+        return dict(channels={"prep": 1, "layer1": 1,
+                              "layer2": 1, "layer3": 1},
+                    num_classes=num_classes)
+
+
+class FixupBottleneck(nn.Module):
+    """Three-conv fixup bottleneck (the submodule's
+    fixup_resnet_imagenet.FixupBottleneck, used via reference
+    fixup_resnet.py:4-10): conv1/conv2 std scaled by
+    num_layers^-0.25, conv3 zero-init; projection shortcut is a
+    1x1 conv on (x + bias1a)."""
+    planes: int
+    num_layers: int
+    stride: int = 1
+    project: bool = False
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        b1a = self.param("bias1a", nn.initializers.zeros, (1,))
+        b1b = self.param("bias1b", nn.initializers.zeros, (1,))
+        b2a = self.param("bias2a", nn.initializers.zeros, (1,))
+        b2b = self.param("bias2b", nn.initializers.zeros, (1,))
+        b3a = self.param("bias3a", nn.initializers.zeros, (1,))
+        b3b = self.param("bias3b", nn.initializers.zeros, (1,))
+        scale = self.param("scale", nn.initializers.ones, (1,))
+
+        s = self.num_layers ** -0.25
+        out = _conv1x1(self.planes, 1, s)(x + b1a)
+        out = nn.relu(out + b1b)
+        out = _conv3x3(self.planes, self.stride, s)(out + b2a)
+        out = nn.relu(out + b2b)
+        out = _conv1x1(self.planes * self.expansion, 1, 0.0)(out + b3a)
+        out = out * scale + b3b
+        if self.project:
+            identity = _conv1x1(self.planes * self.expansion,
+                                self.stride)(x + b1a)
+        else:
+            identity = x
+        return nn.relu(out + identity)
+
+
+@register_model("FixupResNet50")
+class FixupResNet50(nn.Module):
+    """Fixup ImageNet ResNet-50 (reference fixup_resnet.py:8-10:
+    FixupResNet(FixupBottleneck, [3,4,6,3])): 7x7/2 stem with scalar
+    bias+scale, 3x3/2 max-pool, four stages, global avg-pool,
+    zero-init fc. Used by imagenet.sh (SURVEY.md §6)."""
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        L = sum(self.stage_sizes)
+        b1 = self.param("bias1", nn.initializers.zeros, (1,))
+        b2 = self.param("bias2", nn.initializers.zeros, (1,))
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3,
+                    use_bias=False,
+                    kernel_init=_fixup_conv_init())(x)
+        x = nn.relu(x + b1)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1),
+                                                            (1, 1)))
+        planes = 64
+        in_ch = 64
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            stride = 1 if stage == 0 else 2
+            for b in range(n_blocks):
+                x = FixupBottleneck(
+                    planes, num_layers=L,
+                    stride=stride if b == 0 else 1,
+                    project=(b == 0 and
+                             (stride != 1 or in_ch != planes * 4)))(x)
+                in_ch = planes * 4
+            planes *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes,
+                     kernel_init=nn.initializers.zeros,
+                     bias_init=nn.initializers.zeros)(x + b2)
+        return x

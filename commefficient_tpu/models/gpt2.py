@@ -175,7 +175,12 @@ def convert_torch_gpt2(state_dict, cfg: GPT2Config):
     import numpy as np
 
     def a(name):
-        return np.asarray(state_dict[name])
+        # hub checkpoints for the bare "gpt2" model store keys without
+        # the "transformer." base-model prefix; re-saved
+        # GPT2LMHeadModel/DoubleHeads dicts include it — accept both
+        if name in state_dict:
+            return np.asarray(state_dict[name])
+        return np.asarray(state_dict[name.removeprefix("transformer.")])
 
     p = {"transformer": {}}
     t = p["transformer"]
@@ -210,7 +215,6 @@ def convert_torch_gpt2(state_dict, cfg: GPT2Config):
         }
     t["ln_f"] = {"scale": a("transformer.ln_f.weight"),
                  "bias": a("transformer.ln_f.bias")}
-    import numpy as np
     rng = np.random.RandomState(0)
     p["mc_head"] = {
         "kernel": rng.normal(0, cfg.initializer_range,

@@ -1,0 +1,36 @@
+"""Stateless batch-statistics normalization.
+
+The reference's torch BatchNorm keeps running averages per worker
+process that never federate (SURVEY.md §7 "BatchNorm under
+client-vmap"); the well-defined TPU-native equivalent normalizes by
+the current batch statistics in train AND eval, with no mutable state.
+Being stateless keeps every model a pure function of (params, x) —
+exactly what vmap-over-clients and the flat-param-vector runtime
+(ops/vec.py) assume.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class BatchStatNorm(nn.Module):
+    """Per-channel normalization by current batch mean/variance over
+    (N, H, W), with learned scale and bias. No running averages."""
+    epsilon: float = 1e-5
+    scale_init: float = 1.0
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale",
+                           nn.initializers.constant(self.scale_init),
+                           (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        inv = scale * jax.lax.rsqrt(var + self.epsilon)
+        return x * inv + (bias - mean * inv)

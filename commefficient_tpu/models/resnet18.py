@@ -1,0 +1,122 @@
+"""Self-contained CIFAR ResNet18s: post-act BN variant and a BN-free
+Fixup variant (reference models/fixup_resnet18.py:66-216).
+
+Both share the reference's slightly unusual topology: a 3x3 prep conv
+(no norm), four stages with channel plan 64/128/256/256 and strides
+1/2/2/2, and a head that concatenates global **avg and max** pooling
+(so the classifier input is 2x256 = 512; reference
+fixup_resnet18.py:125-133, 206-214).
+
+TPU notes: NHWC; BatchNorm uses batch statistics in train and eval for
+the same federated reasons as ResNet9 (models/resnet9.py docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from commefficient_tpu.models import register_model
+from commefficient_tpu.models.fixup_resnet9 import (_conv1x1, _conv3x3,
+                                                    _fixup_conv_init)
+from commefficient_tpu.models.norms import BatchStatNorm
+
+_he = nn.initializers.he_normal()
+
+
+class PreActBlock(nn.Module):
+    """reference fixup_resnet18.py:138-165 — despite the name the
+    as-shipped code is post-activation: relu(bn(conv(x))) twice, plus
+    an un-normalized 1x1 projection shortcut when shape changes."""
+    c_out: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        out = nn.Conv(self.c_out, (3, 3), strides=(self.stride,) * 2,
+                      padding=1, use_bias=False, kernel_init=_he)(x)
+        out = nn.relu(BatchStatNorm()(out))
+        out = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                      kernel_init=_he)(out)
+        out = nn.relu(BatchStatNorm()(out))
+        if self.stride != 1 or x.shape[-1] != self.c_out:
+            x = nn.Conv(self.c_out, (1, 1), strides=(self.stride,) * 2,
+                        use_bias=False, kernel_init=_he)(x)
+        return out + x
+
+
+class FixupBlock(nn.Module):
+    """reference fixup_resnet18.py:24-63: scalar Adds around each conv,
+    scalar Mul after conv2 (conv2 zero-init, conv1 std x L^-0.5), 1x1
+    projection shortcut, relu(out + shortcut)."""
+    c_out: int
+    num_layers: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        a1a = self.param("add1a", nn.initializers.zeros, (1,))
+        a1b = self.param("add1b", nn.initializers.zeros, (1,))
+        a2a = self.param("add2a", nn.initializers.zeros, (1,))
+        a2b = self.param("add2b", nn.initializers.zeros, (1,))
+        mul = self.param("mul", nn.initializers.ones, (1,))
+        if self.stride != 1 or x.shape[-1] != self.c_out:
+            shortcut = _conv1x1(self.c_out, self.stride)(x)
+        else:
+            shortcut = x
+        out = _conv3x3(self.c_out, self.stride,
+                       self.num_layers ** -0.5)(x + a1a)
+        out = nn.relu(out + a1b)
+        out = _conv3x3(self.c_out, 1, 0.0)(out + a2a)
+        out = out * mul + a2b
+        return nn.relu(out + shortcut)
+
+
+def _avg_max_head(x):
+    """Concat of global average and max pooling (reference
+    fixup_resnet18.py:125-131)."""
+    return jnp.concatenate([jnp.mean(x, axis=(1, 2)),
+                            jnp.max(x, axis=(1, 2))], axis=-1)
+
+
+@register_model("ResNet18")
+class ResNet18(nn.Module):
+    """reference fixup_resnet18.py:168-216."""
+    num_classes: int = 10
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.relu(nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                            kernel_init=_he)(x))
+        for c_out, n, stride in zip((64, 128, 256, 256),
+                                    self.num_blocks, (1, 2, 2, 2)):
+            for b in range(n):
+                x = PreActBlock(c_out, stride if b == 0 else 1)(x)
+        x = _avg_max_head(x)
+        x = nn.Dense(self.num_classes, kernel_init=_he)(x)
+        return x
+
+
+@register_model("FixupResNet18")
+class FixupResNet18(nn.Module):
+    """reference fixup_resnet18.py:66-135 (zero-init classifier)."""
+    num_classes: int = 10
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        L = sum(self.num_blocks)
+        x = nn.relu(nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                            kernel_init=_fixup_conv_init())(x))
+        for c_out, n, stride in zip((64, 128, 256, 256),
+                                    self.num_blocks, (1, 2, 2, 2)):
+            for b in range(n):
+                x = FixupBlock(c_out, L, stride if b == 0 else 1)(x)
+        x = _avg_max_head(x)
+        x = nn.Dense(self.num_classes,
+                     kernel_init=nn.initializers.zeros,
+                     bias_init=nn.initializers.zeros)(x)
+        return x

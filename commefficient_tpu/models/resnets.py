@@ -1,0 +1,146 @@
+"""The generic ResNet family (resnet18 ... wide_resnet101_2), as the
+reference forked it from torchvision for EMNIST (models/resnets.py):
+
+- the stem conv takes **1 input channel** (28x28 grayscale EMNIST;
+  reference resnets.py:155-156),
+- every norm site can be **LayerNorm** instead of BatchNorm
+  (``norm="layer"``; reference resnets.py:79-97, 157-161 hardcodes
+  per-site (C, hw, hw) shapes — here flax resolves the normalized
+  shape from the activation, so any input size works),
+- ``ResNet101LN`` = resnet101 + LayerNorm + 62 classes (reference
+  resnet101ln.py:7-13).
+
+TPU notes: NHWC; LayerNorm normalizes over (H, W, C) with elementwise
+affine over the same axes, matching torch ``LayerNorm((C, hw, hw))``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from commefficient_tpu.models import register_model
+from commefficient_tpu.models.norms import BatchStatNorm
+
+_he = nn.initializers.he_normal()
+
+
+def _norm(kind: str):
+    if kind == "batch":
+        # stateless batch-stat norm (see models/norms.py docstring)
+        return BatchStatNorm
+    if kind == "layer":
+        return partial(nn.LayerNorm, reduction_axes=(-3, -2, -1),
+                       feature_axes=(-3, -2, -1))
+    raise ValueError(f"unknown norm {kind!r}")
+
+
+class BasicBlock(nn.Module):
+    """reference resnets.py:34-73."""
+    planes: int
+    norm: str = "batch"
+    stride: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        norm = _norm(self.norm)
+        out = nn.Conv(self.planes, (3, 3), strides=(self.stride,) * 2,
+                      padding=1, use_bias=False, kernel_init=_he)(x)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                      kernel_init=_he)(out)
+        out = norm()(out)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            x = norm()(nn.Conv(self.planes, (1, 1),
+                               strides=(self.stride,) * 2,
+                               use_bias=False, kernel_init=_he)(x))
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    """reference resnets.py:76-130."""
+    planes: int
+    norm: str = "batch"
+    stride: int = 1
+    base_width: int = 64
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        norm = _norm(self.norm)
+        width = int(self.planes * (self.base_width / 64.0))
+        out_ch = self.planes * self.expansion
+        out = nn.Conv(width, (1, 1), use_bias=False, kernel_init=_he)(x)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(width, (3, 3), strides=(self.stride,) * 2,
+                      padding=1, use_bias=False, kernel_init=_he)(out)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(out_ch, (1, 1), use_bias=False, kernel_init=_he)(out)
+        out = norm()(out)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            x = norm()(nn.Conv(out_ch, (1, 1),
+                               strides=(self.stride,) * 2,
+                               use_bias=False, kernel_init=_he)(x))
+        return nn.relu(out + x)
+
+
+class ResNet(nn.Module):
+    """reference resnets.py:133-237 (1-channel 7x7/2 stem, 3x3/2
+    max-pool, four stages, global avg-pool, fc)."""
+    block: Any  # BasicBlock or Bottleneck class
+    layers: Sequence[int]
+    num_classes: int = 1000
+    norm: str = "batch"
+    width_per_group: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = _norm(self.norm)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3,
+                    use_bias=False, kernel_init=_he)(x)
+        x = nn.relu(norm()(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                        padding=((1, 1), (1, 1)))
+        planes = 64
+        for stage, n_blocks in enumerate(self.layers):
+            stride = 1 if stage == 0 else 2
+            for b in range(n_blocks):
+                kw = {}
+                if self.block is Bottleneck:
+                    kw["base_width"] = self.width_per_group
+                x = self.block(planes, self.norm,
+                               stride if b == 0 else 1, **kw)(x)
+            planes *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, kernel_init=_he)(x)
+
+
+def _factory(layers, block, **preset) -> Callable[..., ResNet]:
+    def make(**kwargs):
+        merged = {**preset, **kwargs}
+        return ResNet(block=block, layers=layers, **merged)
+    return make
+
+
+# reference resnets.py:249-370 factory surface
+resnet18 = _factory([2, 2, 2, 2], BasicBlock)
+resnet34 = _factory([3, 4, 6, 3], BasicBlock)
+resnet50 = _factory([3, 4, 6, 3], Bottleneck)
+resnet101 = _factory([3, 4, 23, 3], Bottleneck)
+resnet152 = _factory([3, 8, 36, 3], Bottleneck)
+wide_resnet50_2 = _factory([3, 4, 6, 3], Bottleneck, width_per_group=128)
+wide_resnet101_2 = _factory([3, 4, 23, 3], Bottleneck,
+                            width_per_group=128)
+
+
+def ResNet101LN(num_classes: int = 62, **kwargs) -> ResNet:
+    """resnet101 with LayerNorm, 62 classes = EMNIST byclass
+    (reference resnet101ln.py:7-13)."""
+    return resnet101(num_classes=num_classes, norm="layer", **kwargs)
+
+
+register_model("ResNet101LN")(ResNet101LN)

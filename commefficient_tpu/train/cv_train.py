@@ -91,7 +91,7 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                 print(f"Stopping at batch {i}: diverged "
                       f"(loss {losses[-1]})")
                 return None
-            if args.do_test and i >= 0:
+            if args.do_test:
                 break
         return (np.mean(losses), np.mean(accs),
                 download_total, upload_total)

@@ -108,7 +108,7 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
             if not math.isfinite(loss) or loss > args.nan_threshold:
                 print(f"diverged at round {i} (loss {loss})")
                 return None
-            if args.do_test and i >= 0:
+            if args.do_test:
                 break
         return float(np.mean(losses))
     else:
