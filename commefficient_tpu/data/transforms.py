@@ -46,13 +46,17 @@ class Normalize:
 class RandomCrop:
     """Pad by ``padding`` then random-crop back to ``size``."""
 
-    def __init__(self, size, padding=4, rng=None):
-        self.size, self.padding = size, padding
+    def __init__(self, size, padding=4, rng=None, fill=None):
+        self.size, self.padding, self.fill = size, padding, fill
         self.rng = rng or np.random
 
     def __call__(self, x):
         p = self.padding
-        x = np.pad(x, ((p, p), (p, p), (0, 0)), mode="reflect")
+        if self.fill is None:
+            x = np.pad(x, ((p, p), (p, p), (0, 0)), mode="reflect")
+        else:
+            x = np.pad(x, ((p, p), (p, p), (0, 0)), mode="constant",
+                       constant_values=self.fill)
         i = self.rng.randint(0, x.shape[0] - self.size + 1)
         j = self.rng.randint(0, x.shape[1] - self.size + 1)
         return x[i:i + self.size, j:j + self.size]
@@ -75,3 +79,134 @@ def cifar_train_transform(mean=CIFAR10_MEAN, std=CIFAR10_STD):
 
 def cifar_val_transform(mean=CIFAR10_MEAN, std=CIFAR10_STD):
     return Compose([ToFloat(), Normalize(mean, std)])
+
+
+FEMNIST_MEAN = np.array([0.9637], np.float32)
+FEMNIST_STD = np.array([0.1597], np.float32)
+
+
+class RandomRotation:
+    """Small-angle rotation with constant fill (femnist augmentation,
+    reference transforms.py:50-51). Nearest-neighbor on HWC arrays."""
+
+    def __init__(self, degrees, fill=1.0, rng=None):
+        self.degrees, self.fill = degrees, fill
+        self.rng = rng or np.random
+
+    def __call__(self, x):
+        ang = np.deg2rad(self.rng.uniform(-self.degrees, self.degrees))
+        h, w = x.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        c, s = np.cos(ang), np.sin(ang)
+        sy = cy + (yy - cy) * c - (xx - cx) * s
+        sx = cx + (yy - cy) * s + (xx - cx) * c
+        syi = np.round(sy).astype(int)
+        sxi = np.round(sx).astype(int)
+        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+        out = np.full_like(x, self.fill, dtype=np.float32)
+        out[valid] = x[syi[valid], sxi[valid]]
+        return out
+
+
+def _pil_resize(x, nh, nw):
+    """PIL-bilinear resize of an HWC array to (nh, nw), preserving the
+    input dtype convention (uint8 stays uint8; float in [0,1] is
+    clipped, round-tripped via uint8, and returned as float32).
+    Handles (H, W, 1) grayscale on both paths."""
+    from PIL import Image
+    dtype = x.dtype
+    if dtype == np.uint8:
+        arr = np.asarray(x)
+    else:
+        arr = np.asarray(np.clip(x, 0, 1) * 255, np.uint8)
+    if arr.ndim == 3 and arr.shape[-1] == 1:
+        arr = arr[..., 0]
+    im = Image.fromarray(arr).resize((nw, nh), Image.BILINEAR)
+    out = np.asarray(im)
+    if out.ndim == 2:
+        out = out[..., None]
+    if dtype != np.uint8:
+        out = out.astype(np.float32) / 255.0
+    return out
+
+
+class Resize:
+    """Shorter side -> ``size`` (PIL bilinear), HWC uint8/float."""
+
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, x):
+        h, w = x.shape[:2]
+        if h < w:
+            nh, nw = self.size, max(1, round(w * self.size / h))
+        else:
+            nh, nw = max(1, round(h * self.size / w)), self.size
+        return _pil_resize(x, nh, nw)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, x):
+        h, w = x.shape[:2]
+        i = max(0, (h - self.size) // 2)
+        j = max(0, (w - self.size) // 2)
+        return x[i:i + self.size, j:j + self.size]
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop resized to ``size`` (reference
+    transforms.py:49, 67)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+                 rng=None):
+        self.size, self.scale, self.ratio = size, scale, ratio
+        self.rng = rng or np.random
+
+    def __call__(self, x):
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * self.rng.uniform(*self.scale)
+            ar = np.exp(self.rng.uniform(np.log(self.ratio[0]),
+                                         np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = self.rng.randint(0, h - ch + 1)
+                j = self.rng.randint(0, w - cw + 1)
+                x = x[i:i + ch, j:j + cw]
+                break
+        else:
+            s = min(h, w)
+            x = CenterCrop(s)(x)
+        return _pil_resize(x, self.size, self.size)
+
+
+def femnist_train_transform(rng=None):
+    """reference transforms.py:47-53 (crop/resize/rotate with white
+    fill — LEAF femnist is white-background floats in [0,1])."""
+    return Compose([ToFloat(),
+                    RandomCrop(28, 2, rng=rng, fill=1.0),
+                    RandomResizedCrop(28, scale=(0.8, 1.2),
+                                      ratio=(4. / 5., 5. / 4.), rng=rng),
+                    RandomRotation(5, fill=1.0, rng=rng),
+                    Normalize(FEMNIST_MEAN, FEMNIST_STD)])
+
+
+def femnist_val_transform():
+    return Compose([ToFloat(), Normalize(FEMNIST_MEAN, FEMNIST_STD)])
+
+
+def imagenet_train_transform(rng=None):
+    return Compose([RandomResizedCrop(224, rng=rng),
+                    RandomHorizontalFlip(rng=rng), ToFloat(),
+                    Normalize(IMAGENET_MEAN, IMAGENET_STD)])
+
+
+def imagenet_val_transform():
+    return Compose([Resize(int(224 * 1.14)), CenterCrop(224), ToFloat(),
+                    Normalize(IMAGENET_MEAN, IMAGENET_STD)])

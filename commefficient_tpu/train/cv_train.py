@@ -159,6 +159,12 @@ def get_data_loaders(args: Config):
         std = T.CIFAR10_STD if name == "CIFAR10" else T.CIFAR100_STD
         train_t = T.cifar_train_transform(mean, std)
         val_t = T.cifar_val_transform(mean, std)
+    elif name == "EMNIST":
+        train_t = T.femnist_train_transform()
+        val_t = T.femnist_val_transform()
+    elif name == "ImageNet":
+        train_t = T.imagenet_train_transform()
+        val_t = T.imagenet_val_transform()
 
     cls = get_dataset_cls(name)
     common = dict(do_iid=args.do_iid, num_clients=args.num_clients,
@@ -182,11 +188,15 @@ def build_model(args: Config, rng=None):
     kw = dict(num_classes=num_classes)
     if args.model == "ResNet9":
         kw["do_batchnorm"] = args.do_batchnorm
-        if args.do_test:
-            kw.update(model_cls.test_config(num_classes))
+    if args.do_test and hasattr(model_cls, "test_config"):
+        kw.update(model_cls.test_config(num_classes))
     module = model_cls(**kw)
     rng = rng if rng is not None else jax.random.PRNGKey(args.seed)
-    sample_shape = (1, 32, 32, 3)
+    # EMNIST is 28x28 grayscale, ImageNet 224x224 (reference dataset
+    # table at utils.py:37-41 + transforms.py)
+    sample_shape = {"EMNIST": (1, 28, 28, 1),
+                    "ImageNet": (1, 224, 224, 3)}.get(
+        args.dataset_name, (1, 32, 32, 3))
     variables = module.init(rng, jnp.zeros(sample_shape), train=True)
     params = variables["params"]
     init_stats = variables.get("batch_stats")

@@ -1,0 +1,133 @@
+"""FedEMNIST (LEAF format) + FedImageNet + new transform stacks,
+driven off synthetic on-disk fixtures (SURVEY.md §2.5)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.data import get_dataset_cls
+from commefficient_tpu.data.fed_sampler import FedSampler
+from commefficient_tpu.data.loader import FedLoader
+
+
+def make_leaf_dir(root, n_clients=4, per_client=(3, 5, 2, 7),
+                  n_test=6, seed=0):
+    rng = np.random.RandomState(seed)
+    for split, counts in (("train", per_client),
+                          ("test", [n_test // 2, n_test - n_test // 2])):
+        d = os.path.join(root, split)
+        os.makedirs(d, exist_ok=True)
+        user_data = {}
+        for u, n in enumerate(counts):
+            user_data[f"writer{u}"] = {
+                "x": rng.rand(n, 784).tolist(),
+                "y": rng.randint(0, 62, n).tolist(),
+            }
+        with open(os.path.join(d, "shard0.json"), "w") as f:
+            json.dump({"users": list(user_data),
+                       "user_data": user_data}, f)
+
+
+class TestFedEMNIST:
+    @pytest.fixture()
+    def ds_dir(self, tmp_path):
+        make_leaf_dir(str(tmp_path))
+        return str(tmp_path)
+
+    def test_natural_partition(self, ds_dir):
+        cls = get_dataset_cls("EMNIST")
+        ds = cls(ds_dir, "EMNIST", train=True)
+        assert ds.num_clients == 4
+        assert list(ds.images_per_client) == [3, 5, 2, 7]
+        assert len(ds) == 17
+        cid, img, target = ds[3]  # first item of client 1
+        assert cid == 1
+        assert img.shape == (28, 28, 1)
+        assert 0 <= target < 62
+
+    def test_val_items(self, ds_dir):
+        cls = get_dataset_cls("EMNIST")
+        ds = cls(ds_dir, "EMNIST", train=False)
+        assert len(ds) == 6
+        cid, img, target = ds[0]
+        assert cid == -1 and img.shape == (28, 28, 1)
+
+    def test_round_batches_flow(self, ds_dir):
+        cls = get_dataset_cls("EMNIST")
+        ds = cls(ds_dir, "EMNIST", train=True)
+        sampler = FedSampler(ds, num_workers=2, local_batch_size=2,
+                             seed=0)
+        loader = FedLoader(ds, sampler)
+        batch = next(iter(loader))
+        assert batch["x"].shape[:2] == (2, 2)
+        assert batch["x"].shape[2:] == (28, 28, 1)
+
+    def test_iid_resplit(self, ds_dir):
+        cls = get_dataset_cls("EMNIST")
+        ds = cls(ds_dir, "EMNIST", train=True, do_iid=True,
+                 num_clients=3, seed=1)
+        assert ds.num_clients == 3
+        ids = sorted({ds[i][0] for i in range(len(ds))})
+        assert ids == [0, 1, 2]
+
+
+class TestFedImageNet:
+    @pytest.fixture()
+    def ds_dir(self, tmp_path):
+        from PIL import Image
+        rng = np.random.RandomState(0)
+        for split, counts in (("train", (3, 2)), ("val", (1, 1))):
+            for ci, wnid in enumerate(["n01440764", "n01443537"]):
+                d = tmp_path / split / wnid
+                d.mkdir(parents=True)
+                for i in range(counts[ci]):
+                    arr = rng.randint(0, 255, (32, 40, 3), np.uint8)
+                    Image.fromarray(arr).save(d / f"img{i}.JPEG")
+        return str(tmp_path)
+
+    def test_stats_only_prep_and_items(self, ds_dir):
+        cls = get_dataset_cls("ImageNet")
+        ds = cls(ds_dir, "ImageNet", train=True)
+        assert list(ds.images_per_client) == [3, 2]
+        cid, img, target = ds[4]  # second image of wnid 1
+        assert cid == 1 and target == 1
+        assert img.shape == (32, 40, 3)
+        with open(os.path.join(ds_dir, "stats.json")) as f:
+            stats = json.load(f)
+        assert stats["num_val_images"] == 2
+
+    def test_refuses_overwrite(self, ds_dir):
+        cls = get_dataset_cls("ImageNet")
+        ds = cls(ds_dir, "ImageNet", train=True)
+        with pytest.raises(RuntimeError):
+            ds.prepare_datasets()
+
+    def test_val_transform_pipeline(self, ds_dir):
+        from commefficient_tpu.data import transforms as T
+        cls = get_dataset_cls("ImageNet")
+        ds = cls(ds_dir, "ImageNet", train=False,
+                 transform=T.imagenet_val_transform())
+        cid, img, target = ds[0]
+        assert img.shape == (224, 224, 3)
+        assert img.dtype == np.float32
+
+
+class TestTransforms:
+    def test_femnist_train_shapes(self):
+        from commefficient_tpu.data import transforms as T
+        rng = np.random.RandomState(0)
+        t = T.femnist_train_transform(rng=np.random.RandomState(1))
+        x = rng.rand(28, 28, 1).astype(np.float32)
+        out = t(x)
+        assert out.shape == (28, 28, 1)
+        assert np.isfinite(out).all()
+
+    def test_resize_center_crop(self):
+        from commefficient_tpu.data import transforms as T
+        x = np.zeros((100, 60, 3), np.uint8)
+        out = T.Resize(50)(x)
+        assert min(out.shape[:2]) == 50
+        out = T.CenterCrop(40)(out)
+        assert out.shape[:2] == (40, 40)
