@@ -208,5 +208,5 @@ def imagenet_train_transform(rng=None):
 
 
 def imagenet_val_transform():
-    return Compose([Resize(int(224 * 1.14)), CenterCrop(224), ToFloat(),
+    return Compose([Resize(256), CenterCrop(224), ToFloat(),
                     Normalize(IMAGENET_MEAN, IMAGENET_STD)])

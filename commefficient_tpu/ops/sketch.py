@@ -106,6 +106,13 @@ class CountSketch:
     # error feedback re-surfaces missed heavy hitters next round — but
     # off by default for exact reference parity.
     approx_topk: bool = False
+    # "auto" | "xla" | "pallas" | "pallas_interpret": auto picks the
+    # fused Pallas kernels (ops/sketch_pallas.py) on TPU when the
+    # geometry supports them (c lane-aligned, table VMEM-resident) and
+    # the roll-based XLA path otherwise. Identical hash streams; sketch
+    # tables agree to ULP-level summation-order tolerance, recovery
+    # from a given table is bit-exact.
+    backend: str = "auto"
 
     def __post_init__(self):
         assert self.d > 0 and self.c > 0 and self.r > 0
@@ -167,11 +174,29 @@ class CountSketch:
 
     # --- sketching (accumulateVec) --------------------------------------
 
+    def _resolve_backend(self) -> str:
+        if self.backend != "auto":
+            return self.backend
+        from commefficient_tpu.ops.sketch_pallas import supported
+        if not supported(self.d, self.c, self.r):
+            return "xla"
+        # allowlist: Mosaic kernels only lower on TPU ("axon" is the
+        # tunneled-TPU platform name under the remote relay)
+        platform = jax.devices()[0].platform
+        return "pallas" if platform in ("tpu", "axon") else "xla"
+
     def sketch(self, v: jax.Array) -> jax.Array:
         """Dense (d,) vector -> (r, c) sketch table, scatter-free."""
         assert v.shape == (self.d,), v.shape
         m, c = self._m, self.c
         vp = jnp.pad(v.astype(jnp.float32), (0, self._padded_d - self.d))
+        backend = self._resolve_backend()
+        if backend in ("pallas", "pallas_interpret"):
+            from commefficient_tpu.ops.sketch_pallas import sketch_pallas
+            _, sign_seed = self._seeds()
+            return sketch_pallas(vp, jnp.asarray(self._rotations()),
+                                 c, self.r, int(sign_seed),
+                                 backend == "pallas_interpret")
         rot = self._rotations()  # host constants -> static rolls
 
         if m <= _UNROLL_LIMIT:
@@ -210,6 +235,14 @@ class CountSketch:
         (r, padded_d): fine up to tens of millions of coords."""
         assert table.shape == (self.r, self.c), table.shape
         m, c = self._m, self.c
+        backend = self._resolve_backend()
+        if backend in ("pallas", "pallas_interpret"):
+            from commefficient_tpu.ops.sketch_pallas import estimates_pallas
+            _, sign_seed = self._seeds()
+            est = estimates_pallas(table, jnp.asarray(self._rotations()),
+                                   c, self.r, int(sign_seed),
+                                   backend == "pallas_interpret")
+            return est[: self.d]
         rot = self._rotations()
 
         if m <= _UNROLL_LIMIT:

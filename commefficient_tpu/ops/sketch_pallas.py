@@ -1,0 +1,215 @@
+"""Pallas TPU kernels for the count-sketch hot path.
+
+The XLA formulation in :mod:`commefficient_tpu.ops.sketch` materialises
+an ``(r, padded_d)`` intermediate for recovery (~140 MB at the flagship
+ResNet9 geometry) and re-reads the signed vector once per row when
+sketching. These kernels fuse sign generation (murmur mix of the
+coordinate index, computed in-register), the per-(row, chunk) rotation,
+and the accumulate/median into single passes:
+
+- ``sketch_pallas``: one streamed read of the (padded) vector, table
+  accumulated in VMEM across the chunk grid — HBM traffic ~= |v| + |table|
+  instead of r·|v|.
+- ``estimates_pallas``: table stays VMEM-resident across the chunk grid;
+  the (r, padded_d) estimate tensor is never materialised — each chunk's
+  r rolled/sign-corrected rows are medianed in-register (odd-even
+  transposition network) and written once.
+
+Hash-identity contract: identical rotation/sign streams to the XLA
+path, so Pallas and XLA replicas can mix freely under ``psum``. Tables
+match to ULP-level tolerance (chunk summation order differs); recovery
+from a given table is bit-exact. Property-tested in
+tests/test_pallas_sketch.py.
+
+Rotation trick: a chunk of width c is viewed as a 2-D ``(S, L)`` tile
+(L a multiple of 128, so lane-aligned). A 1-D circular shift by
+``o = a·L + b`` decomposes into two sublane rolls (a, a+1), a lane roll
+(b) of each, and a lane-index select — all supported by Mosaic's
+``dynamic_rotate`` at any alignment, unlike a flat 1-D rotate of
+unaligned width. Requires ``c % 128 == 0`` (the auto backend falls back
+to XLA otherwise, e.g. for the reference's default c=500000).
+
+Reference provenance: this implements the same operator as the
+reference's external CUDA ``csvec`` library (fed_aggregator.py:466-469,
+fed_worker.py:315-322) — see SURVEY.md §2.9.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+
+# table must stay VMEM-resident for the estimates kernel; leave room
+# for the chunk block + temporaries under the ~16 MB scoped budget
+_TABLE_VMEM_LIMIT = 12 * 1024 * 1024
+
+
+def _pick_lanes(c: int) -> int | None:
+    """Widest lane-aligned factorisation of the chunk width."""
+    for L in (1024, 512, 256, 128):
+        if c % L == 0:
+            return L
+    return None
+
+
+def supported(d: int, c: int, r: int) -> bool:
+    """Whether the Pallas backend can run this geometry (else XLA).
+
+    The table limit is empirical: the estimates kernel also streams r
+    per-chunk value arrays through the median network, but Mosaic's
+    scheduler handles the flagship r=5, c=2^19 case (10.5 MB table) on
+    v5e. Geometries pushing right up to the limit may still OOM VMEM
+    at compile — set backend="xla" explicitly there. The m bound keeps
+    the (r, m) rotation table within SMEM."""
+    L = _pick_lanes(c)
+    if L is None or 4 * r * c > _TABLE_VMEM_LIMIT:
+        return False
+    m = -(-d // c)
+    return r * m <= 2048
+
+
+def _mix_u32(x):
+    """murmur3 fmix32 — must match ops.sketch._mix bit-for-bit."""
+    x = x ^ (x >> 16)
+    x = x * _M1
+    x = x ^ (x >> 13)
+    x = x * _M2
+    x = x ^ (x >> 16)
+    return x
+
+
+def _signs_chunk(t, row: int, sign_seed: np.uint32, c: int, S: int, L: int):
+    """(S, L) float32 ±1 signs for chunk ``t`` of row ``row`` —
+    replicates ops.sketch.CountSketch._signs_row on global indices
+    ``t*c + s*L + l``. ``row`` is a Python int; ``t`` is traced."""
+    s_idx = jax.lax.broadcasted_iota(jnp.uint32, (S, L), 0)
+    l_idx = jax.lax.broadcasted_iota(jnp.uint32, (S, L), 1)
+    g = t.astype(jnp.uint32) * jnp.uint32(c) + s_idx * jnp.uint32(L) + l_idx
+    row_const = (np.uint32((row * 0x9E3779B9) & 0xFFFFFFFF) ^ sign_seed)
+    h = _mix_u32(g ^ jnp.uint32(row_const))
+    # Mosaic has no uint32->f32 cast; the bit is 0/1 so int32 is safe
+    bit = ((h >> 16) & 1).astype(jnp.int32)
+    return 1.0 - 2.0 * bit.astype(jnp.float32)
+
+
+def _roll1d(x, o, S: int, L: int):
+    """Circular shift of the flattened (S, L) tile by traced ``o``
+    (0 <= o < S*L): sublane rolls a / a+1, lane roll b, lane select."""
+    a = o // L
+    b = o % L
+    P = pltpu.roll(x, shift=a, axis=0)
+    Q = pltpu.roll(x, shift=a + 1, axis=0)
+    R1 = pltpu.roll(P, shift=b, axis=1)
+    R2 = pltpu.roll(Q, shift=b, axis=1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
+    return jnp.where(lane < b, R2, R1)
+
+
+def _median_network(vals):
+    """Elementwise median of a list of same-shape arrays via odd-even
+    transposition (r is small: <= ~8). Matches jnp.median: middle
+    element for odd r, mean of the two middles for even r."""
+    v = list(vals)
+    n = len(v)
+    if n == 1:
+        return v[0]
+    for rnd in range(n):
+        start = rnd % 2
+        for i in range(start, n - 1, 2):
+            lo = jnp.minimum(v[i], v[i + 1])
+            hi = jnp.maximum(v[i], v[i + 1])
+            v[i], v[i + 1] = lo, hi
+    if n % 2 == 1:
+        return v[n // 2]
+    return 0.5 * (v[n // 2 - 1] + v[n // 2])
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
+                  interpret: bool = False, lanes: int | None = None):
+    """(padded_d,) signed-rotate-accumulate -> (r, c) table.
+
+    ``vp`` is the zero-padded flat vector (padded_d = m*c); ``rot`` is
+    the (r, m) int32 host-derived rotation table (static per operator,
+    passed as an array so the kernel is geometry-cached)."""
+    L = lanes or _pick_lanes(c)
+    assert L is not None and c % L == 0
+    S = c // L
+    m = vp.size // c
+    seed = np.uint32(sign_seed)
+
+    def kernel(rot_ref, v_ref, out_ref):
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        chunk = v_ref[:]  # (S, L) chunk t, streamed
+        for row in range(r):
+            signed = chunk * _signs_chunk(t, row, seed, c, S, L)
+            rolled = _roll1d(signed, rot_ref[row, t], S, L)
+            sl = slice(row * S, (row + 1) * S)
+            out_ref[sl, :] = out_ref[sl, :] + rolled
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(m,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((S, L), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r * S, L), lambda t: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r * S, L), jnp.float32),
+        interpret=interpret,
+    )(rot.astype(jnp.int32), vp.astype(jnp.float32).reshape(m * S, L))
+    return out.reshape(r, c)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
+                     interpret: bool = False, lanes: int | None = None):
+    """(r, c) table -> (padded_d,) median-of-rows estimates, fused
+    (the (r, padded_d) intermediate of the XLA path never exists)."""
+    L = lanes or _pick_lanes(c)
+    assert L is not None and c % L == 0
+    S = c // L
+    m = rot.shape[1]
+    seed = np.uint32(sign_seed)
+
+    def kernel(rot_ref, tab_ref, out_ref):
+        t = pl.program_id(0)
+        vals = []
+        for row in range(r):
+            trow = tab_ref[row * S:(row + 1) * S, :]
+            o = rot_ref[row, t]
+            back = (jnp.int32(c) - o) % jnp.int32(c)
+            unrolled = _roll1d(trow, back, S, L)
+            vals.append(unrolled * _signs_chunk(t, row, seed, c, S, L))
+        out_ref[:] = _median_network(vals)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(m,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            # table resident in VMEM across all chunk steps
+            pl.BlockSpec((r * S, L), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((S, L), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m * S, L), jnp.float32),
+        interpret=interpret,
+    )(rot.astype(jnp.int32), table.astype(jnp.float32).reshape(r * S, L))
+    return out.reshape(m * c)

@@ -1,0 +1,84 @@
+"""Pallas sketch kernels vs the XLA rotation-sketch path.
+
+The contract is hash-identity: identical rotation/sign streams, so
+Pallas- and XLA-sketched tables may be psum-mixed. Chunk summation
+order differs between the two (sequential grid accumulation vs XLA's
+tree reduce), so sketch tables match to ULP-level tolerance; recovery
+from a given table is a pure permutation + median and matches
+bit-for-bit. On CPU the kernels run in interpreter mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.ops.sketch import CountSketch
+from commefficient_tpu.ops.sketch_pallas import supported
+
+GEOMS = [
+    # (d, c, r) — c lane-aligned (multiple of 128), table VMEM-sized
+    (5000, 1024, 3),
+    (300, 128, 5),      # d > padded? no: m=3 chunks of 128
+    (4096, 4096, 1),    # single chunk, single row
+    (70000, 2048, 4),   # even r -> median averages two middles
+]
+
+
+def _pair(d, c, r):
+    xla = CountSketch(d=d, c=c, r=r, seed=7, backend="xla")
+    pal = CountSketch(d=d, c=c, r=r, seed=7, backend="pallas_interpret")
+    return xla, pal
+
+
+@pytest.mark.parametrize("d,c,r", GEOMS)
+def test_sketch_table_matches(d, c, r):
+    assert supported(d, c, r)
+    xla, pal = _pair(d, c, r)
+    v = jnp.asarray(np.random.RandomState(0).randn(d).astype(np.float32))
+    tx, tp = np.asarray(xla.sketch(v)), np.asarray(pal.sketch(v))
+    # same hash streams; only chunk-sum order differs (ULP-level)
+    np.testing.assert_allclose(tx, tp, rtol=1e-6, atol=1e-5)
+
+
+@pytest.mark.parametrize("d,c,r", GEOMS)
+def test_estimates_bit_exact(d, c, r):
+    xla, pal = _pair(d, c, r)
+    rng = np.random.RandomState(1)
+    table = jnp.asarray(rng.randn(r, c).astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(xla.estimates(table)),
+                                  np.asarray(pal.estimates(table)))
+
+
+def test_unsketch_from_shared_table_bit_exact():
+    d, c, r, k = 5000, 1024, 3, 20
+    xla, pal = _pair(d, c, r)
+    rng = np.random.RandomState(2)
+    v = np.zeros(d, np.float32)
+    hh = rng.choice(d, k, replace=False)
+    v[hh] = rng.randn(k).astype(np.float32) * 100
+    v += rng.randn(d).astype(np.float32) * 0.01
+    table = xla.sketch(jnp.asarray(v))  # one table, both recoveries
+    out_x = xla.unsketch(table, k)
+    out_p = pal.unsketch(table, k)
+    np.testing.assert_array_equal(np.asarray(out_x), np.asarray(out_p))
+    # and the heavy hitters were actually recovered
+    recovered = set(np.nonzero(np.asarray(out_p))[0])
+    assert len(recovered & set(hh.tolist())) >= int(0.9 * k)
+
+
+def test_unsupported_geometry_falls_back():
+    # the reference default c=500000 is not lane-aligned -> XLA path
+    assert not supported(6_500_000, 500_000, 5)
+    cs = CountSketch(d=1000, c=500, r=3, backend="auto")
+    assert cs._resolve_backend() == "xla"  # c % 128 != 0
+
+
+def test_pallas_linearity():
+    d, c, r = 5000, 1024, 3
+    _, pal = _pair(d, c, r)
+    rng = np.random.RandomState(3)
+    a = jnp.asarray(rng.randn(d).astype(np.float32))
+    b = jnp.asarray(rng.randn(d).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(pal.sketch(a) + pal.sketch(b)),
+        np.asarray(pal.sketch(a + b)), rtol=1e-5, atol=1e-5)
