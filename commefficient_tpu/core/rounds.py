@@ -148,9 +148,8 @@ def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh):
     W = transmit.shape[0]
     if mesh is not None and W % mesh.devices.size == 0 \
             and mesh.devices.size > 1:
-        from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
-        from commefficient_tpu.parallel.mesh import CLIENT_AXIS
+        from commefficient_tpu.parallel.mesh import CLIENT_AXIS, shard_map
 
         def block(local):  # (W/n_dev, d) on each device
             table = sketch.sketch(jnp.sum(local, axis=0))

@@ -39,6 +39,17 @@ class GPT2Config:
     n_head: int = 12
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
+    # Sequence/context parallelism (a capability the reference lacks,
+    # SURVEY.md §2.8): set to a mesh axis name and call the model
+    # inside shard_map with input_ids sharded on T over that axis.
+    # Attention runs as ring attention ("ring") or all-to-all Ulysses
+    # ("ulysses", needs n_head % axis_size == 0); position embeddings
+    # and the MC-head gather become global-position aware. Hidden
+    # states / LM logits stay sequence-sharded inside the model — use
+    # an out_spec partitioned on T to reassemble, or keep them sharded
+    # for a distributed loss.
+    seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
 
     @staticmethod
     def tiny() -> "GPT2Config":
@@ -78,7 +89,14 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, T, H, C // H)
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
-        out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        if self.cfg.seq_axis is not None:
+            from commefficient_tpu.parallel.ring_attention import (
+                ring_attention, ulysses_attention)
+            attn = (ring_attention if self.cfg.seq_impl == "ring"
+                    else ulysses_attention)
+            out = attn(q, k, v, self.cfg.seq_axis, causal=True)
+        else:
+            out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape(B, T, C)
         return nn.Dense(C, kernel_init=_dense_init(self.cfg),
                         name="c_proj")(out)
@@ -108,7 +126,11 @@ class GPT2Transformer(nn.Module):
                          (cfg.vocab_size, cfg.n_embd))
         wpe = self.param("wpe", _dense_init(cfg),
                          (cfg.n_positions, cfg.n_embd))
-        h = wte[input_ids] + wpe[jnp.arange(T)][None]
+        pos = jnp.arange(T)
+        if cfg.seq_axis is not None:
+            # T here is the local shard; offset to global positions
+            pos = pos + jax.lax.axis_index(cfg.seq_axis) * T
+        h = wte[input_ids] + wpe[pos][None]
         if token_type_ids is not None:
             # token types index the same embedding table, GPT-2 style
             h = h + wte[token_type_ids]
@@ -136,9 +158,20 @@ class GPT2DoubleHeads(nn.Module):
         lm_logits = lm_logits.reshape(B, N, T, -1)
 
         h = h.reshape(B, N, T, -1)
-        idx = jnp.clip(mc_token_ids, 0, T - 1)
-        cls_h = jnp.take_along_axis(
-            h, idx[..., None, None], axis=2)[:, :, 0]  # (B, N, C)
+        if self.cfg.seq_axis is not None:
+            # mc_token_ids are GLOBAL positions; the owning shard
+            # contributes its hidden state, psum broadcasts it
+            ax = self.cfg.seq_axis
+            n_shards = jax.lax.axis_size(ax)
+            gpos = jax.lax.axis_index(ax) * T + jnp.arange(T)
+            idx = jnp.clip(mc_token_ids, 0, n_shards * T - 1)
+            sel = (gpos[None, None, :] == idx[..., None]).astype(h.dtype)
+            cls_h = jax.lax.psum(
+                jnp.einsum("bnt,bntc->bnc", sel, h), ax)
+        else:
+            idx = jnp.clip(mc_token_ids, 0, T - 1)
+            cls_h = jnp.take_along_axis(
+                h, idx[..., None, None], axis=2)[:, :, 0]  # (B, N, C)
         mc_logits = nn.Dense(1, kernel_init=_dense_init(self.cfg),
                              name="mc_head")(cls_h)[..., 0]  # (B, N)
         return lm_logits, mc_logits

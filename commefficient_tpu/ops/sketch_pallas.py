@@ -44,8 +44,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_M1 = np.uint32(0x85EBCA6B)
-_M2 = np.uint32(0xC2B2AE35)
+from commefficient_tpu.ops.sketch import _mix as _mix_u32  # noqa: E402
+# (single source of truth for the murmur mix: the psum-mixing contract
+# requires the Pallas and XLA sign streams to stay bit-identical)
 
 # table must stay VMEM-resident for the estimates kernel; leave room
 # for the chunk block + temporaries under the ~16 MB scoped budget
@@ -74,16 +75,6 @@ def supported(d: int, c: int, r: int) -> bool:
         return False
     m = -(-d // c)
     return r * m <= 2048
-
-
-def _mix_u32(x):
-    """murmur3 fmix32 — must match ops.sketch._mix bit-for-bit."""
-    x = x ^ (x >> 16)
-    x = x * _M1
-    x = x ^ (x >> 13)
-    x = x * _M2
-    x = x ^ (x >> 16)
-    return x
 
 
 def _signs_chunk(t, row: int, sign_seed: np.uint32, c: int, S: int, L: int):

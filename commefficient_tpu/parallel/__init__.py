@@ -3,3 +3,7 @@ from commefficient_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicated,
 )
+from commefficient_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
