@@ -2,7 +2,12 @@ from commefficient_tpu.data.fed_dataset import FedDataset  # noqa: F401
 from commefficient_tpu.data.fed_cifar import FedCIFAR10, FedCIFAR100  # noqa: F401
 from commefficient_tpu.data.synthetic import FedSynthetic  # noqa: F401
 from commefficient_tpu.data.fed_sampler import FedSampler  # noqa: F401
-from commefficient_tpu.data.loader import FedLoader, ValLoader  # noqa: F401
+from commefficient_tpu.data.loader import (  # noqa: F401
+    FedLoader,
+    NativeFedLoader,
+    ValLoader,
+    make_fed_loader,
+)
 
 DATASET_REGISTRY = {
     "CIFAR10": FedCIFAR10,

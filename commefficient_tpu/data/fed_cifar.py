@@ -76,6 +76,15 @@ class FedCIFAR10(FedDataset):
         # fed_cifar.py:80)
         return self._clients[client_id][idx_within_client], int(client_id)
 
+    def dense_train_view(self):
+        cached = getattr(self, "_dense_view_cache", None)
+        if cached is None:
+            imgs = np.concatenate(self._clients)
+            tgts = np.repeat(np.arange(len(self._clients), dtype=np.int32),
+                             [len(c) for c in self._clients])
+            self._dense_view_cache = (imgs, tgts)
+        return self._dense_view_cache
+
     def _get_val_item(self, idx):
         return self._test_x[idx], int(self._test_y[idx])
 

@@ -131,6 +131,35 @@ class FedDataset:
             image = self.transform(image)
         return client_id, image, target
 
+    def dense_train_view(self):
+        """(images (N, ...), targets (N,) int32) in global *pre-iid*
+        train-index order — the storage the native C++ data-plane
+        gathers from (commefficient_tpu/native). Raw records, no
+        transform. Subclasses with contiguous storage should override
+        (FedCIFAR does); this generic path materialises once."""
+        cached = getattr(self, "_dense_view_cache", None)
+        if cached is not None:
+            return cached
+        cumsum = self._ipc_cumsum
+        n = int(sum(self.images_per_client))
+        imgs, tgts = None, np.zeros(n, np.int32)
+        for idx in range(n):
+            nat = int(np.searchsorted(cumsum, idx, side="right"))
+            start = cumsum[nat - 1] if nat else 0
+            img, t = self._get_train_item(nat, idx - start)
+            img = np.asarray(img)
+            if imgs is None:
+                imgs = np.zeros((n,) + img.shape, img.dtype)
+            imgs[idx] = img
+            tgts[idx] = t
+        self._dense_view_cache = (imgs, tgts)
+        return self._dense_view_cache
+
+    def storage_row(self, idx):
+        """Map a sampled global train index to its dense_train_view
+        row (identity unless --iid permuted)."""
+        return self.iid_shuffle[idx] if self.do_iid else idx
+
     def stats_fn(self):
         return os.path.join(self.dataset_dir, "stats.json")
 

@@ -20,7 +20,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 __all__ = ["FedLoader", "ValLoader", "PersonaFedLoader",
-           "PersonaValLoader"]
+           "PersonaValLoader", "NativeFedLoader", "make_fed_loader"]
 
 
 class _RoundLoaderBase:
@@ -82,6 +82,101 @@ class FedLoader(_RoundLoaderBase):
                 y[i, j] = target
                 mask[i, j] = 1.0
         return {"client_ids": ids, "x": x, "y": y, "mask": mask}
+
+
+class NativeFedLoader(_RoundLoaderBase):
+    """CV rounds assembled by the C++ data-plane with threaded
+    prefetch (commefficient_tpu/native): gather + reflect-pad random
+    crop + flip + normalize run GIL-free while the device steps.
+
+    Same batch dict contract as FedLoader. Augmentation RNG is the
+    native splitmix64 stream (deterministic per seed, a different
+    stream than the numpy transforms); with augmentation off the
+    output matches FedLoader bit-for-bit — tested in
+    tests/test_native_dataplane.py.
+
+    Raises RuntimeError when the toolchain/transform/dataset don't
+    support the native path — use :func:`make_fed_loader` for the
+    auto-fallback.
+    """
+
+    def __init__(self, dataset, sampler,
+                 max_batch_size: Optional[int] = None,
+                 seed: int = 0, depth: int = 4, n_threads: int = 2):
+        super().__init__(dataset, sampler, max_batch_size)
+        from commefficient_tpu import native
+
+        if not native.available():
+            raise RuntimeError("native dataplane unavailable (no g++?)")
+        spec = native.native_transform_spec(dataset.transform)
+        if spec is None:
+            raise RuntimeError("transform not native-representable")
+        images, targets = dataset.dense_train_view()
+        if images.ndim != 4 or images.shape[1] != images.shape[2]:
+            raise RuntimeError(
+                "native path needs square (N, H, H, C) storage, got "
+                f"{images.shape}")
+        if spec["crop_size"] is not None \
+                and spec["crop_size"] != images.shape[1]:
+            # the native kernel crops back to the image's own size
+            raise RuntimeError("crop size != image size")
+        self.plane = native.NativeDataplane(
+            images, targets, self.W, self.B,
+            spec["mean"], spec["std"],
+            crop_pad=spec["crop_pad"], do_flip=spec["do_flip"])
+        self.seed = seed
+        self.depth, self.n_threads = depth, n_threads
+        self._round_counter = 0
+
+    def _spec_to_indices(self, round_spec):
+        idx = np.full((self.W, self.B), -1, np.int64)
+        ids = np.zeros((self.W,), np.int32)
+        for i, (cid, idxs) in enumerate(round_spec):
+            ids[i] = cid
+            rows = [self.dataset.storage_row(int(ix))
+                    for ix in idxs[: self.B]]
+            idx[i, : len(rows)] = rows
+        return ids, idx
+
+    def __iter__(self):
+        from commefficient_tpu import native
+
+        with native.Prefetcher(self.plane, self.depth,
+                               self.n_threads) as pf:
+            pending: list = []
+            for round_spec in self.sampler:
+                if len(round_spec) < self.W:
+                    continue
+                ids, idx = self._spec_to_indices(round_spec)
+                pf.submit(idx, self.seed + self._round_counter)
+                self._round_counter += 1
+                pending.append(ids)
+                if len(pending) > self.depth:
+                    yield self._pop(pf, pending)
+            while pending:
+                yield self._pop(pf, pending)
+
+    def _pop(self, pf, pending):
+        ids = pending.pop(0)
+        x, y, m = pf.pop()
+        return {"client_ids": ids, "x": x, "y": y, "mask": m}
+
+
+def make_fed_loader(dataset, sampler, max_batch_size=None, seed=0,
+                    prefer_native=True):
+    """NativeFedLoader when the C++ path applies, FedLoader otherwise.
+    The fallback is logged (once per call site reason) so a silently
+    slow data path is visible; genuine bugs (TypeError etc.) still
+    propagate."""
+    if prefer_native:
+        try:
+            return NativeFedLoader(dataset, sampler, max_batch_size,
+                                   seed=seed)
+        except RuntimeError as e:
+            import warnings
+            warnings.warn(f"native data-plane unavailable ({e}); "
+                          "using the Python loader")
+    return FedLoader(dataset, sampler, max_batch_size)
 
 
 class PersonaFedLoader(_RoundLoaderBase):

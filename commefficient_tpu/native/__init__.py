@@ -1,0 +1,244 @@
+"""Native (C++) federated data-plane bindings.
+
+Builds ``fed_dataplane.cpp`` on first use with the in-image g++ (no
+pybind11 — plain C ABI via ctypes; ctypes releases the GIL around
+calls, so ring pops block without stalling Python). Falls back cleanly
+when no toolchain is available: callers must check :func:`available`.
+
+Counterpart of the reference's native data plumbing (multiprocessing
+queues + torchvision C++ transform kernels, SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "fed_dataplane.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LOCK = threading.Lock()
+_lib_handle = None
+_build_failed = False
+
+
+def _compile() -> Optional[str]:
+    so = os.path.join(_BUILD_DIR, "libfed_dataplane.so")
+    try:
+        if (os.path.exists(so)
+                and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+            return so
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+             "-pthread", _SRC, "-o", so + ".tmp"],
+            check=True, capture_output=True)
+        os.replace(so + ".tmp", so)
+        return so
+    except (OSError, subprocess.CalledProcessError):
+        return None
+
+
+def _lib():
+    global _lib_handle, _build_failed
+    with _LOCK:
+        if _lib_handle is not None or _build_failed:
+            return _lib_handle
+        so = _compile()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        ci = ctypes.c_int
+        i64 = ctypes.c_int64
+        lib.cet_assemble_round.argtypes = [
+            u8p, f32p, i32p, i64, ci, ci, ci, ci, ci, ci, ci,
+            f32p, f32p, i64p, ctypes.c_uint64, f32p, i32p, f32p]
+        lib.cet_assemble_round.restype = ctypes.c_int
+        lib.cet_ring_create.argtypes = [
+            u8p, f32p, i32p, i64, ci, ci, ci, ci, ci, ci, ci,
+            f32p, f32p, ci, ci]
+        lib.cet_ring_create.restype = ctypes.c_void_p
+        lib.cet_ring_submit.argtypes = [ctypes.c_void_p, i64p,
+                                        ctypes.c_uint64]
+        lib.cet_ring_submit.restype = None
+        lib.cet_ring_pop.argtypes = [ctypes.c_void_p, f32p, i32p, f32p]
+        lib.cet_ring_pop.restype = ctypes.c_int64
+        lib.cet_ring_oob.argtypes = [ctypes.c_void_p]
+        lib.cet_ring_oob.restype = ctypes.c_longlong
+        lib.cet_ring_destroy.argtypes = [ctypes.c_void_p]
+        lib.cet_ring_destroy.restype = None
+        _lib_handle = lib
+        return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def _ptr(arr, ctype):
+    if arr is None:
+        return ctypes.cast(None, ctypes.POINTER(ctype))
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeDataplane:
+    """Round assembly over a dense in-memory image store.
+
+    ``images``: (N, H, W, C) uint8 (raw, scaled by 1/255 natively) or
+    float32 in [0, 1]. ``targets``: (N,) int32. Augmentation =
+    reflect-pad random crop (``crop_pad``) + horizontal flip
+    (``do_flip``) + per-channel normalize — the CIFAR/FEMNIST stacks.
+    """
+
+    def __init__(self, images: np.ndarray, targets: np.ndarray,
+                 slots: int, B: int, mean, std,
+                 crop_pad: int = 0, do_flip: bool = False):
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError("native dataplane unavailable")
+        if images.ndim != 4:
+            raise RuntimeError(
+                f"need (N, H, W, C) images, got {images.shape}")
+        self._lib = lib
+        # keep alive: the C side borrows these buffers
+        self.images = np.ascontiguousarray(images)
+        self.targets = np.ascontiguousarray(targets, dtype=np.int32)
+        self.slots, self.B = slots, B
+        _, self.H, self.W, self.C = self.images.shape
+        assert self.C <= 8
+        self.mean = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(mean, np.float32), (self.C,)))
+        self.std = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(std, np.float32), (self.C,)))
+        self.crop_pad, self.do_flip = crop_pad, int(do_flip)
+        if self.images.dtype == np.uint8:
+            self._u8, self._f32 = self.images, None
+        elif self.images.dtype == np.float32:
+            self._u8, self._f32 = None, self.images
+        else:
+            raise RuntimeError(
+                f"unsupported image dtype {self.images.dtype} "
+                "(uint8 or float32)")
+
+    def _common_args(self):
+        return (_ptr(self._u8, ctypes.c_uint8),
+                _ptr(self._f32, ctypes.c_float),
+                _ptr(self.targets, ctypes.c_int32),
+                ctypes.c_int64(self.images.shape[0]),
+                self.H, self.W, self.C, self.slots, self.B,
+                self.crop_pad, self.do_flip,
+                _ptr(self.mean, ctypes.c_float),
+                _ptr(self.std, ctypes.c_float))
+
+    def _alloc_out(self):
+        x = np.empty((self.slots, self.B, self.H, self.W, self.C),
+                     np.float32)
+        y = np.empty((self.slots, self.B), np.int32)
+        m = np.empty((self.slots, self.B), np.float32)
+        return x, y, m
+
+    def assemble(self, indices: np.ndarray, seed: int):
+        """indices: (slots, B) int64 storage rows, -1 = padding."""
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        assert idx.shape == (self.slots, self.B), idx.shape
+        x, y, m = self._alloc_out()
+        oob = self._lib.cet_assemble_round(
+            *self._common_args(), _ptr(idx, ctypes.c_int64),
+            ctypes.c_uint64(seed & (2**64 - 1)),
+            _ptr(x, ctypes.c_float), _ptr(y, ctypes.c_int32),
+            _ptr(m, ctypes.c_float))
+        if oob:
+            raise IndexError(
+                f"{oob} indices out of range for {self.images.shape[0]}"
+                " stored rows")
+        return x, y, m
+
+
+class Prefetcher:
+    """Bounded ring of pre-assembled rounds, filled by C++ worker
+    threads; pops arrive strictly in submission order (deterministic
+    regardless of thread scheduling)."""
+
+    def __init__(self, plane: NativeDataplane, depth: int = 4,
+                 n_threads: int = 2):
+        self.plane = plane
+        self._handle = plane._lib.cet_ring_create(
+            *plane._common_args(), depth, n_threads)
+        assert self._handle
+
+    def submit(self, indices: np.ndarray, seed: int):
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        assert idx.shape == (self.plane.slots, self.plane.B)
+        self.plane._lib.cet_ring_submit(
+            self._handle, _ptr(idx, ctypes.c_int64),
+            ctypes.c_uint64(seed & (2**64 - 1)))
+
+    def pop(self):
+        x, y, m = self.plane._alloc_out()
+        seq = self.plane._lib.cet_ring_pop(
+            self._handle, _ptr(x, ctypes.c_float),
+            _ptr(y, ctypes.c_int32), _ptr(m, ctypes.c_float))
+        assert seq >= 0, "ring stopped"
+        oob = self.plane._lib.cet_ring_oob(self._handle)
+        if oob:
+            raise IndexError(
+                f"{oob} out-of-range indices submitted to the ring")
+        return x, y, m
+
+    def close(self):
+        if self._handle:
+            self.plane._lib.cet_ring_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_transform_spec(transform) -> Optional[dict]:
+    """Map a data/transforms.py Compose onto the native augmentation
+    pipeline, which is exactly ``ToFloat -> [RandomCrop(reflect)] ->
+    [RandomHorizontalFlip] -> Normalize`` in that order (the CIFAR /
+    FEMNIST-val stacks). Anything else — different order, missing
+    ToFloat (the native path always scales uint8 by 1/255), extra
+    ops — returns None and the caller falls back to the Python
+    loader, so the two paths can never silently diverge."""
+    from commefficient_tpu.data import transforms as T
+
+    if not isinstance(transform, T.Compose):
+        return None
+    ts = list(transform.transforms)
+    if not ts or not isinstance(ts.pop(0), T.ToFloat):
+        return None
+    crop_pad, do_flip, crop_size = 0, False, None
+    if ts and isinstance(ts[0], T.RandomCrop):
+        t = ts.pop(0)
+        if t.fill is not None:
+            return None
+        crop_pad, crop_size = t.padding, t.size
+    if ts and isinstance(ts[0], T.RandomHorizontalFlip):
+        ts.pop(0)
+        do_flip = True
+    if len(ts) != 1 or not isinstance(ts[0], T.Normalize):
+        return None
+    norm = ts[0]
+    return {"crop_pad": crop_pad, "do_flip": do_flip,
+            "crop_size": crop_size,  # must equal image H/W (checked
+            "mean": norm.mean, "std": norm.std}  # by the loader)

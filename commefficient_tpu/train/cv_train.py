@@ -176,7 +176,11 @@ def get_data_loaders(args: Config):
     sampler = FedSampler(train_ds, args.num_workers,
                          args.local_batch_size,
                          seed=args.seed)
-    train_loader = FedLoader(train_ds, sampler)
+    # C++ data-plane with threaded prefetch when the transform stack
+    # and toolchain allow; Python loader otherwise (same batch dict)
+    from commefficient_tpu.data import make_fed_loader
+    train_loader = make_fed_loader(train_ds, sampler, seed=args.seed,
+                                   prefer_native=not args.do_test)
     val_loader = ValLoader(val_ds, args.valid_batch_size,
                            shards_per_step=max(1, args.num_workers))
     return train_loader, val_loader, train_ds
