@@ -54,6 +54,9 @@ class Config:
     model: str = "ResNet9"
     do_finetune: bool = False
     do_checkpoint: bool = False
+    # full-state resume (beyond the reference's save-only checkpoints)
+    do_resume: bool = False
+    checkpoint_every: int = 0  # epochs; 0 = end of training only
     checkpoint_path: str = "./checkpoint"
     finetune_path: str = "./finetune"
     finetuned_from: Optional[str] = None
@@ -76,6 +79,11 @@ class Config:
     virtual_momentum: float = 0.0
     weight_decay: float = 5e-4
     num_epochs: float = 24.0
+    # LR-schedule horizon; defaults to num_epochs. Set it when a run
+    # will stop early and be resumed (--resume) so every invocation
+    # decays over the same total, keeping resumed training identical
+    # to an uninterrupted run.
+    schedule_epochs: Optional[float] = None
     num_fedavg_epochs: int = 1
     fedavg_batch_size: int = -1
     fedavg_lr_decay: float = 1.0
@@ -239,6 +247,9 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--finetune", action="store_true", dest="do_finetune")
     parser.add_argument("--checkpoint", action="store_true",
                         dest="do_checkpoint")
+    parser.add_argument("--resume", action="store_true",
+                        dest="do_resume")
+    parser.add_argument("--checkpoint_every", type=int, default=0)
     parser.add_argument("--checkpoint_path", type=str, default="./checkpoint")
     parser.add_argument("--finetune_path", type=str, default="./finetune")
     parser.add_argument("--finetuned_from", type=str,
@@ -265,6 +276,7 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--virtual_momentum", type=float, default=0)
     parser.add_argument("--weight_decay", type=float, default=5e-4)
     parser.add_argument("--num_epochs", type=float, default=24)
+    parser.add_argument("--schedule_epochs", type=float, default=None)
     parser.add_argument("--num_fedavg_epochs", type=int, default=1)
     parser.add_argument("--fedavg_batch_size", type=int, default=-1)
     parser.add_argument("--fedavg_lr_decay", type=float, default=1)

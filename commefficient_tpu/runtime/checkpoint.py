@@ -1,0 +1,216 @@
+"""Full-state checkpoint/resume for the federated runtime.
+
+The reference only saves final weights (``torch.save(state_dict)``,
+cv_train.py:420-423) and never optimizer/error state (SURVEY.md §5
+"Checkpoint / resume: save-only"). Here a checkpoint captures the
+complete round state:
+
+- flat ``ps_weights``
+- per-client ``ClientStates`` (velocities / errors / stale weights)
+- server ``ServerState`` (virtual momentum + error, dense or
+  sketch-shaped)
+- round / update counters, byte-accounting state, optimizer step
+  count, LR-scheduler position
+- optionally the ``FedSampler``'s RNG state, so a resumed run
+  continues the exact data order of an uninterrupted one
+
+Format: a single ``np.savez_compressed`` archive with a JSON ``meta``
+entry, written atomically (tmp + rename). Resume is bit-exact:
+tests/test_checkpoint.py checks interrupted-and-resumed training
+reproduces the uninterrupted run's weights exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Optional
+
+import jax
+import numpy as np
+
+from commefficient_tpu.core.rounds import ClientStates
+from commefficient_tpu.core.server import ServerState
+
+_FMT = 1
+
+
+def checkpoint_file(directory: str, tag: str = "state") -> str:
+    return os.path.join(directory, f"ckpt_{tag}.npz")
+
+
+def save_checkpoint(path: str, model, opt, scheduler=None,
+                    sampler=None, epoch: int = 0,
+                    extra: Optional[dict] = None,
+                    loader=None) -> str:
+    """Serialise the full runtime state to ``path`` (.npz)."""
+    arrays = {"ps_weights": np.asarray(jax.device_get(model.ps_weights))}
+    cs = model.client_states
+    for name, val in (("cs_velocities", cs.velocities),
+                      ("cs_errors", cs.errors),
+                      ("cs_weights", cs.weights)):
+        if val is not None:
+            arrays[name] = np.asarray(jax.device_get(val))
+    ss = opt.server_state
+    arrays["ss_Vvelocity"] = np.asarray(jax.device_get(ss.Vvelocity))
+    arrays["ss_Verror"] = np.asarray(jax.device_get(ss.Verror))
+    arrays["last_updated"] = model.last_updated
+    arrays["client_last_seen"] = model.client_last_seen
+
+    meta = {
+        "format": _FMT,
+        "epoch": int(epoch),
+        "round_index": int(model.round_index),
+        "update_round": int(model._update_round),
+        "fedavg_lr": float(model.fedavg_lr),
+        "opt_step_count": int(opt._step_count),
+        "mode": model.args.mode,
+        "grad_size": int(model.args.grad_size),
+        "num_clients": int(model.num_clients),
+        "extra": extra or {},
+    }
+    if scheduler is not None:
+        meta["scheduler_step"] = int(scheduler._step)
+    if sampler is not None and hasattr(sampler.rng, "get_state"):
+        state = sampler.rng.get_state()
+        meta["sampler_rng"] = [state[0], None, int(state[2]),
+                               int(state[3]), float(state[4])]
+        arrays["sampler_rng_keys"] = np.asarray(state[1])
+    # datasets with stateful per-item RNG (e.g. FedPERSONA's
+    # personality shuffles) advance it on every access — capture it or
+    # a resumed epoch sees different records than the uninterrupted run
+    ds = getattr(sampler, "dataset", None)
+    ds_rng = getattr(ds, "_rng", None)
+    if ds_rng is not None and hasattr(ds_rng, "getstate"):
+        version, internal, gauss = ds_rng.getstate()
+        meta["dataset_rng"] = [int(version), gauss]
+        arrays["dataset_rng_state"] = np.asarray(internal, np.int64)
+    # the CV transform stacks draw from the GLOBAL numpy RNG — capture
+    # it too, or augmentation replays from the re-seeded stream after
+    # resume while the uninterrupted run's stream had advanced
+    g = np.random.get_state()
+    meta["np_global_rng"] = [g[0], None, int(g[2]), int(g[3]),
+                             float(g[4])]
+    arrays["np_global_rng_keys"] = np.asarray(g[1])
+    # the native data-plane derives per-round augmentation seeds from
+    # its round counter
+    if loader is not None and hasattr(loader, "_round_counter"):
+        meta["loader_round_counter"] = int(loader._round_counter)
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, meta=json.dumps(meta), **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def load_checkpoint(path: str, model, opt, scheduler=None,
+                    sampler=None, loader=None) -> dict:
+    """Restore runtime state in place; returns the meta dict (use
+    ``meta["epoch"]`` as the resume epoch)."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        for key, want in (("format", _FMT),
+                          ("grad_size", int(model.args.grad_size)),
+                          ("mode", model.args.mode),
+                          ("num_clients", int(model.num_clients))):
+            if meta[key] != want:
+                raise ValueError(
+                    f"checkpoint {key}={meta[key]!r} does not match "
+                    f"this run's {want!r} ({path})")
+
+        import jax.numpy as jnp
+
+        from commefficient_tpu.parallel.mesh import client_sharding
+
+        # per-client state rows were sharded over the clients axis at
+        # init (FedModel.__init__) — restore with the same placement
+        csh = client_sharding(model.mesh)
+
+        def put_client_rows(arr):
+            return jax.device_put(jnp.asarray(arr), csh)
+
+        model.ps_weights = jnp.asarray(z["ps_weights"])
+        cs = model.client_states
+        model.client_states = ClientStates(
+            put_client_rows(z["cs_velocities"])
+            if "cs_velocities" in z else cs.velocities,
+            put_client_rows(z["cs_errors"])
+            if "cs_errors" in z else cs.errors,
+            put_client_rows(z["cs_weights"])
+            if "cs_weights" in z else cs.weights,
+        )
+        opt.server_state = ServerState(jnp.asarray(z["ss_Vvelocity"]),
+                                       jnp.asarray(z["ss_Verror"]))
+        model.last_updated = np.asarray(z["last_updated"])
+        model.client_last_seen = np.asarray(z["client_last_seen"])
+        model.round_index = meta["round_index"]
+        model._update_round = meta["update_round"]
+        model.fedavg_lr = meta["fedavg_lr"]
+        opt._step_count = meta["opt_step_count"]
+        if scheduler is not None and "scheduler_step" in meta:
+            scheduler._step = meta["scheduler_step"]
+        if sampler is not None and "sampler_rng" in meta:
+            s = meta["sampler_rng"]
+            sampler.rng.set_state((s[0], np.asarray(z["sampler_rng_keys"]),
+                                   s[2], s[3], s[4]))
+        ds = getattr(sampler, "dataset", None)
+        ds_rng = getattr(ds, "_rng", None)
+        if ds_rng is not None and "dataset_rng" in meta:
+            version, gauss = meta["dataset_rng"]
+            internal = tuple(int(v) for v in z["dataset_rng_state"])
+            ds_rng.setstate((version, internal, gauss))
+        if "np_global_rng" in meta:
+            g = meta["np_global_rng"]
+            np.random.set_state((g[0],
+                                 np.asarray(z["np_global_rng_keys"]),
+                                 g[2], g[3], g[4]))
+        if loader is not None and "loader_round_counter" in meta \
+                and hasattr(loader, "_round_counter"):
+            loader._round_counter = meta["loader_round_counter"]
+    return meta
+
+
+def setup_resume(args, model, opt, scheduler, loader, tag: str):
+    """Shared trainer wiring: returns ``(start_epoch, epoch_hook)``.
+
+    - ``--resume`` requires ``--checkpoint`` and an existing file —
+      anything else raises instead of silently training from scratch
+      (and then overwriting the directory's checkpoints).
+    - ``epoch_hook`` saves every ``--checkpoint_every`` epochs and at
+      the end of training.
+    """
+    import math
+
+    if not (args.do_checkpoint or args.do_resume):
+        return 0, None
+    if args.do_resume and not args.do_checkpoint:
+        raise ValueError("--resume requires --checkpoint")
+    path = checkpoint_file(args.checkpoint_path, tag)
+    sampler = getattr(loader, "sampler", None)
+    start_epoch = 0
+    if args.do_resume:
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"--resume: no checkpoint at {path}")
+        meta = load_checkpoint(path, model, opt, scheduler, sampler,
+                               loader)
+        start_epoch = meta["epoch"]
+        print(f"resumed from {path} at epoch {start_epoch}")
+
+    def epoch_hook(ep):
+        if (args.checkpoint_every
+                and ep % args.checkpoint_every == 0) \
+                or ep >= math.ceil(args.num_epochs):
+            save_checkpoint(path, model, opt, scheduler, sampler,
+                            epoch=ep, loader=loader)
+
+    return start_epoch, epoch_hook

@@ -111,14 +111,15 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
 
 
 def train(model, opt, lr_scheduler, train_loader, val_loader, args,
-          logger=None, timer=None):
-    """Epoch loop (reference cv_train.py:85-168)."""
+          logger=None, timer=None, start_epoch=0, epoch_hook=None):
+    """Epoch loop (reference cv_train.py:85-168). ``epoch_hook(ep)``
+    runs after each completed epoch (checkpointing)."""
     timer = timer or Timer()
     logger = logger or TableLogger()
     tsv = TSVLogger()
     results = []
     num_epochs = args.num_epochs
-    for epoch in range(math.ceil(num_epochs)):
+    for epoch in range(start_epoch, math.ceil(num_epochs)):
         epoch_fraction = min(1.0, num_epochs - epoch)
         out = run_batches(model, opt, lr_scheduler, train_loader, args,
                           training=True, epoch_fraction=epoch_fraction)
@@ -147,6 +148,8 @@ def train(model, opt, lr_scheduler, train_loader, val_loader, args,
         logger.append(row)
         tsv.append(row)
         results.append(row)
+        if epoch_hook is not None:
+            epoch_hook(epoch + 1)
     return results
 
 
@@ -231,13 +234,20 @@ def main(argv=None):
 
     spe = steps_per_epoch(args.local_batch_size, train_ds,
                           args.num_workers)
+    horizon = args.schedule_epochs or args.num_epochs
     lambda_step = PiecewiseLinear(
-        [0, args.pivot_epoch * spe, args.num_epochs * spe],
+        [0, args.pivot_epoch * spe, horizon * spe],
         [0, args.lr_scale, 0])
     lr_scheduler = LambdaLR(opt, lambda x: lambda_step(x))
 
+    from commefficient_tpu.runtime.checkpoint import setup_resume
+    start_epoch, epoch_hook = setup_resume(args, model, opt,
+                                           lr_scheduler, train_loader,
+                                           tag=args.model)
+
     results = train(model, opt, lr_scheduler, train_loader, val_loader,
-                    args)
+                    args, start_epoch=start_epoch,
+                    epoch_hook=epoch_hook)
     model.finalize()
 
     if args.do_checkpoint:

@@ -128,12 +128,12 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
 
 
 def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
-               args, logger=None):
+               args, logger=None, start_epoch=0, epoch_hook=None):
     """(reference gpt2_train.py:115-147)"""
     logger = logger or TableLogger()
     timer = Timer()
     results = []
-    for epoch in range(math.ceil(args.num_epochs)):
+    for epoch in range(start_epoch, math.ceil(args.num_epochs)):
         train_loss = run_batches(model, opt, lr_scheduler,
                                  train_loader, args, training=True)
         if train_loss is None:
@@ -150,6 +150,8 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
                "val_ppl": ppl, "total_time": timer.total_time}
         logger.append(row)
         results.append(row)
+        if epoch_hook is not None:
+            epoch_hook(epoch + 1)
     return results
 
 
@@ -241,7 +243,8 @@ def main(argv=None):
 
     spe = steps_per_epoch(args.local_batch_size, train_ds,
                           args.num_workers)
-    lambda_step = PiecewiseLinear([0, args.num_epochs * spe],
+    horizon = args.schedule_epochs or args.num_epochs
+    lambda_step = PiecewiseLinear([0, horizon * spe],
                                   [args.lr_scale, 0])
     lr_scheduler = LambdaLR(opt, lambda x: lambda_step(x))
 
@@ -252,8 +255,14 @@ def main(argv=None):
         print({"val_nll": out[0], "val_acc": out[1], "val_ppl": out[2]})
         return out
 
+    from commefficient_tpu.runtime.checkpoint import setup_resume
+    start_epoch, epoch_hook = setup_resume(args, model, opt,
+                                           lr_scheduler, train_loader,
+                                           tag="gpt2")
+
     results = train_gpt2(model, opt, lr_scheduler, train_loader,
-                         val_loader, args)
+                         val_loader, args, start_epoch=start_epoch,
+                         epoch_hook=epoch_hook)
     model.finalize()
     return results
 
