@@ -44,7 +44,7 @@ def main():
                  k=50000, num_rows=5, num_cols=524288, num_blocks=20,
                  dataset_name="CIFAR10", seed=21, approx_topk=True)
 
-    module = get_model("ResNet9")(num_classes=10)
+    module = get_model("ResNet9")(num_classes=10, dtype=jnp.bfloat16)
     params = module.init(jax.random.PRNGKey(0),
                          jnp.zeros((1, 32, 32, 3)))["params"]
     flat, unravel = flatten_params(params)

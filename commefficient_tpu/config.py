@@ -48,6 +48,11 @@ class Config:
     do_test: bool = False
     mode: str = "sketch"
     use_tensorboard: bool = False
+    do_profile: bool = False  # JAX profiler trace of the first epoch
+    # bfloat16 activations/matmuls (params + grads stay float32): full
+    # MXU rate on TPU. The TPU analogue of cifar10_fast's fp16
+    # training; no reference equivalent (it trains f32)
+    do_bf16: bool = False
     seed: int = 21
 
     # model/data
@@ -234,6 +239,9 @@ def build_parser(default_lr: Optional[float] = None,
     # meta-args
     parser.add_argument("--test", action="store_true", dest="do_test")
     parser.add_argument("--mode", choices=MODES, default="sketch")
+    parser.add_argument("--profile", action="store_true",
+                        dest="do_profile")
+    parser.add_argument("--bf16", action="store_true", dest="do_bf16")
     parser.add_argument("--tensorboard", dest="use_tensorboard",
                         action="store_true")
     parser.add_argument("--seed", type=int, default=21)

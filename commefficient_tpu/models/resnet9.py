@@ -32,11 +32,12 @@ class ConvBN(nn.Module):
     do_batchnorm: bool = False
     pool: bool = False
     bn_weight_init: float = 1.0
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         x = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
-                    kernel_init=_conv_init)(x)
+                    kernel_init=_conv_init, dtype=self.dtype)(x)
         if self.do_batchnorm:
             # batch statistics in train and eval; running averages are
             # never used (see module docstring), so mark them
@@ -44,6 +45,7 @@ class ConvBN(nn.Module):
             x = nn.BatchNorm(
                 use_running_average=False,
                 scale_init=nn.initializers.constant(self.bn_weight_init),
+                dtype=self.dtype,
             )(x)
         x = nn.relu(x)
         if self.pool:
@@ -55,11 +57,12 @@ class Residual(nn.Module):
     """x + relu(ConvBN(ConvBN(x))) (reference resnet9.py:61-68)"""
     c: int
     do_batchnorm: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        y = ConvBN(self.c, self.do_batchnorm)(x, train)
-        y = ConvBN(self.c, self.do_batchnorm)(y, train)
+        y = ConvBN(self.c, self.do_batchnorm, dtype=self.dtype)(x, train)
+        y = ConvBN(self.c, self.do_batchnorm, dtype=self.dtype)(y, train)
         return x + nn.relu(y)
 
 
@@ -71,22 +74,32 @@ class ResNet9(nn.Module):
     initial_channels: int = 3
     channels: Optional[Dict[str, int]] = None
     weight: float = 0.125
+    # computation dtype (params stay float32): bfloat16 feeds the MXU
+    # at full rate — the TPU analogue of cifar10_fast's fp16 training
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         ch = self.channels or {"prep": 64, "layer1": 128,
                                "layer2": 256, "layer3": 512}
-        x = ConvBN(ch["prep"], self.do_batchnorm)(x, train)
-        x = ConvBN(ch["layer1"], self.do_batchnorm, pool=True)(x, train)
-        x = Residual(ch["layer1"], self.do_batchnorm)(x, train)
-        x = ConvBN(ch["layer2"], self.do_batchnorm, pool=True)(x, train)
-        x = ConvBN(ch["layer3"], self.do_batchnorm, pool=True)(x, train)
-        x = Residual(ch["layer3"], self.do_batchnorm)(x, train)
+        x = x.astype(self.dtype)
+        x = ConvBN(ch["prep"], self.do_batchnorm,
+                   dtype=self.dtype)(x, train)
+        x = ConvBN(ch["layer1"], self.do_batchnorm, pool=True,
+                   dtype=self.dtype)(x, train)
+        x = Residual(ch["layer1"], self.do_batchnorm,
+                     dtype=self.dtype)(x, train)
+        x = ConvBN(ch["layer2"], self.do_batchnorm, pool=True,
+                   dtype=self.dtype)(x, train)
+        x = ConvBN(ch["layer3"], self.do_batchnorm, pool=True,
+                   dtype=self.dtype)(x, train)
+        x = Residual(ch["layer3"], self.do_batchnorm,
+                     dtype=self.dtype)(x, train)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(self.num_classes, use_bias=False,
-                     kernel_init=_conv_init)(x)
-        return x * self.weight
+                     kernel_init=_conv_init, dtype=self.dtype)(x)
+        return (x * self.weight).astype(jnp.float32)
 
     @staticmethod
     def test_config(num_classes: int = 10) -> Dict[str, Any]:

@@ -48,9 +48,19 @@ from commefficient_tpu.ops.sketch import _mix as _mix_u32  # noqa: E402
 # (single source of truth for the murmur mix: the psum-mixing contract
 # requires the Pallas and XLA sign streams to stay bit-identical)
 
-# table must stay VMEM-resident for the estimates kernel; leave room
-# for the chunk block + temporaries under the ~16 MB scoped budget
-_TABLE_VMEM_LIMIT = 12 * 1024 * 1024
+# table must stay VMEM-resident for the estimates kernel. The kernels
+# raise the Mosaic scoped-VMEM budget (default 16 MB) via
+# CompilerParams — v5e cores have headroom well past 64 MB (verified
+# on hardware) — so the bound here is table + temporaries with margin.
+_TABLE_VMEM_LIMIT = 20 * 1024 * 1024
+_VMEM_CEILING = 64 * 1024 * 1024
+
+
+def _compiler_params(table_bytes: int):
+    # table resident + r per-chunk temp rows (~table again) + double-
+    # buffered chunk blocks + relayout scratch, with margin
+    want = min(_VMEM_CEILING, max(32 * 1024 * 1024, 3 * table_bytes))
+    return pltpu.CompilerParams(vmem_limit_bytes=want)
 
 
 def _pick_lanes(c: int) -> int | None:
@@ -162,6 +172,7 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
         out_specs=pl.BlockSpec((r * S, L), lambda t: (0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((r * S, L), jnp.float32),
+        compiler_params=_compiler_params(4 * r * c),
         interpret=interpret,
     )(rot.astype(jnp.int32), vp.astype(jnp.float32).reshape(m * S, L))
     return out.reshape(r, c)
@@ -201,6 +212,7 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
         out_specs=pl.BlockSpec((S, L), lambda t: (t, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m * S, L), jnp.float32),
+        compiler_params=_compiler_params(4 * r * c),
         interpret=interpret,
     )(rot.astype(jnp.int32), table.astype(jnp.float32).reshape(r * S, L))
     return out.reshape(m * c)

@@ -68,6 +68,8 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         "mode": model.args.mode,
         "grad_size": int(model.args.grad_size),
         "num_clients": int(model.num_clients),
+        "transmit_shape": list(model.args.transmit_shape),
+        "error_type": model.args.error_type,
         "extra": extra or {},
     }
     if scheduler is not None:
@@ -118,14 +120,33 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
     ``meta["epoch"]`` as the resume epoch)."""
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["meta"]))
-        for key, want in (("format", _FMT),
-                          ("grad_size", int(model.args.grad_size)),
-                          ("mode", model.args.mode),
-                          ("num_clients", int(model.num_clients))):
+        checks = [("format", _FMT),
+                  ("grad_size", int(model.args.grad_size)),
+                  ("mode", model.args.mode),
+                  ("num_clients", int(model.num_clients))]
+        if "transmit_shape" in meta:  # sketch geometry etc.
+            checks.append(("transmit_shape",
+                           list(model.args.transmit_shape)))
+            checks.append(("error_type", model.args.error_type))
+        for key, want in checks:
             if meta[key] != want:
                 raise ValueError(
                     f"checkpoint {key}={meta[key]!r} does not match "
                     f"this run's {want!r} ({path})")
+        # the set of client-state buffers is determined by the config
+        # (local momentum / local error / topk_down) — a presence
+        # mismatch means the hyperparameters changed, and silently
+        # keeping fresh zeros would diverge from the saved trajectory
+        cs_now = model.client_states
+        for name, val in (("cs_velocities", cs_now.velocities),
+                          ("cs_errors", cs_now.errors),
+                          ("cs_weights", cs_now.weights)):
+            if (name in z.files) != (val is not None):
+                raise ValueError(
+                    f"checkpoint {'has' if name in z.files else 'lacks'}"
+                    f" {name} but this run "
+                    f"{'does not use' if val is None else 'needs'} it "
+                    "— momentum/error/topk_down flags differ")
 
         import jax.numpy as jnp
 

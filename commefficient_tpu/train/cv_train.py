@@ -114,42 +114,56 @@ def train(model, opt, lr_scheduler, train_loader, val_loader, args,
           logger=None, timer=None, start_epoch=0, epoch_hook=None):
     """Epoch loop (reference cv_train.py:85-168). ``epoch_hook(ep)``
     runs after each completed epoch (checkpointing)."""
+    from commefficient_tpu.utils import (make_logdir,
+                                         make_summary_writer,
+                                         profile_epoch,
+                                         write_epoch_scalars)
     timer = timer or Timer()
     logger = logger or TableLogger()
     tsv = TSVLogger()
+    logdir = (make_logdir(args)
+              if (args.use_tensorboard or args.do_profile) else None)
+    writer = make_summary_writer(args, logdir)
     results = []
     num_epochs = args.num_epochs
-    for epoch in range(start_epoch, math.ceil(num_epochs)):
-        epoch_fraction = min(1.0, num_epochs - epoch)
-        out = run_batches(model, opt, lr_scheduler, train_loader, args,
-                          training=True, epoch_fraction=epoch_fraction)
-        if out is None:
-            print("NaN detected, aborting training")
-            return results
-        train_loss, train_acc, download, upload = out
-        train_time = timer()
-        val_loss, val_acc = run_batches(model, opt, lr_scheduler,
-                                        val_loader, args,
-                                        training=False)
-        val_time = timer()
-        row = {
-            "epoch": epoch + 1,
-            "lr": float(opt.param_groups[0]["lr"]),
-            "train_time": train_time,
-            "train_loss": float(train_loss),
-            "train_acc": float(train_acc),
-            "test_time": val_time,
-            "test_loss": float(val_loss),
-            "test_acc": float(val_acc),
-            "down (MiB)": float(download.sum() / (1024 * 1024)),
-            "up (MiB)": float(upload.sum() / (1024 * 1024)),
-            "total_time": timer.total_time,
-        }
-        logger.append(row)
-        tsv.append(row)
-        results.append(row)
-        if epoch_hook is not None:
-            epoch_hook(epoch + 1)
+    try:
+        for epoch in range(start_epoch, math.ceil(num_epochs)):
+            epoch_fraction = min(1.0, num_epochs - epoch)
+            with profile_epoch(args, epoch, start_epoch, logdir):
+                out = run_batches(model, opt, lr_scheduler,
+                                  train_loader, args, training=True,
+                                  epoch_fraction=epoch_fraction)
+            if out is None:
+                print("NaN detected, aborting training")
+                return results
+            train_loss, train_acc, download, upload = out
+            train_time = timer()
+            val_loss, val_acc = run_batches(model, opt, lr_scheduler,
+                                            val_loader, args,
+                                            training=False)
+            val_time = timer()
+            row = {
+                "epoch": epoch + 1,
+                "lr": float(opt.param_groups[0]["lr"]),
+                "train_time": train_time,
+                "train_loss": float(train_loss),
+                "train_acc": float(train_acc),
+                "test_time": val_time,
+                "test_loss": float(val_loss),
+                "test_acc": float(val_acc),
+                "down (MiB)": float(download.sum() / (1024 * 1024)),
+                "up (MiB)": float(upload.sum() / (1024 * 1024)),
+                "total_time": timer.total_time,
+            }
+            logger.append(row)
+            tsv.append(row)
+            results.append(row)
+            write_epoch_scalars(writer, row, epoch + 1)
+            if epoch_hook is not None:
+                epoch_hook(epoch + 1)
+    finally:
+        if writer is not None:
+            writer.close()
     return results
 
 
@@ -195,6 +209,13 @@ def build_model(args: Config, rng=None):
     kw = dict(num_classes=num_classes)
     if args.model == "ResNet9":
         kw["do_batchnorm"] = args.do_batchnorm
+    if args.do_bf16:
+        if "dtype" in getattr(model_cls, "__dataclass_fields__", {}):
+            kw["dtype"] = jnp.bfloat16
+        else:
+            import warnings
+            warnings.warn(f"--bf16 not supported by {args.model}; "
+                          "training in float32")
     if args.do_test and hasattr(model_cls, "test_config"):
         kw.update(model_cls.test_config(num_classes))
     module = model_cls(**kw)
@@ -208,6 +229,48 @@ def build_model(args: Config, rng=None):
     params = variables["params"]
     init_stats = variables.get("batch_stats")
     return module, params, init_stats
+
+
+def merge_finetune_params(target, source):
+    """Overlay ``source`` (a loaded checkpoint pytree) onto ``target``
+    (freshly initialised for the new dataset) wherever leaf shapes
+    match; leaves whose shapes differ — the classifier head when the
+    class count changed — keep their fresh initialisation. The
+    functional form of the reference's head-swap finetuning
+    (cv_train.py:342-352, 377-384). Returns (merged, replaced_paths).
+    """
+    replaced = []
+
+    def rec(t, s, path):
+        if isinstance(t, dict):
+            out = {}
+            for k, v in t.items():
+                if isinstance(s, dict) and k in s:
+                    out[k] = rec(v, s[k], path + (k,))
+                else:
+                    replaced.append("/".join(path + (k,)))
+                    out[k] = v
+            return out
+        if getattr(s, "shape", None) == getattr(t, "shape", None):
+            return jnp.asarray(s)
+        replaced.append("/".join(path))
+        return t
+
+    return rec(target, source, ()), replaced
+
+
+def load_finetune_params(args, params):
+    """Load finetune_path/<model>.pkl (trained on --finetuned_from)
+    and merge it into the fresh params."""
+    import os
+    import pickle
+    path = os.path.join(args.finetune_path, args.model + ".pkl")
+    with open(path, "rb") as f:
+        source = pickle.load(f)
+    merged, replaced = merge_finetune_params(params, source)
+    print(f"finetune: loaded {path}; reinitialised: "
+          f"{replaced or 'nothing'}")
+    return merged
 
 
 def main(argv=None):
@@ -226,6 +289,8 @@ def main(argv=None):
         args.num_clients = int(train_ds.num_clients)
 
     module, params, init_stats = build_model(args)
+    if args.do_finetune:
+        params = load_finetune_params(args, params)
     compute_loss = make_compute_loss(module, init_stats)
 
     model = FedModel(module, params, compute_loss, args,

@@ -130,32 +130,51 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
 def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
                args, logger=None, start_epoch=0, epoch_hook=None):
     """(reference gpt2_train.py:115-147)"""
+    from commefficient_tpu.utils import (make_logdir,
+                                         make_summary_writer,
+                                         profile_epoch,
+                                         write_epoch_scalars)
     logger = logger or TableLogger()
     timer = Timer()
+    logdir = (make_logdir(args)
+              if (args.use_tensorboard or args.do_profile) else None)
+    writer = make_summary_writer(args, logdir)
     results = []
-    for epoch in range(start_epoch, math.ceil(args.num_epochs)):
-        train_loss = run_batches(model, opt, lr_scheduler,
-                                 train_loader, args, training=True)
-        if train_loss is None:
-            print("NaN detected, aborting")
-            return results
-        train_time = timer()
-        nll, acc, ppl = run_batches(model, opt, lr_scheduler,
-                                    val_loader, args, training=False)
-        val_time = timer()
-        row = {"epoch": epoch + 1,
-               "lr": float(opt.param_groups[0]["lr"]),
-               "train_time": train_time, "train_loss": train_loss,
-               "val_time": val_time, "val_nll": nll, "val_acc": acc,
-               "val_ppl": ppl, "total_time": timer.total_time}
-        logger.append(row)
-        results.append(row)
-        if epoch_hook is not None:
-            epoch_hook(epoch + 1)
+    try:
+        for epoch in range(start_epoch, math.ceil(args.num_epochs)):
+            with profile_epoch(args, epoch, start_epoch, logdir):
+                train_loss = run_batches(model, opt, lr_scheduler,
+                                         train_loader, args,
+                                         training=True)
+            if train_loss is None:
+                print("NaN detected, aborting")
+                return results
+            train_time = timer()
+            nll, acc, ppl = run_batches(model, opt, lr_scheduler,
+                                        val_loader, args,
+                                        training=False)
+            val_time = timer()
+            row = {"epoch": epoch + 1,
+                   "lr": float(opt.param_groups[0]["lr"]),
+                   "train_time": train_time, "train_loss": train_loss,
+                   "val_time": val_time, "val_nll": nll, "val_acc": acc,
+                   "val_ppl": ppl, "total_time": timer.total_time}
+            logger.append(row)
+            results.append(row)
+            write_epoch_scalars(writer, row, epoch + 1)
+            if epoch_hook is not None:
+                epoch_hook(epoch + 1)
+    finally:
+        if writer is not None:
+            writer.close()
     return results
 
 
 def build_model_and_tokenizer(args: Config):
+    if args.do_bf16:
+        import warnings
+        warnings.warn("--bf16 is not supported by the GPT-2 path yet; "
+                      "training in float32")
     tokenizer = load_tokenizer(args.model_checkpoint)
     tokenizer.add_special_tokens(SPECIAL_TOKENS)
     if args.do_test or tokenizer.__class__.__name__ == "ByteTokenizer":

@@ -49,3 +49,47 @@ class TestCvTrainSmoke:
         # --test shrinks the model; blobs are separable, so even the
         # 1-channel net should move off chance by the last epoch
         assert results[-1]["train_loss"] < results[0]["train_loss"] + 0.5
+
+
+class TestFinetune:
+    def test_merge_replaces_only_mismatched_head(self):
+        import jax
+        import jax.numpy as jnp
+        from commefficient_tpu.models import get_model
+        from commefficient_tpu.train.cv_train import merge_finetune_params
+
+        mk = lambda n: get_model("ResNet9")(
+            num_classes=n,
+            channels={"prep": 2, "layer1": 2, "layer2": 2, "layer3": 2})
+        p10 = mk(10).init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 32, 32, 3)))["params"]
+        p4 = mk(4).init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 32, 32, 3)))["params"]
+        merged, replaced = merge_finetune_params(p4, p10)
+        assert replaced == ["Dense_0/kernel"]
+        # body copied from source, head kept fresh
+        import numpy as np
+        np.testing.assert_array_equal(
+            np.asarray(merged["ConvBN_0"]["Conv_0"]["kernel"]),
+            np.asarray(p10["ConvBN_0"]["Conv_0"]["kernel"]))
+        np.testing.assert_array_equal(
+            np.asarray(merged["Dense_0"]["kernel"]),
+            np.asarray(p4["Dense_0"]["kernel"]))
+
+    def test_finetune_end_to_end(self, tmp_path):
+        """Train + checkpoint, then a --finetune run loads the body."""
+        from commefficient_tpu.train import cv_train
+
+        base = [
+            "--test", "--dataset_name", "Synthetic",
+            "--mode", "uncompressed", "--error_type", "none",
+            "--local_momentum", "0", "--virtual_momentum", "0",
+            "--num_clients", "10", "--num_workers", "2",
+            "--local_batch_size", "4", "--num_epochs", "1",
+            "--lr_scale", "0.1", "--pivot_epoch", "1",
+        ]
+        cv_train.main(base + ["--checkpoint",
+                              "--checkpoint_path", str(tmp_path)])
+        out = cv_train.main(base + ["--finetune",
+                                    "--finetune_path", str(tmp_path)])
+        assert len(out) == 1
