@@ -1,0 +1,159 @@
+"""Federated round with sequence parallelism inside each client — the
+2-D mesh composition ("clients" x "seq").
+
+The 1-D engine (core/rounds.py) shards *clients* over the mesh; each
+client's forward fits one device. For long-sequence federated LM
+training (GPT-2/PersonaChat at context lengths the reference could
+never reach — it has no sequence parallelism at all, SURVEY.md §2.8),
+this module composes both axes:
+
+- the client batch is sharded over ``clients`` AND its token arrays
+  over ``seq``;
+- inside one ``shard_map`` block, each device holds its client slice's
+  sequence shard; the GPT-2 forward runs ring (or Ulysses) attention
+  over ``seq`` (models/gpt2.py seq_axis) with global-position
+  embeddings;
+- the loss is a masked token-CE over local positions (labels are
+  pre-shifted host-side so the shard boundary needs no halo exchange)
+  plus the MC-head CE, normalised by ``psum`` counts over ``seq``;
+- parameter gradients are ``psum``-ed over ``seq`` (params are
+  replicated on that axis), then the per-client transmits sum over
+  ``clients`` — exactly the 1-D engine's aggregation semantics, so the
+  aggregated gradient equals the dense single-device oracle
+  (tested in tests/test_rounds_sp.py) and any linear compressor
+  (count-sketch) composes on top unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+from commefficient_tpu.parallel.mesh import shard_map
+
+CLIENT_AXIS = "clients"
+SEQ_AXIS = "seq"
+
+
+def make_sp_mesh(n_clients_axis: int, n_seq_axis: int,
+                 devices=None) -> Mesh:
+    import numpy as np
+    devices = list(devices) if devices is not None else jax.devices()
+    n = n_clients_axis * n_seq_axis
+    assert len(devices) >= n, (len(devices), n)
+    return Mesh(np.array(devices[:n]).reshape(n_clients_axis,
+                                              n_seq_axis),
+                (CLIENT_AXIS, SEQ_AXIS))
+
+
+def shift_lm_labels(lm_labels, ignore_index: int = -1):
+    """Host-side global shift: position t is labelled with token t+1
+    (the loss shift of gpt2_double_heads_loss), so sequence shards
+    never need their right neighbour's first token. Default
+    ignore_index -1 matches the persona loaders' label padding
+    (data/loader.py PersonaFedLoader)."""
+    shifted = jnp.roll(lm_labels, -1, axis=-1)
+    return shifted.at[..., -1].set(ignore_index)
+
+
+def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
+                        unravel: Callable, lm_coef: float = 1.0,
+                        mc_coef: float = 1.0,
+                        ignore_index: int = -1):
+    """Returns jit-able ``round(flat_params, batch) -> (agg_grad,
+    mean_loss)``.
+
+    ``batch`` (host layout, W = participating clients):
+      input_ids / token_type_ids (W, B, N, T) int32,
+      shifted_labels (W, B, N, T) int32 (see shift_lm_labels),
+      mc_token_ids (W, B, N) int32 — GLOBAL positions,
+      mc_labels (W, B) int32, mask (W, B) float32 per-EXAMPLE mask
+      (ragged client batches: padded rows are excluded from both loss
+      terms; a client with no real rows contributes nothing).
+    """
+    sp_cfg = dataclasses.replace(cfg, seq_axis=SEQ_AXIS)
+    model = GPT2DoubleHeads(sp_cfg)
+    ignore = ignore_index
+
+    def client_loss(flat, ids, tt, labels, mc_ids, mc_labels,
+                    ex_mask):
+        """Local-shard loss contributions for ONE client:
+        (lm_nll_sum_local, lm_valid_count_local, mc_nll_mean) —
+        the seq-psum happens outside so grad sees pure locals.
+        ``ex_mask`` (B,) zeroes padded examples out of both terms."""
+        params = unravel(flat)
+        lm_logits, mc_logits = model.apply(
+            {"params": params}, ids, mc_ids, tt)
+        valid = ((labels != ignore).astype(jnp.float32)
+                 * ex_mask[:, None, None])
+        safe = jnp.where(labels != ignore, labels, 0)
+        logp = jax.nn.log_softmax(lm_logits)
+        nll = -jnp.take_along_axis(logp, safe[..., None],
+                                   axis=-1)[..., 0]
+        lm_sum = jnp.sum(nll * valid)
+        lm_cnt = jnp.sum(valid)
+        mc_logp = jax.nn.log_softmax(mc_logits, axis=-1)
+        mc_nll = -jnp.take_along_axis(mc_logp, mc_labels[..., None],
+                                      axis=-1)[..., 0]
+        mc = (jnp.sum(mc_nll * ex_mask)
+              / jnp.maximum(jnp.sum(ex_mask), 1.0))
+        return lm_sum, lm_cnt, mc
+
+    def block(flat, ids, tt, labels, mc_ids, mc_labels, mask):
+        # local shapes: (Wl, B, N, Tl) tokens, (Wl, B, N) mc, (Wl, B).
+        # Gradients of the replicated ``flat`` are automatically
+        # psum-med over BOTH mesh axes by shard_map's autodiff, so the
+        # per-device objective must be the exact local share of the
+        # global weighted objective: the lm term contributes its LOCAL
+        # numerator over the GLOBAL count (seq shards sum to the full
+        # mean) and the mc term — identical on every seq shard after
+        # the gather-psum — is divided by the seq axis size.
+        ex_mask = mask if mask.ndim > 1 else mask[:, None]  # (Wl, B)
+        w = (jnp.sum(ex_mask, axis=1) > 0).astype(jnp.float32)  # (Wl,)
+        seq_n = jax.lax.axis_size(SEQ_AXIS)
+
+        def local_objective(f):
+            def per_client(ids_c, tt_c, labels_c, mc_c, mcl_c, ex_c):
+                lm_sum, lm_cnt, mc = client_loss(
+                    f, ids_c, tt_c, labels_c, mc_c, mcl_c, ex_c)
+                global_cnt = jnp.maximum(
+                    jax.lax.psum(lm_cnt, SEQ_AXIS), 1.0)
+                share = (lm_coef * lm_sum / global_cnt
+                         + mc_coef * mc / seq_n)
+                report = (lm_coef
+                          * jax.lax.psum(lm_sum, SEQ_AXIS) / global_cnt
+                          + mc_coef * mc)
+                return share, report
+
+            shares, reports = jax.vmap(per_client)(
+                ids, tt, labels, mc_ids, mc_labels, ex_mask)
+            return jnp.sum(shares * w), reports
+
+        (_, losses), g = jax.value_and_grad(
+            local_objective, has_aux=True)(flat)
+        # g is already Sum_c w_c * grad_c, replicated everywhere
+        n_clients = jnp.maximum(
+            jax.lax.psum(jnp.sum(w), CLIENT_AXIS), 1.0)
+        loss_sum = jax.lax.psum(jnp.sum(losses * w), CLIENT_AXIS)
+        return g / n_clients, loss_sum / n_clients
+
+    tok = P(CLIENT_AXIS, None, None, SEQ_AXIS)
+    per_client = P(CLIENT_AXIS)
+    fn = shard_map(
+        block, mesh=mesh,
+        in_specs=(P(), tok, tok, tok, per_client, per_client,
+                  per_client),
+        out_specs=(P(), P()))
+
+    def round_fn(flat_params, batch):
+        return fn(flat_params, batch["input_ids"],
+                  batch["token_type_ids"], batch["shifted_labels"],
+                  batch["mc_token_ids"], batch["mc_labels"],
+                  batch["mask"])
+
+    return round_fn

@@ -21,7 +21,7 @@ mc_labels (B,).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -39,6 +39,9 @@ class GPT2Config:
     n_head: int = 12
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
+    # computation dtype (params stay float32); bfloat16 runs the MXU
+    # at full rate. LayerNorm statistics and logits stay float32.
+    dtype: Any = jnp.float32
     # Sequence/context parallelism (a capability the reference lacks,
     # SURVEY.md §2.8): set to a mesh axis name and call the model
     # inside shard_map with input_ids sharded on T over that axis.
@@ -68,10 +71,10 @@ class MLP(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        h = nn.Dense(4 * self.cfg.n_embd,
+        h = nn.Dense(4 * self.cfg.n_embd, dtype=self.cfg.dtype,
                      kernel_init=_dense_init(self.cfg), name="c_fc")(x)
         h = jax.nn.gelu(h, approximate=True)
-        return nn.Dense(self.cfg.n_embd,
+        return nn.Dense(self.cfg.n_embd, dtype=self.cfg.dtype,
                         kernel_init=_dense_init(self.cfg),
                         name="c_proj")(h)
 
@@ -83,7 +86,8 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x, attn_mask=None):
         B, T, C = x.shape
         H = self.cfg.n_head
-        qkv = nn.Dense(3 * C, kernel_init=_dense_init(self.cfg),
+        qkv = nn.Dense(3 * C, dtype=self.cfg.dtype,
+                       kernel_init=_dense_init(self.cfg),
                        name="c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, C // H)
@@ -98,7 +102,8 @@ class CausalSelfAttention(nn.Module):
         else:
             out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape(B, T, C)
-        return nn.Dense(C, kernel_init=_dense_init(self.cfg),
+        return nn.Dense(C, dtype=self.cfg.dtype,
+                        kernel_init=_dense_init(self.cfg),
                         name="c_proj")(out)
 
 
@@ -109,9 +114,11 @@ class Block(nn.Module):
     def __call__(self, x):
         eps = self.cfg.layer_norm_epsilon
         x = x + CausalSelfAttention(self.cfg, name="attn")(
-            nn.LayerNorm(epsilon=eps, name="ln_1")(x))
+            nn.LayerNorm(epsilon=eps, name="ln_1")(x)
+            .astype(self.cfg.dtype))
         x = x + MLP(self.cfg, name="mlp")(
-            nn.LayerNorm(epsilon=eps, name="ln_2")(x))
+            nn.LayerNorm(epsilon=eps, name="ln_2")(x)
+            .astype(self.cfg.dtype))
         return x
 
 
@@ -154,7 +161,10 @@ class GPT2DoubleHeads(nn.Module):
                    if token_type_ids is not None else None)
         h, wte = GPT2Transformer(self.cfg, name="transformer")(
             flat_ids, flat_tt)
-        lm_logits = h @ wte.T  # tied weights
+        # tied weights; logits accumulate in float32
+        lm_logits = jnp.einsum("btc,vc->btv", h.astype(self.cfg.dtype),
+                               wte.astype(self.cfg.dtype),
+                               preferred_element_type=jnp.float32)
         lm_logits = lm_logits.reshape(B, N, T, -1)
 
         h = h.reshape(B, N, T, -1)

@@ -77,16 +77,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
         allowed = q_pos[:, None] >= k_pos[None, :]
         return jnp.where(allowed, 0.0, _NEG_INF)
 
-    o = jnp.zeros((B, T, H, D), jnp.float32)
-    m = jnp.full((B, T, H), _NEG_INF, jnp.float32)
-    l = jnp.zeros((B, T, H), jnp.float32)
-    # mark the accumulators device-varying so the loop carry type
-    # matches after mixing with the (varying) rotated KV blocks
-    if hasattr(jax.lax, "pcast"):
-        o, m, l = (jax.lax.pcast(x, axis_name, to="varying")
-                   for x in (o, m, l))
-    elif hasattr(jax.lax, "pvary"):  # pre-0.9 fallback
-        o, m, l = (jax.lax.pvary(x, axis_name) for x in (o, m, l))
+    # derive the accumulators from q so they carry q's full
+    # varying-axes set (the loop carry must type-match after mixing
+    # with the rotated KV blocks — and under a multi-axis mesh, e.g.
+    # clients x seq, the inputs vary over more axes than just ours)
+    zero = (q * 0.0).astype(jnp.float32)
+    o = zero
+    m = jnp.sum(zero, axis=-1) + _NEG_INF  # (B, T, H)
+    l = jnp.sum(zero, axis=-1)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(s, carry):

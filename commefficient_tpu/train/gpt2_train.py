@@ -171,22 +171,21 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
 
 
 def build_model_and_tokenizer(args: Config):
-    if args.do_bf16:
-        import warnings
-        warnings.warn("--bf16 is not supported by the GPT-2 path yet; "
-                      "training in float32")
+    import dataclasses
+
     tokenizer = load_tokenizer(args.model_checkpoint)
     tokenizer.add_special_tokens(SPECIAL_TOKENS)
     if args.do_test or tokenizer.__class__.__name__ == "ByteTokenizer":
         cfg = GPT2Config.tiny()
-        cfg = GPT2Config(
+        cfg = dataclasses.replace(
+            cfg,
             vocab_size=max(len(tokenizer), cfg.vocab_size),
-            n_positions=max(MAX_SEQ_LEN, cfg.n_positions),
-            n_embd=cfg.n_embd, n_layer=cfg.n_layer,
-            n_head=cfg.n_head)
+            n_positions=max(MAX_SEQ_LEN, cfg.n_positions))
     else:
         cfg = GPT2Config(vocab_size=len(tokenizer),
                          n_positions=1024)
+    if args.do_bf16:
+        cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
     module = GPT2DoubleHeads(cfg)
     dummy = jnp.zeros((1, args.num_candidates, 8), jnp.int32)
     params = module.init(jax.random.PRNGKey(args.seed), dummy,
@@ -278,6 +277,14 @@ def main(argv=None):
     start_epoch, epoch_hook = setup_resume(args, model, opt,
                                            lr_scheduler, train_loader,
                                            tag="gpt2")
+
+    if args.eval_before_start and start_epoch == 0:
+        # (reference gpt2_train.py:207 via --eval_before_start);
+        # skipped on resume — the restored model isn't "before start"
+        out = run_batches(model, opt, lr_scheduler, val_loader, args,
+                          training=False)
+        print({"epoch": 0, "val_nll": out[0], "val_acc": out[1],
+               "val_ppl": out[2]})
 
     results = train_gpt2(model, opt, lr_scheduler, train_loader,
                          val_loader, args, start_epoch=start_epoch,
