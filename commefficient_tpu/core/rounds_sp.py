@@ -34,9 +34,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
-from commefficient_tpu.parallel.mesh import shard_map
+from commefficient_tpu.parallel.mesh import CLIENT_AXIS, shard_map
 
-CLIENT_AXIS = "clients"
 SEQ_AXIS = "seq"
 
 
@@ -113,7 +112,8 @@ def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
         # numerator over the GLOBAL count (seq shards sum to the full
         # mean) and the mc term — identical on every seq shard after
         # the gather-psum — is divided by the seq axis size.
-        ex_mask = mask if mask.ndim > 1 else mask[:, None]  # (Wl, B)
+        assert mask.ndim == 2, f"mask must be (W, B), got {mask.shape}"
+        ex_mask = mask  # (Wl, B) per-example
         w = (jnp.sum(ex_mask, axis=1) > 0).astype(jnp.float32)  # (Wl,)
         seq_n = jax.lax.axis_size(SEQ_AXIS)
 

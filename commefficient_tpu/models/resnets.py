@@ -126,15 +126,20 @@ def _factory(layers, block, **preset) -> Callable[..., ResNet]:
     return make
 
 
-# reference resnets.py:249-370 factory surface
-resnet18 = _factory([2, 2, 2, 2], BasicBlock)
-resnet34 = _factory([3, 4, 6, 3], BasicBlock)
-resnet50 = _factory([3, 4, 6, 3], Bottleneck)
-resnet101 = _factory([3, 4, 23, 3], Bottleneck)
-resnet152 = _factory([3, 8, 36, 3], Bottleneck)
-wide_resnet50_2 = _factory([3, 4, 6, 3], Bottleneck, width_per_group=128)
-wide_resnet101_2 = _factory([3, 4, 23, 3], Bottleneck,
-                            width_per_group=128)
+# reference resnets.py:249-370 factory surface; registered so every
+# family member is a valid --model choice (the reference discovers
+# them by reflection over its models package, utils.py:114-118)
+resnet18 = register_model("resnet18")(_factory([2, 2, 2, 2], BasicBlock))
+resnet34 = register_model("resnet34")(_factory([3, 4, 6, 3], BasicBlock))
+resnet50 = register_model("resnet50")(_factory([3, 4, 6, 3], Bottleneck))
+resnet101 = register_model("resnet101")(
+    _factory([3, 4, 23, 3], Bottleneck))
+resnet152 = register_model("resnet152")(
+    _factory([3, 8, 36, 3], Bottleneck))
+wide_resnet50_2 = register_model("wide_resnet50_2")(
+    _factory([3, 4, 6, 3], Bottleneck, width_per_group=128))
+wide_resnet101_2 = register_model("wide_resnet101_2")(
+    _factory([3, 4, 23, 3], Bottleneck, width_per_group=128))
 
 
 def ResNet101LN(num_classes: int = 62, **kwargs) -> ResNet:

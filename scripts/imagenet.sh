@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# ImageNet / FixupResNet50 federated run — the working TPU counterpart
+# of the reference's imagenet.sh (which passes --mixup/--mixup_alpha/
+# --supervised flags its own parse_args does not define; those are
+# dropped here). Reference config: 7 workers / 7 clients iid, local
+# batch 64, virtual momentum 0.9, wd 1e-4, error_type virtual,
+# mode uncompressed (imagenet.sh:2-21).
+set -euo pipefail
+
+DATASET_DIR=${DATASET_DIR:-./data/imagenet}
+
+python -m commefficient_tpu.train.cv_train \
+    --dataset_name ImageNet \
+    --dataset_dir "$DATASET_DIR" \
+    --model FixupResNet50 \
+    --mode uncompressed \
+    --error_type virtual \
+    --iid \
+    --num_clients 7 \
+    --num_workers 7 \
+    --local_batch_size 64 \
+    --valid_batch_size 64 \
+    --local_momentum 0 \
+    --virtual_momentum 0.9 \
+    --weight_decay 1e-4 \
+    --num_epochs 24 \
+    --pivot_epoch 5 \
+    --lr_scale 0.4 \
+    --k 1000000 \
+    --num_rows 1 \
+    --num_cols 10000000 \
+    "$@"
