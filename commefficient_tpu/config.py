@@ -53,6 +53,10 @@ class Config:
     # MXU rate on TPU. The TPU analogue of cifar10_fast's fp16
     # training; no reference equivalent (it trains f32)
     do_bf16: bool = False
+    # GPT-2 sequence parallelism: shard each client's sequences over
+    # this many chips (ring or ulysses attention). 1 = off.
+    seq_devices: int = 1
+    seq_impl: str = "ring"
     seed: int = 21
 
     # model/data
@@ -242,6 +246,9 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--profile", action="store_true",
                         dest="do_profile")
     parser.add_argument("--bf16", action="store_true", dest="do_bf16")
+    parser.add_argument("--seq_devices", type=int, default=1)
+    parser.add_argument("--seq_impl", choices=["ring", "ulysses"],
+                        default="ring")
     parser.add_argument("--tensorboard", dest="use_tensorboard",
                         action="store_true")
     parser.add_argument("--seed", type=int, default=21)

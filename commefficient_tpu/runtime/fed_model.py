@@ -164,17 +164,20 @@ class FedModel:
         self.pending_client_ids = ids
         self.round_index += 1
 
-        # byte accounting (download before this round's update lands)
+        metrics = [np.asarray(m) for m in res.metrics]
+        return metrics + list(self._account_bytes(ids_np))
+
+    def _account_bytes(self, ids_np):
+        """Per-round download/upload byte accounting (see module
+        docstring; reference fed_aggregator.py:171-196, 240-300)."""
         download_bytes = np.zeros(self.num_clients)
         changed = self.last_updated[None, :] > \
             self.client_last_seen[ids_np, None]
         download_bytes[ids_np] = 4.0 * changed.sum(axis=1)
         self.client_last_seen[ids_np] = self._update_round
         upload_bytes = np.zeros(self.num_clients)
-        upload_bytes[ids_np] = 4.0 * args.upload_floats_per_client
-
-        metrics = [np.asarray(m) for m in res.metrics]
-        return metrics + [download_bytes, upload_bytes]
+        upload_bytes[ids_np] = 4.0 * self.args.upload_floats_per_client
+        return download_bytes, upload_bytes
 
     def _call_val(self, batch):
         dev_batch = shard_batch(self.mesh, jax.tree_util.tree_map(

@@ -1,0 +1,118 @@
+"""Sequence-parallel federated runtime for GPT-2 (``--seq_devices N``).
+
+Drop-in FedModel variant whose TRAIN path runs the 2-D
+clients x seq round (core/rounds_sp.py): each client's forward/backward
+is sequence-sharded over ``seq_devices`` chips with ring (or Ulysses)
+attention, so context length scales with chips — a capability the
+reference lacks entirely (SURVEY.md §2.8). Validation and the
+FedOptimizer server step are inherited unchanged.
+
+Mode composition: the SP round produces the round's aggregated DENSE
+gradient. ``uncompressed``/``true_topk`` consume it directly; for
+``sketch`` it is table-ized once server-side — by sketch linearity
+this equals the psum of per-client sketches, so the server math is
+identical to the 1-D engine's. Modes needing per-client local state
+(local momentum/error, local_topk, fedavg, topk_down) are rejected.
+
+Objective notes (differences vs the 1-D engine, both deliberate):
+- clients are weighted equally (per-client mean), vs datapoint-count
+  weighting — the standard FedAvg-style choice for ragged clients;
+- each client's LM loss is a token-mean over ALL its valid tokens,
+  vs the 1-D path's mean of per-example token-means — longer examples
+  weigh proportionally to their length. Toggling --seq_devices
+  therefore changes training dynamics slightly at equal LR.
+Weight decay is applied with the 1-D engine's effective coefficient
+(weight_decay / num_workers, see core/grad.py). ``--max_grad_norm``
+and ``--dp`` are per-client pre-aggregation operations that cannot be
+recovered from the aggregated gradient — they are rejected rather than
+silently dropped. Byte accounting is inherited.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.core.rounds import args2sketch
+from commefficient_tpu.core.rounds_sp import (build_sp_gpt2_round,
+                                              make_sp_mesh,
+                                              shift_lm_labels)
+from commefficient_tpu.runtime.fed_model import FedModel
+
+
+class SeqParallelFedModel(FedModel):
+    def __init__(self, module, params, compute_loss, args: Config,
+                 gpt2_cfg, compute_loss_val=None,
+                 padded_batch_size=None):
+        if args.mode not in ("uncompressed", "sketch", "true_topk"):
+            raise ValueError(
+                f"--seq_devices does not support mode={args.mode} "
+                "(needs per-client local state)")
+        if args.local_momentum > 0 or args.error_type == "local" \
+                or args.do_topk_down:
+            raise ValueError("--seq_devices requires local_momentum 0, "
+                             "error_type none/virtual, no topk_down")
+        if args.max_grad_norm is not None or args.do_dp:
+            raise ValueError(
+                "--seq_devices does not support --max_grad_norm/--dp "
+                "(per-client clipping/noise happens before "
+                "aggregation and cannot be applied afterwards)")
+        n_dev = len(jax.devices())
+        if n_dev % args.seq_devices != 0:
+            raise ValueError(f"seq_devices={args.seq_devices} must "
+                             f"divide device count {n_dev}")
+        n_client_axis = n_dev // args.seq_devices
+
+        super().__init__(module, params, compute_loss, args,
+                         compute_loss_val=compute_loss_val,
+                         padded_batch_size=padded_batch_size)
+
+        sp_cfg = dataclasses.replace(gpt2_cfg,
+                                     seq_impl=args.seq_impl)
+        self._sp_mesh = make_sp_mesh(n_client_axis, args.seq_devices)
+        sp_round = build_sp_gpt2_round(
+            sp_cfg, self._sp_mesh, self.unravel,
+            lm_coef=args.lm_coef, mc_coef=args.mc_coef,
+            ignore_index=-1)
+        sketch = args2sketch(args)
+        wd = args.weight_decay / max(args.num_workers, 1)
+
+        @jax.jit
+        def round_and_compress(ps, batch):
+            agg, loss = sp_round(ps, batch)
+            if wd > 0:  # 1-D engine's effective decay (core/grad.py)
+                agg = agg + wd * ps
+            if sketch is not None:
+                # linearity: sketch(mean of grads) == mean of sketches
+                agg = sketch.sketch(agg)
+            return agg, loss
+
+        self._sp_round = round_and_compress
+
+    def _call_train(self, batch):
+        ids_np = np.asarray(batch["client_ids"])
+        W = ids_np.shape[0]
+        if W % self._sp_mesh.shape["clients"] != 0:
+            raise ValueError(
+                f"num_workers {W} must be divisible by the client "
+                f"axis {self._sp_mesh.shape['clients']}")
+        sp_batch = {
+            "input_ids": jnp.asarray(batch["input_ids"]),
+            "token_type_ids": jnp.asarray(batch["token_type_ids"]),
+            "shifted_labels": shift_lm_labels(
+                jnp.asarray(batch["lm_labels"])),
+            "mc_token_ids": jnp.asarray(batch["mc_token_ids"]),
+            "mc_labels": jnp.asarray(batch["mc_labels"]),
+            "mask": jnp.asarray(batch["mask"]),
+        }
+        agg, loss = self._sp_round(self.ps_weights, sp_batch)
+        self.pending_aggregated = agg
+        self.pending_client_ids = jnp.asarray(ids_np, jnp.int32)
+        self.round_index += 1
+
+        metrics = [np.full(W, float(loss), np.float64)]
+        return metrics + list(self._account_bytes(ids_np))

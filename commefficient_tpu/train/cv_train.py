@@ -275,6 +275,10 @@ def load_finetune_params(args, params):
 
 def main(argv=None):
     args = parse_args(default_lr=0.4, argv=argv)
+    if args.seq_devices > 1:
+        raise ValueError("--seq_devices is a GPT-2 trainer feature "
+                         "(sequence parallelism); cv models have no "
+                         "sequence axis")
     np.random.seed(args.seed)
 
     if args.do_test:

@@ -252,11 +252,20 @@ def main(argv=None):
     if args.num_clients is None:
         args.num_clients = int(train_ds.num_clients)
 
-    model = FedModel(module, params,
-                     make_compute_loss_train(module, args), args,
-                     compute_loss_val=make_compute_loss_val(module,
-                                                            args),
-                     padded_batch_size=train_loader.B)
+    if args.seq_devices > 1:
+        from commefficient_tpu.runtime.fed_model_sp import (
+            SeqParallelFedModel)
+        model = SeqParallelFedModel(
+            module, params, make_compute_loss_train(module, args),
+            args, gpt2_cfg=module.cfg,
+            compute_loss_val=make_compute_loss_val(module, args),
+            padded_batch_size=train_loader.B)
+    else:
+        model = FedModel(module, params,
+                         make_compute_loss_train(module, args), args,
+                         compute_loss_val=make_compute_loss_val(module,
+                                                                args),
+                         padded_batch_size=train_loader.B)
     opt = FedOptimizer([{"lr": 1.0}], args)
 
     spe = steps_per_epoch(args.local_batch_size, train_ds,

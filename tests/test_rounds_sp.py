@@ -156,3 +156,35 @@ def test_sp_round_client_mask():
         {k: v[:1] for k, v in batch.items()}, 1.0, 1.0)
     np.testing.assert_allclose(np.asarray(agg_sp), np.asarray(agg_ref),
                                rtol=5e-4, atol=2e-5)
+
+
+def test_gpt2_train_cli_seq_devices(tmp_path):
+    """Full trainer path with --seq_devices: sequence-parallel client
+    rounds feeding the sketch-mode server step."""
+    from commefficient_tpu.train import gpt2_train
+
+    results = gpt2_train.main([
+        "--test", "--dataset_name", "PERSONA",
+        "--dataset_dir", str(tmp_path / "data"),
+        "--mode", "sketch", "--error_type", "virtual",
+        "--local_momentum", "0", "--virtual_momentum", "0.9",
+        "--num_workers", "2", "--local_batch_size", "2",
+        "--num_epochs", "1", "--seq_devices", "4",
+    ])
+    assert len(results) == 1
+    assert np.isfinite(results[0]["train_loss"])
+    assert np.isfinite(results[0]["val_ppl"])
+
+
+def test_seq_devices_rejects_local_state_modes(tmp_path):
+    from commefficient_tpu.train import gpt2_train
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        gpt2_train.main([
+            "--test", "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path / "data"),
+            "--mode", "local_topk", "--error_type", "local",
+            "--num_workers", "2", "--local_batch_size", "2",
+            "--num_epochs", "1", "--seq_devices", "4",
+        ])
