@@ -40,6 +40,36 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devices), (CLIENT_AXIS,))
 
 
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> int:
+    """Join the JAX multi-controller runtime for multi-host pods — the
+    TPU counterpart of the reference's NCCL process-group init
+    (fed_aggregator.py:161-165), except one call replaces the whole
+    PS/worker rank topology. After it returns, ``jax.devices()`` spans
+    every host, ``make_mesh()`` covers ICI+DCN, and the per-round
+    ``psum`` is routed hierarchically by XLA. On Cloud TPU the
+    arguments are auto-detected from the environment; pass them
+    explicitly elsewhere. Returns this process's index.
+
+    No-op (returns the current process index) when the runtime is
+    already initialised or when no cluster is detectable (plain
+    single-process dev machine)."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except RuntimeError:
+        # jax raises RuntimeError("...should only be called once.")
+        # on double-init — the runtime is up, which is what we want
+        pass
+    except ValueError:
+        # no coordinator address and none auto-detectable: plain
+        # single-process run; jax.process_index() below returns 0
+        pass
+    return jax.process_index()
+
+
 def client_sharding(mesh: Mesh) -> NamedSharding:
     """Shard leading (client) axis across the mesh."""
     return NamedSharding(mesh, P(CLIENT_AXIS))

@@ -299,3 +299,58 @@ class TestModeLattice:
         want = run_mirror(cfg_plain, self.W0, self.rounds(14, B=3),
                           lr=0.03)
         np.testing.assert_allclose(got[0], want[0], rtol=1e-4, atol=1e-5)
+
+
+class TestTopkDown:
+    """--topk_down stale-client weight download (reference
+    get_new_worker_weights, fed_worker.py:234-249)."""
+
+    def test_stale_weight_download_applies_topk_of_diff(self):
+        import jax.numpy as jnp
+        from commefficient_tpu.core.client import stale_weight_download
+
+        cfg = make_cfg(mode="true_topk", error_type="virtual",
+                       do_topk_down=True, k=2)
+        ps = jnp.asarray(np.array([1.0, 5.0, -3.0, 0.5, 0.1],
+                                  np.float32))
+        local = jnp.zeros(5, jnp.float32)
+        out = np.asarray(stale_weight_download(cfg, ps, local))
+        # only the two largest-|diff| coords (5.0 and -3.0) download
+        np.testing.assert_array_equal(
+            out, np.array([0.0, 5.0, -3.0, 0.0, 0.0], np.float32))
+
+    def test_round_engine_tracks_client_weights(self):
+        """Under --topk_down the engine keeps per-client stale weights
+        and each participating client only catches up by top-k."""
+        import jax
+        import jax.numpy as jnp
+        from commefficient_tpu.core.rounds import (ClientStates,
+                                                   build_client_round)
+
+        d, k, W = 12, 3, 2
+        cfg = make_cfg(mode="true_topk", error_type="virtual",
+                       local_momentum=0.0, do_topk_down=True, k=k,
+                       num_workers=W, local_batch_size=2)
+        cfg.grad_size = d
+
+        def loss(p, batch):
+            # quadratic -> grad = p - target rows
+            t = jnp.sum(batch["x"], axis=0)
+            return (0.5 * jnp.sum((p - t) ** 2), (jnp.float32(0.0),))
+
+        round_fn = jax.jit(build_client_round(cfg, loss, 2))
+        ps = jnp.asarray(np.linspace(1, 4, d).astype(np.float32))
+        cs = ClientStates.init(cfg, 4, jnp.zeros(d, jnp.float32))
+        assert cs.weights is not None and cs.weights.shape == (4, d)
+
+        batch = {"x": jnp.zeros((W, 2, d), jnp.float32),
+                 "mask": jnp.ones((W, 2), jnp.float32)}
+        ids = jnp.asarray([0, 2], jnp.int32)
+        res = round_fn(ps, cs, batch, ids, jax.random.PRNGKey(0), 1.0)
+
+        new_w = np.asarray(res.client_states.weights)
+        # participating clients moved by exactly k coords, others not
+        assert (np.count_nonzero(new_w[0]) == k
+                and np.count_nonzero(new_w[2]) == k)
+        np.testing.assert_array_equal(new_w[1], np.zeros(d))
+        np.testing.assert_array_equal(new_w[3], np.zeros(d))
