@@ -57,6 +57,11 @@ class Config:
     # this many chips (ring or ulysses attention). 1 = off.
     seq_devices: int = 1
     seq_impl: str = "ring"
+    # fault injection: each sampled client independently drops out of
+    # the round with this probability (its contribution is excluded
+    # and the round renormalises over the survivors). The reference
+    # has no dropout simulation (SURVEY §5 failure detection).
+    dropout_prob: float = 0.0
     seed: int = 21
 
     # model/data
@@ -249,6 +254,7 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--seq_devices", type=int, default=1)
     parser.add_argument("--seq_impl", choices=["ring", "ulysses"],
                         default="ring")
+    parser.add_argument("--dropout_prob", type=float, default=0.0)
     parser.add_argument("--tensorboard", dest="use_tensorboard",
                         action="store_true")
     parser.add_argument("--seed", type=int, default=21)

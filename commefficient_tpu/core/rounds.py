@@ -195,9 +195,23 @@ def _build_sgd_client_step(cfg, loss_fn, sketch, padded_batch_size):
             velocity if cfg.local_momentum > 0 else None,
             error if cfg.error_type == "local" else None,
             batch_size)
-        new_vel = upd.velocity if upd.velocity is not None else velocity
-        new_err = upd.error if upd.error is not None else error
-        return upd.transmit, metrics, new_vel, new_err, new_wts
+        # a dropped client (--dropout_prob zeroes its whole mask) ran
+        # nothing: it transmits 0 and its momentum/error state stays
+        # untouched — without this, local-momentum/-error modes would
+        # still upload rho*velocity / accumulated error for it
+        alive = (batch_size > 0).astype(jnp.float32)
+        transmit = upd.transmit * alive
+
+        def keep(new, old):
+            if new is None:
+                return old
+            if old is None:
+                return new
+            return jnp.where(alive > 0, new, old)
+
+        new_vel = keep(upd.velocity, velocity)
+        new_err = keep(upd.error, error)
+        return transmit, metrics, new_vel, new_err, new_wts
 
     return step
 

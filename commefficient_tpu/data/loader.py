@@ -26,10 +26,20 @@ __all__ = ["FedLoader", "ValLoader", "PersonaFedLoader",
 class _RoundLoaderBase:
     """Iterate federated train rounds. Rounds with fewer than
     ``num_workers`` distinct clients are skipped, matching the
-    reference's run_batches guard (cv_train.py:205-219)."""
+    reference's run_batches guard (cv_train.py:205-219).
+
+    ``dropout_prob`` injects client failures: each sampled client
+    independently drops with that probability — its mask rows are
+    zeroed, the engine excludes its transmit and leaves its
+    momentum/error state untouched, and the aggregate renormalises
+    over survivors (fault injection the reference lacks, SURVEY §5).
+    A fully-dropped round still executes with a zero aggregate (the
+    server's momentum coasts), keeping round counts, RNG streams and
+    the LR schedule identical across the Python and native loaders."""
 
     def __init__(self, dataset, sampler,
-                 max_batch_size: Optional[int] = None):
+                 max_batch_size: Optional[int] = None,
+                 dropout_prob: float = 0.0, dropout_seed: int = 0):
         self.dataset = dataset
         self.sampler = sampler
         if max_batch_size is not None:
@@ -39,12 +49,26 @@ class _RoundLoaderBase:
         else:
             self.B = int(np.max(dataset.data_per_client))
         self.W = sampler.num_workers
+        self.dropout_prob = dropout_prob
+        self._dropout_rng = np.random.RandomState(dropout_seed)
+
+    def _apply_dropout(self, batch: dict) -> dict:
+        """Zero dropped clients' mask rows."""
+        if self.dropout_prob <= 0.0:
+            return batch
+        drop = self._dropout_rng.rand(self.W) < self.dropout_prob
+        if drop.any():
+            batch = dict(batch)
+            mask = batch["mask"].copy()
+            mask[drop] = 0.0
+            batch["mask"] = mask
+        return batch
 
     def __iter__(self) -> Iterator[dict]:
         for round_spec in self.sampler:
             if len(round_spec) < self.W:
                 continue  # incomplete round: skip
-            yield self.collate(round_spec)
+            yield self._apply_dropout(self.collate(round_spec))
 
     def collate(self, round_spec) -> dict:
         raise NotImplementedError
@@ -102,8 +126,11 @@ class NativeFedLoader(_RoundLoaderBase):
 
     def __init__(self, dataset, sampler,
                  max_batch_size: Optional[int] = None,
-                 seed: int = 0, depth: int = 4, n_threads: int = 2):
-        super().__init__(dataset, sampler, max_batch_size)
+                 seed: int = 0, depth: int = 4, n_threads: int = 2,
+                 dropout_prob: float = 0.0, dropout_seed: int = 0):
+        super().__init__(dataset, sampler, max_batch_size,
+                         dropout_prob=dropout_prob,
+                         dropout_seed=dropout_seed)
         from commefficient_tpu import native
 
         if not native.available():
@@ -159,11 +186,12 @@ class NativeFedLoader(_RoundLoaderBase):
     def _pop(self, pf, pending):
         ids = pending.pop(0)
         x, y, m = pf.pop()
-        return {"client_ids": ids, "x": x, "y": y, "mask": m}
+        return self._apply_dropout(
+            {"client_ids": ids, "x": x, "y": y, "mask": m})
 
 
 def make_fed_loader(dataset, sampler, max_batch_size=None, seed=0,
-                    prefer_native=True):
+                    prefer_native=True, dropout_prob=0.0):
     """NativeFedLoader when the C++ path applies, FedLoader otherwise.
     The fallback is logged (once per call site reason) so a silently
     slow data path is visible; genuine bugs (TypeError etc.) still
@@ -171,12 +199,15 @@ def make_fed_loader(dataset, sampler, max_batch_size=None, seed=0,
     if prefer_native:
         try:
             return NativeFedLoader(dataset, sampler, max_batch_size,
-                                   seed=seed)
+                                   seed=seed,
+                                   dropout_prob=dropout_prob,
+                                   dropout_seed=seed)
         except RuntimeError as e:
             import warnings
             warnings.warn(f"native data-plane unavailable ({e}); "
                           "using the Python loader")
-    return FedLoader(dataset, sampler, max_batch_size)
+    return FedLoader(dataset, sampler, max_batch_size,
+                     dropout_prob=dropout_prob, dropout_seed=seed)
 
 
 class PersonaFedLoader(_RoundLoaderBase):
@@ -186,8 +217,11 @@ class PersonaFedLoader(_RoundLoaderBase):
 
     def __init__(self, dataset, sampler, num_candidates: int,
                  max_seq_len: int, pad_id: int = 0,
-                 max_batch_size: Optional[int] = None):
-        super().__init__(dataset, sampler, max_batch_size)
+                 max_batch_size: Optional[int] = None,
+                 dropout_prob: float = 0.0, dropout_seed: int = 0):
+        super().__init__(dataset, sampler, max_batch_size,
+                         dropout_prob=dropout_prob,
+                         dropout_seed=dropout_seed)
         self.N, self.T, self.pad_id = num_candidates, max_seq_len, pad_id
 
     def collate(self, round_spec) -> dict:

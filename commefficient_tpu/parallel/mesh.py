@@ -59,14 +59,19 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
-    except RuntimeError:
-        # jax raises RuntimeError("...should only be called once.")
-        # on double-init — the runtime is up, which is what we want
-        pass
+    except RuntimeError as e:
+        # double-init is fine (the runtime is up); anything else —
+        # connection/barrier failures on a real pod — must surface,
+        # or each host would silently train alone
+        if "only be called once" not in str(e):
+            raise
     except ValueError:
-        # no coordinator address and none auto-detectable: plain
-        # single-process run; jax.process_index() below returns 0
-        pass
+        # "coordinator_address should be defined": only tolerable in
+        # auto-detect mode on a plain single-process machine
+        if (coordinator_address is not None
+                or num_processes is not None
+                or process_id is not None):
+            raise
     return jax.process_index()
 
 

@@ -165,18 +165,25 @@ class FedModel:
         self.round_index += 1
 
         metrics = [np.asarray(m) for m in res.metrics]
-        return metrics + list(self._account_bytes(ids_np))
+        return metrics + list(self._account_bytes(ids_np,
+                                                  batch["mask"]))
 
-    def _account_bytes(self, ids_np):
+    def _account_bytes(self, ids_np, mask=None):
         """Per-round download/upload byte accounting (see module
-        docstring; reference fed_aggregator.py:171-196, 240-300)."""
+        docstring; reference fed_aggregator.py:171-196, 240-300).
+        ``mask`` (W, B) derives which clients completed the round:
+        dropped clients (--dropout_prob) downloaded weights but
+        uploaded nothing."""
         download_bytes = np.zeros(self.num_clients)
         changed = self.last_updated[None, :] > \
             self.client_last_seen[ids_np, None]
         download_bytes[ids_np] = 4.0 * changed.sum(axis=1)
         self.client_last_seen[ids_np] = self._update_round
         upload_bytes = np.zeros(self.num_clients)
-        upload_bytes[ids_np] = 4.0 * self.args.upload_floats_per_client
+        up_ids = ids_np
+        if mask is not None:
+            up_ids = ids_np[np.asarray(mask).sum(axis=1) > 0]
+        upload_bytes[up_ids] = 4.0 * self.args.upload_floats_per_client
         return download_bytes, upload_bytes
 
     def _call_val(self, batch):

@@ -115,4 +115,5 @@ class SeqParallelFedModel(FedModel):
         self.round_index += 1
 
         metrics = [np.full(W, float(loss), np.float64)]
-        return metrics + list(self._account_bytes(ids_np))
+        return metrics + list(self._account_bytes(ids_np,
+                                                  batch["mask"]))

@@ -84,8 +84,13 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                                            metrics[-2], metrics[-1])
             download_total += download
             upload_total += upload
-            losses.append(float(np.mean(loss)))
-            accs.append(float(np.mean(acc)))
+            # weight per-client metrics by real sample counts so
+            # dropped clients (--dropout_prob) and ragged batches
+            # don't dilute the reported numbers
+            w = np.asarray(batch["mask"]).sum(axis=1)
+            denom = max(w.sum(), 1.0)
+            losses.append(float(np.sum(loss * w) / denom))
+            accs.append(float(np.sum(acc * w) / denom))
             if not math.isfinite(losses[-1]) or \
                     losses[-1] > args.nan_threshold:
                 print(f"Stopping at batch {i}: diverged "
@@ -197,7 +202,8 @@ def get_data_loaders(args: Config):
     # and toolchain allow; Python loader otherwise (same batch dict)
     from commefficient_tpu.data import make_fed_loader
     train_loader = make_fed_loader(train_ds, sampler, seed=args.seed,
-                                   prefer_native=not args.do_test)
+                                   prefer_native=not args.do_test,
+                                   dropout_prob=args.dropout_prob)
     val_loader = ValLoader(val_ds, args.valid_batch_size,
                            shards_per_step=max(1, args.num_workers))
     return train_loader, val_loader, train_ds

@@ -103,7 +103,9 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
             lr_scheduler.step()
             metrics = model(batch)
             opt.step()
-            loss = float(np.mean(metrics[0]))
+            # sample-count weighting: see cv_train.run_batches
+            w = np.asarray(batch["mask"]).sum(axis=1)
+            loss = float(np.sum(metrics[0] * w) / max(w.sum(), 1.0))
             losses.append(loss)
             if not math.isfinite(loss) or loss > args.nan_threshold:
                 print(f"diverged at round {i} (loss {loss})")
@@ -227,7 +229,8 @@ def get_data_loaders(args: Config, tokenizer):
     sampler = FedSampler(train_ds, args.num_workers,
                          args.local_batch_size, seed=args.seed)
     train_loader = PersonaFedLoader(
-        train_ds, sampler, args.num_candidates, MAX_SEQ_LEN, pad_id)
+        train_ds, sampler, args.num_candidates, MAX_SEQ_LEN, pad_id,
+        dropout_prob=args.dropout_prob, dropout_seed=args.seed)
     val_loader = PersonaValLoader(
         val_ds, args.valid_batch_size, max(args.num_candidates, 2),
         MAX_SEQ_LEN, pad_id,

@@ -131,3 +131,144 @@ class TestTransforms:
         assert min(out.shape[:2]) == 50
         out = T.CenterCrop(40)(out)
         assert out.shape[:2] == (40, 40)
+
+
+class TestClientDropout:
+    """--dropout_prob fault injection: dropped clients' mask rows are
+    zeroed so the engine excludes them; fully-dropped rounds are
+    skipped (Python loader); deterministic per seed."""
+
+    def _loader(self, p, seed=3):
+        from commefficient_tpu.data.fed_sampler import FedSampler
+        from commefficient_tpu.data.loader import FedLoader
+        from commefficient_tpu.data.synthetic import FedSynthetic
+        from commefficient_tpu.data.transforms import (Compose,
+                                                       Normalize,
+                                                       ToFloat)
+        import numpy as np
+        tf = Compose([ToFloat(), Normalize(np.zeros(3, np.float32),
+                                           np.ones(3, np.float32))])
+        ds = FedSynthetic("", "Synthetic", transform=tf, num_classes=4,
+                          per_class=16, num_val=8, gen_seed=1)
+        return FedLoader(ds, FedSampler(ds, num_workers=2,
+                                        local_batch_size=4, seed=0),
+                         dropout_prob=p, dropout_seed=seed)
+
+    def test_some_clients_dropped(self):
+        import numpy as np
+        batches = list(self._loader(0.5))
+        per_client = np.concatenate(
+            [b["mask"].sum(axis=1) for b in batches])
+        assert (per_client == 0).any(), "expected some dropouts"
+        assert (per_client > 0).any(), "expected some survivors"
+
+    def test_no_dropout_by_default(self):
+        import numpy as np
+        batches = list(self._loader(0.0))
+        assert all((b["mask"].sum(axis=1) > 0).all() for b in batches)
+
+    def test_deterministic_per_seed(self):
+        import numpy as np
+        a = [b["mask"] for b in self._loader(0.5, seed=7)]
+        b = [b["mask"] for b in self._loader(0.5, seed=7)]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_dropped_client_excluded_from_aggregate(self):
+        """Engine semantics: zero-mask client contributes nothing and
+        the denominator renormalises over survivors."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.core.rounds import (ClientStates,
+                                                   build_client_round)
+
+        d = 6
+        cfg = Config(mode="uncompressed", error_type="none",
+                     local_momentum=0.0, num_workers=2,
+                     local_batch_size=2, num_clients=4,
+                     dataset_name="CIFAR10", seed=0)
+        cfg.grad_size = d
+
+        def loss(p, batch):
+            m = batch["mask"]
+            per = jnp.sum(batch["x"] * p[None, :], axis=1)
+            return (jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0),
+                    (jnp.float32(0.0),))
+
+        fn = jax.jit(build_client_round(cfg, loss, 2))
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 2, d).astype(np.float32))
+        p0 = jnp.zeros(d, jnp.float32)
+        cs = ClientStates.init(cfg, 4)
+        mask_full = jnp.ones((2, 2), jnp.float32)
+        mask_drop = jnp.asarray([[1, 1], [0, 0]], jnp.float32)
+
+        agg_drop = fn(p0, cs, {"x": x, "mask": mask_full * mask_drop},
+                      jnp.asarray([0, 1], jnp.int32),
+                      jax.random.PRNGKey(0), 1.0).aggregated
+        agg_solo = fn(p0, cs, {"x": x[:1].repeat(2, 0),
+                               "mask": jnp.asarray([[1, 1], [0, 0]],
+                                                   jnp.float32)},
+                      jnp.asarray([0, 1], jnp.int32),
+                      jax.random.PRNGKey(0), 1.0).aggregated
+        np.testing.assert_allclose(np.asarray(agg_drop),
+                                   np.asarray(agg_solo),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_dropped_client_state_untouched_in_stateful_modes(self):
+        """local_topk with momentum+error: a dropped client transmits
+        nothing and its velocity/error rows stay exactly as they
+        were (without the engine guard it would upload rho*velocity)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.core.rounds import (ClientStates,
+                                                   build_client_round)
+
+        d = 6
+        cfg = Config(mode="local_topk", error_type="local",
+                     local_momentum=0.9, num_workers=2,
+                     local_batch_size=2, num_clients=4, k=2,
+                     dataset_name="CIFAR10", seed=0)
+        cfg.grad_size = d
+
+        def loss(p, batch):
+            m = batch["mask"]
+            per = jnp.sum(batch["x"] * p[None, :], axis=1)
+            return (jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0),
+                    (jnp.float32(0.0),))
+
+        fn = jax.jit(build_client_round(cfg, loss, 2))
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 2, d).astype(np.float32))
+        cs = ClientStates(
+            velocities=jnp.asarray(
+                rng.randn(4, d).astype(np.float32)),
+            errors=jnp.asarray(rng.randn(4, d).astype(np.float32)),
+            weights=None)
+        mask = jnp.asarray([[1, 1], [0, 0]], jnp.float32)  # 1 dropped
+        res = fn(jnp.zeros(d, jnp.float32), cs,
+                 {"x": x, "mask": mask},
+                 jnp.asarray([0, 1], jnp.int32),
+                 jax.random.PRNGKey(0), 1.0)
+        new = res.client_states
+        # dropped client 1: state rows bit-identical
+        np.testing.assert_array_equal(np.asarray(new.velocities[1]),
+                                      np.asarray(cs.velocities[1]))
+        np.testing.assert_array_equal(np.asarray(new.errors[1]),
+                                      np.asarray(cs.errors[1]))
+        # survivor's state DID change
+        assert not np.array_equal(np.asarray(new.velocities[0]),
+                                  np.asarray(cs.velocities[0]))
+        # aggregated == survivor's own top-k transmit / its datapoints
+        solo = fn(jnp.zeros(d, jnp.float32), cs,
+                  {"x": x, "mask": jnp.asarray([[1, 1], [0, 0]],
+                                               jnp.float32)},
+                  jnp.asarray([0, 3], jnp.int32),
+                  jax.random.PRNGKey(0), 1.0)
+        np.testing.assert_allclose(np.asarray(res.aggregated),
+                                   np.asarray(solo.aggregated),
+                                   rtol=1e-6, atol=1e-7)

@@ -77,3 +77,66 @@ class TestImagenetModels:
         from commefficient_tpu.models.resnets import resnet18
         module = resnet18(num_classes=7)
         _fwd(module, (1, 28, 28, 1), 7)
+
+
+class TestBatchNormUnderClientVmap:
+    """SURVEY §7 hard part: with --batchnorm, batch statistics must
+    stay per-client under the vmapped round — sync-BN-style mixing
+    across the client axis would break the federated semantics. If
+    stats never mix, client contributions are additive: the two-client
+    round's aggregated gradient equals the weighted sum of the two
+    single-client rounds'."""
+
+    def test_per_client_batch_stats_additivity(self):
+        import jax
+        import jax.numpy as jnp
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.core.rounds import (ClientStates,
+                                                   build_client_round)
+        from commefficient_tpu.models import get_model
+        from commefficient_tpu.ops.vec import flatten_params
+        from commefficient_tpu.train.cv_train import make_compute_loss
+
+        cfg = Config(mode="uncompressed", error_type="none",
+                     local_momentum=0.0, virtual_momentum=0.0,
+                     weight_decay=0.0, num_workers=2,
+                     local_batch_size=4, num_clients=4,
+                     dataset_name="CIFAR10", seed=0)
+        module = get_model("ResNet9")(
+            num_classes=10, do_batchnorm=True,
+            channels={"prep": 2, "layer1": 2, "layer2": 2,
+                      "layer3": 2})
+        variables = module.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 32, 32, 3)), train=True)
+        flat, unravel = flatten_params(variables["params"])
+        cfg.grad_size = int(flat.size)
+        loss = make_compute_loss(module, variables.get("batch_stats"))
+
+        def loss_flat(p, batch):
+            return loss(unravel(p), batch, cfg)
+
+        rng = np.random.RandomState(0)
+        xa = rng.randn(1, 4, 32, 32, 3).astype(np.float32)
+        xb = rng.randn(1, 4, 32, 32, 3).astype(np.float32)
+        ya = rng.randint(0, 10, (1, 4)).astype(np.int32)
+        yb = rng.randint(0, 10, (1, 4)).astype(np.int32)
+        ones = np.ones((1, 4), np.float32)
+
+        def agg(x, y, m, W):
+            c = Config(**{**cfg.__dict__, "num_workers": W})
+            fn = jax.jit(build_client_round(c, loss_flat, 4))
+            cs = ClientStates.init(c, 4)
+            res = fn(flat, cs,
+                     {"x": jnp.asarray(x), "y": jnp.asarray(y),
+                      "mask": jnp.asarray(m)},
+                     jnp.arange(W, dtype=jnp.int32),
+                     jax.random.PRNGKey(0), 1.0)
+            return np.asarray(res.aggregated)
+
+        both = agg(np.concatenate([xa, xb]), np.concatenate([ya, yb]),
+                   np.concatenate([ones, ones]), 2)
+        solo_a = agg(xa, ya, ones, 1)
+        solo_b = agg(xb, yb, ones, 1)
+        # each solo agg = g_sum/4; both = (gA_sum + gB_sum)/8
+        np.testing.assert_allclose(both, (solo_a + solo_b) / 2.0,
+                                   rtol=2e-4, atol=2e-5)
