@@ -62,6 +62,12 @@ class Config:
     # and the round renormalises over the survivors). The reference
     # has no dropout simulation (SURVEY §5 failure detection).
     dropout_prob: float = 0.0
+    # mixup augmentation for CV training. The reference's imagenet.sh
+    # passes --mixup/--mixup_alpha but its parse_args never defines
+    # them and its compute_loss_mixup is dead code (SURVEY §2.7);
+    # here they work (host-side per-client mixing, lam ~ Beta(a, a)).
+    do_mixup: bool = False
+    mixup_alpha: float = 1.0
     seed: int = 21
 
     # model/data
@@ -255,6 +261,8 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--seq_impl", choices=["ring", "ulysses"],
                         default="ring")
     parser.add_argument("--dropout_prob", type=float, default=0.0)
+    parser.add_argument("--mixup", action="store_true", dest="do_mixup")
+    parser.add_argument("--mixup_alpha", type=float, default=1.0)
     parser.add_argument("--tensorboard", dest="use_tensorboard",
                         action="store_true")
     parser.add_argument("--seed", type=int, default=21)

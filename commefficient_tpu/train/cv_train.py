@@ -37,7 +37,14 @@ def masked_mean(values, mask):
 
 def make_compute_loss(module, init_stats=None):
     """CE loss + accuracy (reference compute_loss_ce,
-    cv_train.py:32-50), masked-mean over real samples."""
+    cv_train.py:32-50), masked-mean over real samples.
+
+    Mixup support: when the batch carries ``y_b``/``lam`` (added by
+    ``apply_mixup`` under ``--mixup``), the loss becomes
+    lam*CE(y) + (1-lam)*CE(y_b) — the reference ships this as dead
+    code (compute_loss_mixup is never wired and its mixup_data helper
+    doesn't exist, SURVEY §2.7); here it works. Accuracy is reported
+    against the dominant label."""
 
     def compute_loss(params, batch, args):
         variables = {"params": params}
@@ -49,19 +56,54 @@ def make_compute_loss(module, init_stats=None):
             logits = module.apply(variables, batch["x"])
         labels = batch["y"]
         logp = jax.nn.log_softmax(logits)
-        nll = -jnp.take_along_axis(logp, labels[..., None],
-                                   axis=-1)[..., 0]
+
+        def nll_of(lab):
+            return -jnp.take_along_axis(logp, lab[..., None],
+                                        axis=-1)[..., 0]
+
+        if "y_b" in batch:
+            lam = batch["lam"]  # per-sample (broadcast of round lam)
+            nll = lam * nll_of(labels) \
+                + (1.0 - lam) * nll_of(batch["y_b"])
+            dominant = jnp.where(lam >= 0.5, labels, batch["y_b"])
+        else:
+            nll = nll_of(labels)
+            dominant = labels
         loss = masked_mean(nll, batch["mask"])
         acc = masked_mean(
-            (jnp.argmax(logits, -1) == labels).astype(jnp.float32),
+            (jnp.argmax(logits, -1) == dominant).astype(jnp.float32),
             batch["mask"])
         return loss, (acc,)
 
     return compute_loss
 
 
+def apply_mixup(batch, alpha, rng):
+    """Host-side mixup (the classic mixup_data recipe): one lambda ~
+    Beta(alpha, alpha) per round; inputs are mixed with a permutation
+    WITHIN each client's real rows (mixing across clients would leak
+    data between federated clients)."""
+    lam = float(rng.beta(alpha, alpha)) if alpha > 0 else 1.0
+    x = np.asarray(batch["x"]).copy()
+    y = np.asarray(batch["y"])
+    mask = np.asarray(batch["mask"])
+    y_b = y.copy()
+    for w in range(x.shape[0]):
+        real = np.nonzero(mask[w] > 0)[0]
+        if len(real) < 2:
+            continue
+        perm = real[rng.permutation(len(real))]
+        x[w, real] = lam * x[w, real] + (1 - lam) * x[w, perm]
+        y_b[w, real] = y[w, perm]
+    out = dict(batch)
+    out["x"] = x
+    out["y_b"] = y_b
+    out["lam"] = np.full_like(mask, lam)
+    return out
+
+
 def run_batches(model, opt, lr_scheduler, loader, args, training,
-                logger=None, epoch_fraction=1.0):
+                logger=None, epoch_fraction=1.0, mixup_rng=None):
     """(reference cv_train.py:171-252)"""
     if training:
         model.train(True)
@@ -73,6 +115,8 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
         for i, batch in enumerate(loader):
             if i >= max_batches:
                 break
+            if mixup_rng is not None:
+                batch = apply_mixup(batch, args.mixup_alpha, mixup_rng)
             lr_scheduler.step()
             if opt.param_groups[0]["lr"] == 0:
                 # "HACK STEP": keep FedAvg's schedule aligned when the
@@ -86,11 +130,13 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
             upload_total += upload
             # weight per-client metrics by real sample counts so
             # dropped clients (--dropout_prob) and ragged batches
-            # don't dilute the reported numbers
+            # don't dilute the reported numbers; fully-dropped rounds
+            # trained on nothing and are excluded from the epoch means
             w = np.asarray(batch["mask"]).sum(axis=1)
-            denom = max(w.sum(), 1.0)
-            losses.append(float(np.sum(loss * w) / denom))
-            accs.append(float(np.sum(acc * w) / denom))
+            if w.sum() == 0:
+                continue
+            losses.append(float(np.sum(loss * w) / w.sum()))
+            accs.append(float(np.sum(acc * w) / w.sum()))
             if not math.isfinite(losses[-1]) or \
                     losses[-1] > args.nan_threshold:
                 print(f"Stopping at batch {i}: diverged "
@@ -98,6 +144,9 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                 return None
             if args.do_test:
                 break
+        if not losses:  # every round fully dropped
+            return (float("nan"), float("nan"),
+                    download_total, upload_total)
         return (np.mean(losses), np.mean(accs),
                 download_total, upload_total)
     else:
@@ -131,13 +180,17 @@ def train(model, opt, lr_scheduler, train_loader, val_loader, args,
     writer = make_summary_writer(args, logdir)
     results = []
     num_epochs = args.num_epochs
+    # one persistent mixup stream across epochs (fresh draws per round)
+    mixup_rng = (np.random.RandomState(args.seed + 77)
+                 if args.do_mixup else None)
     try:
         for epoch in range(start_epoch, math.ceil(num_epochs)):
             epoch_fraction = min(1.0, num_epochs - epoch)
             with profile_epoch(args, epoch, start_epoch, logdir):
                 out = run_batches(model, opt, lr_scheduler,
                                   train_loader, args, training=True,
-                                  epoch_fraction=epoch_fraction)
+                                  epoch_fraction=epoch_fraction,
+                                  mixup_rng=mixup_rng)
             if out is None:
                 print("NaN detected, aborting training")
                 return results

@@ -103,16 +103,19 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
             lr_scheduler.step()
             metrics = model(batch)
             opt.step()
-            # sample-count weighting: see cv_train.run_batches
+            # sample-count weighting: see cv_train.run_batches;
+            # fully-dropped rounds trained on nothing — excluded
             w = np.asarray(batch["mask"]).sum(axis=1)
-            loss = float(np.sum(metrics[0] * w) / max(w.sum(), 1.0))
+            if w.sum() == 0:
+                continue
+            loss = float(np.sum(metrics[0] * w) / w.sum())
             losses.append(loss)
             if not math.isfinite(loss) or loss > args.nan_threshold:
                 print(f"diverged at round {i} (loss {loss})")
                 return None
             if args.do_test:
                 break
-        return float(np.mean(losses))
+        return float(np.mean(losses)) if losses else float("nan")
     else:
         model.train(False)
         nlls, accs, counts = [], [], []

@@ -29,4 +29,6 @@ python -m commefficient_tpu.train.cv_train \
     --k 1000000 \
     --num_rows 1 \
     --num_cols 10000000 \
+    --mixup \
+    --mixup_alpha 0.2 \
     "$@"

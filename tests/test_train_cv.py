@@ -93,3 +93,42 @@ class TestFinetune:
         out = cv_train.main(base + ["--finetune",
                                     "--finetune_path", str(tmp_path)])
         assert len(out) == 1
+
+
+class TestMixup:
+    def test_apply_mixup_mixes_within_client_only(self):
+        import numpy as np
+        from commefficient_tpu.train.cv_train import apply_mixup
+
+        rng = np.random.RandomState(0)
+        W, B = 2, 4
+        x = np.arange(W * B, dtype=np.float32).reshape(W, B, 1, 1, 1)
+        y = np.arange(W * B, dtype=np.int32).reshape(W, B)
+        mask = np.ones((W, B), np.float32)
+        mask[1, 2:] = 0.0  # client 1 has 2 real rows
+        out = apply_mixup({"x": x, "y": y, "mask": mask}, 1.0, rng)
+        lam = out["lam"][0, 0]
+        assert 0.0 <= lam <= 1.0
+        # mixed values stay within each client's own row range
+        for w in range(W):
+            real = np.nonzero(mask[w] > 0)[0]
+            lo, hi = x[w, real].min(), x[w, real].max()
+            assert (out["x"][w, real] >= lo - 1e-6).all()
+            assert (out["x"][w, real] <= hi + 1e-6).all()
+            # y_b is a permutation of the client's own labels
+            assert set(out["y_b"][w, real]) <= set(y[w, real])
+        # padded rows untouched
+        np.testing.assert_array_equal(out["x"][1, 2:], x[1, 2:])
+
+    def test_mixup_end_to_end_smoke(self):
+        from commefficient_tpu.train import cv_train
+
+        results = cv_train.main([
+            "--test", "--dataset_name", "Synthetic",
+            "--mode", "uncompressed", "--error_type", "none",
+            "--local_momentum", "0", "--num_clients", "10",
+            "--num_workers", "2", "--local_batch_size", "4",
+            "--num_epochs", "1", "--lr_scale", "0.1",
+            "--pivot_epoch", "1", "--mixup", "--mixup_alpha", "0.5",
+        ])
+        assert np.isfinite(results[-1]["train_loss"])
