@@ -48,16 +48,28 @@ class ClientStates(NamedTuple):
 
     @staticmethod
     def init(cfg: Config, num_clients: int,
-             ps_weights: Optional[jax.Array] = None) -> "ClientStates":
-        shape = (num_clients,) + cfg.transmit_shape
-        vel = jnp.zeros(shape, jnp.float32) if cfg.local_momentum > 0 else None
-        err = (jnp.zeros(shape, jnp.float32)
+             ps_weights: Optional[jax.Array] = None,
+             sharding=None) -> "ClientStates":
+        """``sharding`` (a NamedSharding over the client axis) creates
+        the big (rows, ...) buffers directly sharded — at
+        EMNIST/PERSONA scale a replicated allocation would not fit one
+        device. NamedSharding requires the leading dim to divide the
+        mesh, so rows are padded up to the next multiple; padded rows
+        are never indexed (client ids < num_clients)."""
+        rows = num_clients
+        if sharding is not None:
+            n = sharding.mesh.devices.size
+            rows = -(-num_clients // n) * n
+        shape = (rows,) + cfg.transmit_shape
+        vel = (jnp.zeros(shape, jnp.float32, device=sharding)
+               if cfg.local_momentum > 0 else None)
+        err = (jnp.zeros(shape, jnp.float32, device=sharding)
                if cfg.error_type == "local" else None)
         wts = None
         if cfg.do_topk_down:
             assert ps_weights is not None
-            wts = jnp.broadcast_to(ps_weights,
-                                   (num_clients, cfg.grad_size)).copy()
+            wts = (jnp.zeros((rows, cfg.grad_size), jnp.float32,
+                             device=sharding) + ps_weights[None, :])
         return ClientStates(vel, err, wts)
 
 

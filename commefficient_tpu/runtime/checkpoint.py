@@ -153,10 +153,21 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
         from commefficient_tpu.parallel.mesh import client_sharding
 
         # per-client state rows were sharded over the clients axis at
-        # init (FedModel.__init__) — restore with the same placement
+        # init (FedModel.__init__) — restore with the same placement.
+        # Row padding depends on the mesh size, so a checkpoint taken
+        # on a different device count is repadded here (padded rows
+        # hold no information: client ids never index them).
         csh = client_sharding(model.mesh)
+        n_dev = model.mesh.devices.size
+        nc = int(model.num_clients)
+        rows = -(-nc // n_dev) * n_dev
 
         def put_client_rows(arr):
+            arr = np.asarray(arr)[:nc]
+            if arr.shape[0] < rows:
+                pad = np.zeros((rows - arr.shape[0],) + arr.shape[1:],
+                               arr.dtype)
+                arr = np.concatenate([arr, pad])
             return jax.device_put(jnp.asarray(arr), csh)
 
         model.ps_weights = jnp.asarray(z["ps_weights"])

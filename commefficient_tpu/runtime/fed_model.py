@@ -82,16 +82,11 @@ class FedModel:
         self.num_clients = num_clients
 
         self.ps_weights = flat
-        self.client_states = ClientStates.init(args, num_clients, flat)
-        if self.client_states.velocities is not None:
-            sh = client_sharding(self.mesh)
-            self.client_states = self.client_states._replace(
-                velocities=jax.device_put(self.client_states.velocities,
-                                          sh))
-        if self.client_states.errors is not None:
-            sh = client_sharding(self.mesh)
-            self.client_states = self.client_states._replace(
-                errors=jax.device_put(self.client_states.errors, sh))
+        # big per-client buffers created directly sharded over the
+        # client axis, row-padded to the mesh size — never
+        # materialised replicated (see ClientStates.init)
+        self.client_states = ClientStates.init(
+            args, num_clients, flat, sharding=client_sharding(self.mesh))
 
         if padded_batch_size is None:
             padded_batch_size = (args.local_batch_size
@@ -105,9 +100,13 @@ class FedModel:
             return self.compute_loss_val(self.unravel(flat_params),
                                          batch, args)
 
+        # donate the per-client state buffers: the round returns their
+        # updated versions and the stale ones are never read again —
+        # halves peak memory for local-momentum/-error modes at scale
         self._client_round = jax.jit(
             build_client_round(args, loss_flat, padded_batch_size,
-                               mesh=self.mesh))
+                               mesh=self.mesh),
+            donate_argnums=(1,))
         self._val_fn = jax.jit(build_val_fn(args, loss_flat_val))
 
         # pending round state consumed by FedOptimizer.step
