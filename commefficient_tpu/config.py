@@ -116,7 +116,10 @@ class Config:
     num_clients: Optional[int] = None
     num_workers: int = 1  # participating clients per round
     device: str = "tpu"
-    num_devices: int = 1
+    # number of TPU devices for the mesh; <= 0 = all available (the
+    # reference's flag counted GPUs and defaulted to 1 — here a single
+    # jitted program spans the mesh, so "all" is the natural default)
+    num_devices: int = -1
     share_ps_gpu: bool = False  # parity no-op: there is no PS rank
     do_iid: bool = False
     train_dataloader_workers: int = 0
@@ -319,7 +322,7 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--num_workers", type=int, default=1)
     parser.add_argument("--device", type=str,
                         choices=["cpu", "tpu", "cuda"], default="tpu")
-    parser.add_argument("--num_devices", type=int, default=1)
+    parser.add_argument("--num_devices", type=int, default=-1)
     parser.add_argument("--share_ps_gpu", action="store_true")
     parser.add_argument("--iid", action="store_true", dest="do_iid")
     parser.add_argument("--train_dataloader_workers", type=int, default=0)

@@ -58,8 +58,8 @@ class ClientStates(NamedTuple):
         are never indexed (client ids < num_clients)."""
         rows = num_clients
         if sharding is not None:
-            n = sharding.mesh.devices.size
-            rows = -(-num_clients // n) * n
+            from commefficient_tpu.parallel.mesh import padded_rows
+            rows = padded_rows(num_clients, sharding.mesh)
         shape = (rows,) + cfg.transmit_shape
         vel = (jnp.zeros(shape, jnp.float32, device=sharding)
                if cfg.local_momentum > 0 else None)

@@ -80,6 +80,16 @@ def client_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(CLIENT_AXIS))
 
 
+def padded_rows(num_clients: int, mesh: Mesh) -> int:
+    """Leading-dim size for client-axis-sharded state buffers:
+    NamedSharding rejects non-divisible dims, so round up to the mesh
+    size (padded rows are never indexed — client ids < num_clients).
+    Single source of truth for ClientStates.init and checkpoint
+    restore."""
+    n = mesh.devices.size
+    return -(-num_clients // n) * n
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

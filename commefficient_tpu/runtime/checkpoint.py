@@ -157,10 +157,11 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
         # Row padding depends on the mesh size, so a checkpoint taken
         # on a different device count is repadded here (padded rows
         # hold no information: client ids never index them).
+        from commefficient_tpu.parallel.mesh import padded_rows
+
         csh = client_sharding(model.mesh)
-        n_dev = model.mesh.devices.size
         nc = int(model.num_clients)
-        rows = -(-nc // n_dev) * n_dev
+        rows = padded_rows(nc, model.mesh)
 
         def put_client_rows(arr):
             arr = np.asarray(arr)[:nc]
@@ -168,7 +169,9 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
                 pad = np.zeros((rows - arr.shape[0],) + arr.shape[1:],
                                arr.dtype)
                 arr = np.concatenate([arr, pad])
-            return jax.device_put(jnp.asarray(arr), csh)
+            # device_put straight from host numpy: transfers each
+            # shard to its device without a replicated stopover
+            return jax.device_put(arr, csh)
 
         model.ps_weights = jnp.asarray(z["ps_weights"])
         cs = model.client_states

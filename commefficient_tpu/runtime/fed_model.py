@@ -73,7 +73,21 @@ class FedModel:
         flat, unravel = flatten_params(params)
         args.grad_size = int(flat.size)
         self.unravel = unravel
-        self.mesh = mesh or make_mesh()
+        if mesh is None:
+            devices = jax.devices()
+            if args.num_devices > 0:
+                if args.num_devices > len(devices):
+                    raise ValueError(
+                        f"--num_devices {args.num_devices} > "
+                        f"{len(devices)} available devices")
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "--num_devices is a single-host knob; on "
+                        "multi-host pods the mesh must span every "
+                        "process's devices (leave it at -1)")
+                devices = devices[: args.num_devices]
+            mesh = make_mesh(devices)
+        self.mesh = mesh
 
         num_clients = args.num_clients
         if num_clients is None:
