@@ -1,0 +1,61 @@
+"""Per-model training configs — a WORKING version of the reference's
+models/configs.py (which is bit-rotted: it uses PiecewiseLinear
+without importing it and is never wired into the trainers,
+SURVEY.md §2.6).
+
+``ModelConfig.set_args(args)`` overlays recommended hyperparameters
+onto a parsed Config, but only for fields the user left at their CLI
+defaults — explicit flags always win. ``lr_schedule(epoch)`` (when a
+config defines one) replaces the default triangular schedule in
+cv_train.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from commefficient_tpu.utils import PiecewiseLinear
+
+
+class ModelConfig:
+    #: fields overlaid onto args (name -> value)
+    overrides: dict = {}
+    #: epoch -> multiplier SHAPE with peak 1.0; the effective LR is
+    #: args.lr_scale * shape(epoch), so an explicit --lr_scale always
+    #: takes effect. None = keep the triangular default schedule.
+    lr_schedule_shape: Optional[PiecewiseLinear] = None
+
+    def set_args(self, args, parser_defaults: dict):
+        """Overlay recommended values onto fields still at their
+        parser defaults. (The reference unconditionally clobbered user
+        flags; note argparse cannot distinguish an omitted flag from
+        one explicitly passed at its default value — those are
+        overlaid too.)"""
+        applied = {}
+        for name, val in self.overrides.items():
+            if getattr(args, name) == parser_defaults.get(name,
+                                                          object()):
+                setattr(args, name, val)
+                applied[name] = val
+        return applied
+
+
+class FixupResNet50Config(ModelConfig):
+    """ImageNet FixupResNet50 step schedule (reference
+    configs.py:9-16): peak lr_scale 0.1 decayed 10x at epochs
+    30/60/90 (shape below x lr_scale)."""
+    overrides = {"lr_scale": 0.1, "weight_decay": 1e-4,
+                 "num_epochs": 100.0}
+    lr_schedule_shape = PiecewiseLinear(
+        [0, 30, 30, 60, 60, 90, 90, 100],
+        [1.0, 1.0, 0.1, 0.1, 0.01, 0.01, 0.001, 0.001])
+
+
+MODEL_CONFIGS = {
+    "FixupResNet50": FixupResNet50Config,
+}
+
+
+def get_model_config(model_name: str) -> Optional[ModelConfig]:
+    cls = MODEL_CONFIGS.get(model_name)
+    return cls() if cls is not None else None

@@ -332,13 +332,30 @@ def load_finetune_params(args, params):
     return merged
 
 
+DEFAULT_LR = 0.4
+
+
 def main(argv=None):
-    args = parse_args(default_lr=0.4, argv=argv)
+    args = parse_args(default_lr=DEFAULT_LR, argv=argv)
     if args.seq_devices > 1:
         raise ValueError("--seq_devices is a GPT-2 trainer feature "
                          "(sequence parallelism); cv models have no "
                          "sequence axis")
     np.random.seed(args.seed)
+
+    model_cfg = None
+    if not args.do_test:
+        # overlay per-model recommended hyperparameters onto fields
+        # the user left at their defaults (models/configs.py)
+        from commefficient_tpu.models.configs import get_model_config
+        model_cfg = get_model_config(args.model)
+        if model_cfg is not None:
+            defaults = parse_args(default_lr=DEFAULT_LR,
+                                  argv=[]).__dict__
+            applied = model_cfg.set_args(args, defaults)
+            if applied:
+                print(f"model config {type(model_cfg).__name__}: "
+                      f"{applied}")
 
     if args.do_test:
         # tiny sketch like the reference smoke mode (cv_train.py:329-336)
@@ -363,10 +380,19 @@ def main(argv=None):
     spe = steps_per_epoch(args.local_batch_size, train_ds,
                           args.num_workers)
     horizon = args.schedule_epochs or args.num_epochs
-    lambda_step = PiecewiseLinear(
-        [0, args.pivot_epoch * spe, horizon * spe],
-        [0, args.lr_scale, 0])
-    lr_scheduler = LambdaLR(opt, lambda x: lambda_step(x))
+    if model_cfg is not None \
+            and model_cfg.lr_schedule_shape is not None:
+        # per-model epoch-indexed shape x args.lr_scale (the working
+        # form of the reference's ModelConfig pattern) — an explicit
+        # --lr_scale still takes effect
+        shape = model_cfg.lr_schedule_shape
+        lr_scheduler = LambdaLR(
+            opt, lambda x: args.lr_scale * shape(x / spe))
+    else:
+        lambda_step = PiecewiseLinear(
+            [0, args.pivot_epoch * spe, horizon * spe],
+            [0, args.lr_scale, 0])
+        lr_scheduler = LambdaLR(opt, lambda x: lambda_step(x))
 
     from commefficient_tpu.runtime.checkpoint import setup_resume
     start_epoch, epoch_hook = setup_resume(args, model, opt,

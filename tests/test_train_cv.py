@@ -132,3 +132,28 @@ class TestMixup:
             "--pivot_epoch", "1", "--mixup", "--mixup_alpha", "0.5",
         ])
         assert np.isfinite(results[-1]["train_loss"])
+
+
+class TestModelConfigs:
+    def test_fixup50_overlay_respects_explicit_flags(self):
+        from commefficient_tpu.config import parse_args
+        from commefficient_tpu.models.configs import get_model_config
+
+        defaults = parse_args(0.4, []).__dict__
+        mc = get_model_config("FixupResNet50")
+        # user left lr_scale at default, set weight_decay explicitly
+        args = parse_args(0.4, ["--model", "FixupResNet50",
+                                "--weight_decay", "0.123"])
+        applied = mc.set_args(args, defaults)
+        assert args.lr_scale == 0.1 and "lr_scale" in applied
+        assert args.weight_decay == 0.123  # explicit flag wins
+        assert "weight_decay" not in applied
+        # shape: peak 1.0, 10x decays at 30/60/90; effective LR is
+        # args.lr_scale * shape(epoch)
+        assert abs(mc.lr_schedule_shape(0) - 1.0) < 1e-9
+        assert abs(mc.lr_schedule_shape(45) - 0.1) < 1e-9
+        assert abs(mc.lr_schedule_shape(95) - 0.001) < 1e-9
+
+    def test_unknown_model_has_no_config(self):
+        from commefficient_tpu.models.configs import get_model_config
+        assert get_model_config("ResNet9") is None
