@@ -149,3 +149,21 @@ class TestCountSketch:
         # dof = c-1; mean c, sd sqrt(2c): allow 5 sd
         assert chi2 < cs.c + 5 * np.sqrt(2 * cs.c)
         assert abs(float(jnp.mean(signs))) < 0.05
+
+
+class TestKExceedingD:
+    def test_topk_k_exceeding_d_is_total(self):
+        import jax.numpy as jnp
+        from commefficient_tpu.ops.topk import topk
+
+        v = jnp.array([3.0, -1.0, 2.0], jnp.float32)
+        np.testing.assert_array_equal(np.asarray(topk(v, k=10)),
+                                      np.asarray(v))
+
+    def test_unsketch_k_exceeding_d(self):
+        from commefficient_tpu.ops.sketch import CountSketch
+
+        cs = CountSketch(d=50, c=32, r=3, backend="xla")
+        v = np.random.RandomState(0).randn(50).astype(np.float32)
+        out = cs.unsketch(cs.sketch(v), k=100)  # k > d
+        assert out.shape == (50,)

@@ -157,3 +157,23 @@ class TestModelConfigs:
     def test_unknown_model_has_no_config(self):
         from commefficient_tpu.models.configs import get_model_config
         assert get_model_config("ResNet9") is None
+
+
+class TestDeterminism:
+    def test_same_seed_identical_training(self):
+        """Two identical runs (same seed) must produce bit-identical
+        epoch metrics end to end (engine, data order, init)."""
+        base = [
+            "--test", "--dataset_name", "Synthetic",
+            "--mode", "sketch", "--error_type", "virtual",
+            "--local_momentum", "0", "--virtual_momentum", "0.9",
+            "--num_clients", "10", "--num_workers", "2",
+            "--local_batch_size", "4", "--num_epochs", "2",
+            "--lr_scale", "0.1", "--pivot_epoch", "1", "--seed", "33",
+        ]
+        a = cv_train.main(base)
+        b = cv_train.main(base)
+        assert len(a) == len(b) == 2
+        for ra, rb in zip(a, b):
+            assert ra["train_loss"] == rb["train_loss"]
+            assert ra["test_acc"] == rb["test_acc"]
