@@ -138,7 +138,8 @@ def test_make_fed_loader_fallback_on_unsupported_transform():
     from commefficient_tpu.data.transforms import RandomRotation
     tf = Compose([ToFloat(), RandomRotation(5), Normalize(MEAN, STD)])
     ds = _dataset(tf)
-    loader = make_fed_loader(ds, _sampler(ds))
+    with pytest.warns(UserWarning, match="native data-plane"):
+        loader = make_fed_loader(ds, _sampler(ds))
     assert isinstance(loader, FedLoader)
     tf2 = Compose([ToFloat(), Normalize(MEAN, STD)])
     ds2 = _dataset(tf2)
