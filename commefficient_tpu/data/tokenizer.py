@@ -23,6 +23,20 @@ from typing import Dict, List
 SPECIAL_TOKENS = ["<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>"]
 
 
+def _read_special(save_dir: str) -> Dict[str, int]:
+    path = os.path.join(save_dir, "special_tokens.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return {k: int(v) for k, v in json.load(f).items()}
+
+
+def _write_special(save_dir: str, special: Dict[str, int]) -> None:
+    os.makedirs(save_dir, exist_ok=True)
+    with open(os.path.join(save_dir, "special_tokens.json"), "w") as f:
+        json.dump(special, f)
+
+
 @lru_cache()
 def _bytes_to_unicode() -> Dict[int, str]:
     """GPT-2's reversible byte<->unicode table."""
@@ -65,7 +79,7 @@ class GPT2BPETokenizer:
         self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
         self.decoder = {v: k for k, v in self.encoder.items()}
         self.cache: Dict[str, str] = {}
-        self.special: Dict[str, int] = {}
+        self.special: Dict[str, int] = _read_special(vocab_dir)
 
     def __len__(self):
         return len(self.encoder) + len(self.special)
@@ -159,6 +173,22 @@ class GPT2BPETokenizer:
             self.byte_decoder.get(ch, 32) for ch in text
         ).decode("utf-8", errors="replace")
 
+    def save_pretrained(self, save_dir: str):
+        """Write vocab.json / merges.txt / special_tokens.json so the
+        saved run directory is self-contained (the reference saves its
+        tokenizer to the logdir, gpt2_train.py:278-283)."""
+        os.makedirs(save_dir, exist_ok=True)
+        with open(os.path.join(save_dir, "vocab.json"), "w") as f:
+            json.dump(self.encoder, f)
+        merges = sorted(self.bpe_ranks, key=self.bpe_ranks.get)
+        with open(os.path.join(save_dir, "merges.txt"), "w",
+                  encoding="utf-8") as f:
+            f.write("#version: 0.2\n")
+            # trailing newline: HF loaders split("\n")[1:-1] and would
+            # otherwise drop the last merge
+            f.write("\n".join(" ".join(m) for m in merges) + "\n")
+        _write_special(save_dir, self.special)
+
 
 class ByteTokenizer:
     """Offline fallback with the same interface: ids = byte values."""
@@ -202,12 +232,18 @@ class ByteTokenizer:
             out.append(bytes(buf).decode("utf-8", "replace"))
         return "".join(out)
 
+    def save_pretrained(self, save_dir: str):
+        _write_special(save_dir, self.special)
+
 
 def load_tokenizer(model_checkpoint: str):
     """GPT-2 BPE if vocab files exist at the checkpoint path, else the
-    byte fallback."""
+    byte fallback (restoring saved special-token ids if present)."""
     if (os.path.isdir(model_checkpoint)
             and os.path.exists(os.path.join(model_checkpoint,
                                             "vocab.json"))):
         return GPT2BPETokenizer(model_checkpoint)
-    return ByteTokenizer()
+    tok = ByteTokenizer()
+    if os.path.isdir(model_checkpoint):
+        tok.special = _read_special(model_checkpoint)
+    return tok

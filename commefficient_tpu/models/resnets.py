@@ -67,17 +67,19 @@ class Bottleneck(nn.Module):
     norm: str = "batch"
     stride: int = 1
     base_width: int = 64
+    groups: int = 1
     expansion: int = 4
 
     @nn.compact
     def __call__(self, x):
         norm = _norm(self.norm)
-        width = int(self.planes * (self.base_width / 64.0))
+        width = int(self.planes * (self.base_width / 64.0)) * self.groups
         out_ch = self.planes * self.expansion
         out = nn.Conv(width, (1, 1), use_bias=False, kernel_init=_he)(x)
         out = nn.relu(norm()(out))
         out = nn.Conv(width, (3, 3), strides=(self.stride,) * 2,
-                      padding=1, use_bias=False, kernel_init=_he)(out)
+                      padding=1, use_bias=False, kernel_init=_he,
+                      feature_group_count=self.groups)(out)
         out = nn.relu(norm()(out))
         out = nn.Conv(out_ch, (1, 1), use_bias=False, kernel_init=_he)(out)
         out = norm()(out)
@@ -96,6 +98,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     norm: str = "batch"
     width_per_group: int = 64
+    groups: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -112,6 +115,7 @@ class ResNet(nn.Module):
                 kw = {}
                 if self.block is Bottleneck:
                     kw["base_width"] = self.width_per_group
+                    kw["groups"] = self.groups
                 x = self.block(planes, self.norm,
                                stride if b == 0 else 1, **kw)(x)
             planes *= 2
@@ -136,6 +140,10 @@ resnet101 = register_model("resnet101")(
     _factory([3, 4, 23, 3], Bottleneck))
 resnet152 = register_model("resnet152")(
     _factory([3, 8, 36, 3], Bottleneck))
+resnext50_32x4d = register_model("resnext50_32x4d")(
+    _factory([3, 4, 6, 3], Bottleneck, groups=32, width_per_group=4))
+resnext101_32x8d = register_model("resnext101_32x8d")(
+    _factory([3, 4, 23, 3], Bottleneck, groups=32, width_per_group=8))
 wide_resnet50_2 = register_model("wide_resnet50_2")(
     _factory([3, 4, 6, 3], Bottleneck, width_per_group=128))
 wide_resnet101_2 = register_model("wide_resnet101_2")(

@@ -128,6 +128,7 @@ class FedModel:
         self.pending_client_ids = None
         self.round_index = 0
         self.training = True
+        self.diverged = False  # set by trainers on NaN abort
         self.fedavg_lr = 1.0
         self._rng = jax.random.PRNGKey(args.seed)
 
@@ -156,6 +157,31 @@ class FedModel:
         """Current weights as the module's pytree (the reference's
         lazy state_dict sync, fed_aggregator.py:374-378)."""
         return self.unravel(self.ps_weights)
+
+    def save_pretrained(self, save_dir: str):
+        """HF-style final-model save (reference fed_aggregator.py:
+        205-212 / gpt2_train.py:146): current server weights as a flax
+        msgpack blob plus the module's config as JSON."""
+        import dataclasses
+        import json
+        import os
+
+        from flax import serialization
+
+        os.makedirs(save_dir, exist_ok=True)
+        # config first: a dir with weights but no config would rebuild
+        # the wrong architecture on reload (gpt2_train reload path)
+        cfg = getattr(self.module, "cfg", None)
+        if cfg is not None and dataclasses.is_dataclass(cfg):
+            blob = {k: v for k, v in dataclasses.asdict(cfg).items()
+                    if isinstance(v, (int, float, str, bool,
+                                      type(None)))}
+            with open(os.path.join(save_dir, "config.json"), "w") as f:
+                json.dump(blob, f, indent=2)
+        with open(os.path.join(save_dir, "flax_model.msgpack"),
+                  "wb") as f:
+            f.write(serialization.msgpack_serialize(
+                jax.tree_util.tree_map(np.asarray, self.params())))
 
     # --- rounds ----------------------------------------------------------
 

@@ -133,7 +133,8 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
 
 
 def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
-               args, logger=None, start_epoch=0, epoch_hook=None):
+               args, logger=None, start_epoch=0, epoch_hook=None,
+               logdir=None):
     """(reference gpt2_train.py:115-147)"""
     from commefficient_tpu.utils import (make_logdir,
                                          make_summary_writer,
@@ -141,8 +142,9 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
                                          write_epoch_scalars)
     logger = logger or TableLogger()
     timer = Timer()
-    logdir = (make_logdir(args)
-              if (args.use_tensorboard or args.do_profile) else None)
+    if logdir is None:
+        logdir = (make_logdir(args)
+                  if (args.use_tensorboard or args.do_profile) else None)
     writer = make_summary_writer(args, logdir)
     results = []
     try:
@@ -153,6 +155,7 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
                                          training=True)
             if train_loss is None:
                 print("NaN detected, aborting")
+                model.diverged = True
                 return results
             train_time = timer()
             nll, acc, ppl = run_batches(model, opt, lr_scheduler,
@@ -178,9 +181,21 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
 def build_model_and_tokenizer(args: Config):
     import dataclasses
 
+    import json
+
     tokenizer = load_tokenizer(args.model_checkpoint)
     tokenizer.add_special_tokens(SPECIAL_TOKENS)
-    if args.do_test or tokenizer.__class__.__name__ == "ByteTokenizer":
+    cfg_json = os.path.join(args.model_checkpoint, "config.json") \
+        if os.path.isdir(args.model_checkpoint) else ""
+    if os.path.exists(cfg_json):
+        # a run dir saved by FedModel.save_pretrained: its config
+        # defines the architecture the saved weights fit
+        with open(cfg_json) as f:
+            blob = json.load(f)
+        fields = {f.name for f in dataclasses.fields(GPT2Config)}
+        cfg = GPT2Config(**{k: v for k, v in blob.items()
+                            if k in fields})
+    elif args.do_test or tokenizer.__class__.__name__ == "ByteTokenizer":
         cfg = GPT2Config.tiny()
         cfg = dataclasses.replace(
             cfg,
@@ -197,15 +212,30 @@ def build_model_and_tokenizer(args: Config):
                          jnp.zeros((1, args.num_candidates),
                                    jnp.int32), dummy)["params"]
 
-    ckpt = os.path.join(args.model_checkpoint, "pytorch_model.bin") \
-        if os.path.isdir(args.model_checkpoint) else None
-    if ckpt and os.path.exists(ckpt):
-        import torch
-        from commefficient_tpu.models.gpt2 import convert_torch_gpt2
-        sd = {k: v.numpy() for k, v in
-              torch.load(ckpt, map_location="cpu").items()}
-        params = convert_torch_gpt2(sd, cfg)
-        print(f"loaded GPT-2 weights from {ckpt}")
+    if os.path.isdir(args.model_checkpoint):
+        torch_ckpt = os.path.join(args.model_checkpoint,
+                                  "pytorch_model.bin")
+        flax_ckpt = os.path.join(args.model_checkpoint,
+                                 "flax_model.msgpack")
+        if os.path.exists(torch_ckpt):
+            import torch
+            from commefficient_tpu.models.gpt2 import convert_torch_gpt2
+            sd = {k: v.numpy() for k, v in
+                  torch.load(torch_ckpt, map_location="cpu").items()}
+            params = convert_torch_gpt2(sd, cfg)
+            print(f"loaded GPT-2 weights from {torch_ckpt}")
+        elif os.path.exists(flax_ckpt):
+            # a run dir saved by FedModel.save_pretrained; without its
+            # config.json the module above was built from tokenizer
+            # heuristics and the weights would mis-shape inside jit
+            if not os.path.exists(cfg_json):
+                raise FileNotFoundError(
+                    f"{flax_ckpt} has no config.json beside it; "
+                    "cannot reconstruct the saved architecture")
+            from flax import serialization
+            with open(flax_ckpt, "rb") as f:
+                params = serialization.msgpack_restore(f.read())
+            print(f"loaded GPT-2 weights from {flax_ckpt}")
     return module, params, tokenizer
 
 
@@ -301,10 +331,22 @@ def main(argv=None):
         print({"epoch": 0, "val_nll": out[0], "val_acc": out[1],
                "val_ppl": out[2]})
 
+    # one logdir for the whole run: TB events, profiles, and the final
+    # model/tokenizer save all land together (reference gpt2_train.py
+    # computes log_dir once at startup, :278-283)
+    from commefficient_tpu.utils import make_logdir
+    logdir = make_logdir(args) if not args.do_test else None
     results = train_gpt2(model, opt, lr_scheduler, train_loader,
                          val_loader, args, start_epoch=start_epoch,
-                         epoch_hook=epoch_hook)
+                         epoch_hook=epoch_hook, logdir=logdir)
     model.finalize()
+    if logdir is not None and not getattr(model, "diverged", False):
+        # reference gpt2_train.py:146, 278-283: final model + tokenizer
+        # saved HF-style into the run's logdir (skipped after a NaN
+        # abort — diverged weights are not a final model)
+        model.save_pretrained(logdir)
+        tokenizer.save_pretrained(logdir)
+        print(f"saved model + tokenizer to {logdir}")
     return results
 
 

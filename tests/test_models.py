@@ -78,6 +78,17 @@ class TestImagenetModels:
         module = resnet18(num_classes=7)
         _fwd(module, (1, 28, 28, 1), 7)
 
+    def test_resnext_grouped_conv(self):
+        """resnext50_32x4d (reference resnets.py:309-321): grouped 3x3
+        conv — kernel input-channel dim is width/groups."""
+        from commefficient_tpu.models.resnets import resnext50_32x4d
+        module = resnext50_32x4d(num_classes=4)
+        variables, _ = _fwd(module, (1, 28, 28, 1), 4)
+        # first bottleneck: planes=64, base_width=4, groups=32 =>
+        # width=128; grouped conv kernel is (3, 3, 128/32, 128)
+        k = variables["params"]["Bottleneck_0"]["Conv_1"]["kernel"]
+        assert k.shape == (3, 3, 4, 128), k.shape
+
 
 class TestBatchNormUnderClientVmap:
     """SURVEY §7 hard part: with --batchnorm, batch statistics must
