@@ -28,6 +28,40 @@ def flatten_params(params: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
     return flat.astype(jnp.float32), unravel
 
 
+def param_group_indices(params: Any, *predicates):
+    """Flat-vector index arrays grouping leaves by parameter-path name.
+
+    TPU-native form of the reference's named-parameter param groups
+    (cv_train.py:366-376: Fixup bias/scale/other LR groups). Each
+    predicate receives the leaf's path string (e.g.
+    ``['FixupLayer_0']['bias1a']``); a leaf joins the first predicate
+    that matches, unmatched leaves join a final catch-all group.
+    Indices are positions in the ``flatten_params`` vector (leaf order
+    of ``ravel_pytree`` == ``tree_flatten_with_path``), so unlike the
+    reference's concatenated-in-group-order LR vector
+    (fed_aggregator.py:413-429) the resulting per-coordinate LRs are
+    exactly aligned with the flat gradient.
+    """
+    import numpy as np
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    leaves, _ = tree_flatten_with_path(params)
+    spans = [[] for _ in range(len(predicates) + 1)]
+    offset = 0
+    for path, leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        name = keystr(path)
+        for i, pred in enumerate(predicates):
+            if pred(name):
+                spans[i].append((offset, n))
+                break
+        else:
+            spans[-1].append((offset, n))
+        offset += n
+    return [np.concatenate([np.arange(o, o + n) for o, n in s])
+            if s else np.empty(0, np.int64) for s in spans]
+
+
 def global_norm(vec: jax.Array) -> jax.Array:
     return jnp.sqrt(jnp.sum(jax.lax.square(vec)))
 

@@ -260,6 +260,17 @@ class FedOptimizer:
         if isinstance(param_groups, dict):
             param_groups = [param_groups]
         self.param_groups = param_groups
+        # index-based groups: one device-resident indicator vector per
+        # group, built once — get_lr then only ships scalars per step
+        self._lr_indicators = None
+        if len(param_groups) > 1 and \
+                all("index" in g for g in param_groups):
+            inds = []
+            for group in param_groups:
+                v = np.zeros(self.args.grad_size, np.float32)
+                v[group["index"]] = 1.0
+                inds.append(jnp.asarray(v))
+            self._lr_indicators = inds
         self.server_state = ServerState.init(self.args)
         self._server_round = jax.jit(build_server_round(self.args))
         self._noise_rng = jax.random.PRNGKey(self.args.seed + 1)
@@ -268,10 +279,16 @@ class FedOptimizer:
     def get_lr(self):
         if len(self.param_groups) == 1:
             return self.param_groups[0]["lr"]
+        if self._lr_indicators is not None:
+            # index-based groups (param_group_indices): per-coordinate
+            # LRs aligned with the flat vector regardless of how the
+            # group members interleave in parameter order
+            return sum(float(g["lr"]) * ind for g, ind in
+                       zip(self.param_groups, self._lr_indicators))
         lr_vec = []
         for group in self.param_groups:
             assert "size" in group, \
-                "multi-group LR needs per-group 'size'"
+                "multi-group LR needs per-group 'index' or 'size'"
             lr_vec.append(np.full(group["size"], group["lr"],
                                   np.float32))
         return jnp.asarray(np.concatenate(lr_vec))
@@ -281,7 +298,8 @@ class FedOptimizer:
         assert m.pending_aggregated is not None, \
             "call model(batch) before opt.step()"
         lr = self.get_lr()
-        if np.ndim(lr) == 0 and float(lr) == 0:
+        # group scalars, so this also covers the vector-LR path
+        if all(float(g["lr"]) == 0 for g in self.param_groups):
             print("WARNING: LR is 0")
         if self.args.mode == "fedavg":
             assert np.ndim(lr) == 0, "fedavg supports scalar lr only"

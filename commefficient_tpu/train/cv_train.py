@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import math
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -112,6 +113,7 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
         upload_total = np.zeros(model.num_clients)
         spe = len(loader)
         max_batches = max(1, int(spe * epoch_fraction))
+        step_t0 = time.time()
         for i, batch in enumerate(loader):
             if i >= max_batches:
                 break
@@ -120,8 +122,10 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
             lr_scheduler.step()
             if opt.param_groups[0]["lr"] == 0:
                 # "HACK STEP": keep FedAvg's schedule aligned when the
-                # triangular LR hits 0 (reference cv_train.py:198-203)
-                opt.param_groups[0]["lr"] = 1e-10
+                # triangular LR hits 0 (reference cv_train.py:198-203);
+                # every group — schedule zeros hit them all at once
+                for g in opt.param_groups:
+                    g["lr"] = 1e-10
             metrics = model(batch)
             opt.step()
             loss, acc, download, upload = (metrics[0], metrics[1],
@@ -137,6 +141,13 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                 continue
             losses.append(float(np.sum(loss * w) / w.sum()))
             accs.append(float(np.sum(acc * w) / w.sum()))
+            if args.dataset_name == "EMNIST":
+                # per-round progress line (reference cv_train.py:233-237)
+                print("LR: {:0.5f}, Loss: {:0.5f}, Acc: {:0.5f}, "
+                      "Time: {:0.2f}".format(
+                          float(opt.param_groups[0]["lr"]), losses[-1],
+                          accs[-1], time.time() - step_t0))
+                step_t0 = time.time()
             if not math.isfinite(losses[-1]) or \
                     losses[-1] > args.nan_threshold:
                 print(f"Stopping at batch {i}: diverged "
@@ -375,7 +386,29 @@ def main(argv=None):
 
     model = FedModel(module, params, compute_loss, args,
                      padded_batch_size=train_loader.B)
-    opt = FedOptimizer([{"lr": 1.0}], args)
+
+    if args.model.startswith("Fixup") and args.mode != "fedavg":
+        # Fixup LR groups (reference cv_train.py:366-376): bias and
+        # scale parameters train at 0.1x; built as flat-vector index
+        # groups so the per-coordinate LR lines up exactly. The
+        # nominal-LR group comes first so logged LR is the schedule's.
+        from commefficient_tpu.ops.vec import param_group_indices
+        bias_idx, scale_idx, other_idx = param_group_indices(
+            params, lambda n: "bias" in n, lambda n: "scale" in n)
+        param_groups = [{"lr": 1.0, "index": other_idx},
+                        {"lr": 0.1, "index": bias_idx},
+                        {"lr": 0.1, "index": scale_idx}]
+        print("using fixup learning rates")
+    else:
+        if args.model.startswith("Fixup") and args.mode == "fedavg":
+            # fedavg's client local SGD uses one shared scalar LR
+            # (reference g_lr shm, fed_worker.py:57), so per-group
+            # Fixup LRs cannot apply — unlike the reference, which
+            # also ignores them silently in this combination
+            print("WARNING: fedavg uses a scalar LR; Fixup bias/scale "
+                  "0.1x groups are not applied")
+        param_groups = [{"lr": 1.0}]
+    opt = FedOptimizer(param_groups, args)
 
     spe = steps_per_epoch(args.local_batch_size, train_ds,
                           args.num_workers)
