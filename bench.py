@@ -34,7 +34,7 @@ from commefficient_tpu.train.cv_train import make_compute_loss
 
 BASELINE_CLIENTS_PER_SEC = 60.0  # est. reference single-A100 (see doc)
 
-W, B, NUM_CLIENTS, ROUNDS = 8, 8, 100, 20
+W, B, NUM_CLIENTS, ROUNDS = 8, 8, 100, 100
 
 
 def main():
@@ -42,7 +42,8 @@ def main():
                  virtual_momentum=0.9, weight_decay=5e-4,
                  num_workers=W, local_batch_size=B,
                  k=50000, num_rows=5, num_cols=524288, num_blocks=20,
-                 dataset_name="CIFAR10", seed=21, approx_topk=True)
+                 dataset_name="CIFAR10", seed=21, approx_topk=True,
+                 approx_recall=0.85)
 
     module = get_model("ResNet9")(num_classes=10, dtype=jnp.bfloat16)
     params = module.init(jax.random.PRNGKey(0),
@@ -75,7 +76,9 @@ def main():
         """ROUNDS federated rounds chained in one program — measures
         true device throughput (per-dispatch tunnel latency to the
         remote chip is ~70 ms and would otherwise dominate; a real
-        deployment batches rounds the same way)."""
+        deployment batches rounds the same way). Returns a device-
+        computed scalar checksum so forcing completion ships 4 bytes,
+        not the 26 MB weight vector, through the relay."""
         def body(r, carry):
             ps, ss = carry
             res = client_round(ps, cs, batch, ids,
@@ -83,19 +86,20 @@ def main():
             ps, ss, _, _ = server_round(ps, ss, res.aggregated,
                                         jnp.float32(0.1))
             return ps, ss
-        return jax.lax.fori_loop(0, ROUNDS, body, (ps, ss))
+        ps, ss = jax.lax.fori_loop(0, ROUNDS, body, (ps, ss))
+        return ps, ss, jnp.sum(ps)
 
     # warmup/compile
-    w_ps, w_ss = run_rounds(ps, ss)
-    float(jnp.sum(w_ps))  # force full materialisation through the relay
+    w_ps, w_ss, w_sum = run_rounds(ps, ss)
+    assert np.isfinite(float(w_sum))
 
     # median of 3 timed repetitions: dispatch rides a remote relay
     # with ~±15% run-to-run variance, so a single draw is noisy
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        out_ps, _ = run_rounds(ps, ss)
-        float(jnp.sum(out_ps))
+        _, _, checksum = run_rounds(ps, ss)
+        float(checksum)
         times.append(time.perf_counter() - t0)
     dt = sorted(times)[1]
 

@@ -149,6 +149,7 @@ class Config:
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # set bfloat16 for MXU throughput
     approx_topk: bool = False  # lax.approx_max_k in unsketch (faster)
+    approx_recall: float = 0.95  # recall target for --approx_topk
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -164,6 +165,8 @@ class Config:
         assert self.mode in MODES, self.mode
         assert self.error_type in ERROR_TYPES, self.error_type
         assert self.dp_mode in DP_MODES, self.dp_mode
+        assert 0.0 < self.approx_recall <= 1.0, \
+            "--approx_recall must be in (0, 1]"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -351,6 +354,7 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--param_dtype", type=str, default="float32")
     parser.add_argument("--compute_dtype", type=str, default="float32")
     parser.add_argument("--approx_topk", action="store_true")
+    parser.add_argument("--approx_recall", type=float, default=0.95)
 
     return parser
 

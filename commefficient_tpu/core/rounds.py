@@ -85,7 +85,8 @@ def args2sketch(cfg: Config) -> Optional[CountSketch]:
         return None
     return CountSketch(d=cfg.grad_size, c=cfg.num_cols, r=cfg.num_rows,
                        num_blocks=cfg.num_blocks, seed=cfg.seed,
-                       approx_topk=cfg.approx_topk)
+                       approx_topk=cfg.approx_topk,
+                       approx_recall=cfg.approx_recall)
 
 
 def build_client_round(cfg: Config, loss_fn: Callable,
@@ -107,9 +108,27 @@ def build_client_round(cfg: Config, loss_fn: Callable,
     cfg.validate_runtime()
     sketch = args2sketch(cfg)
     sketch_late = (cfg.mode == "sketch" and cfg.max_grad_norm is None)
+    # Fused-gradient fast path: when no per-client transform touches
+    # the gradient (no local momentum/error, clip, DP, topk_down or
+    # microbatching), the aggregated quantity is exactly the gradient
+    # of the sample-weighted mean loss over ALL clients' real samples
+    # (+ the analytic weight-decay term). One backward pass then
+    # accumulates straight into a single (d,) vector — the (W, d)
+    # per-client gradient buffer, its dynamic-update-slices and the
+    # cross-client reduction disappear from the program. Single-device
+    # only: on a mesh the per-device sum + psum-of-sketch-tables path
+    # below keeps inter-chip traffic compressed.
+    fused_grad = (
+        cfg.mode in ("sketch", "uncompressed", "true_topk")
+        and cfg.local_momentum == 0 and cfg.error_type != "local"
+        and not cfg.do_topk_down and not cfg.do_dp
+        and cfg.max_grad_norm is None and cfg.microbatch_size <= 0
+        and (mesh is None or mesh.devices.size == 1))
     if cfg.mode == "fedavg":
         per_client = _build_fedavg_client_step(cfg, loss_fn,
                                                padded_batch_size)
+    elif fused_grad:
+        per_client = None
     else:
         step_cfg = cfg.replace(mode="uncompressed", error_type="none",
                                grad_size=cfg.grad_size) \
@@ -117,6 +136,35 @@ def build_client_round(cfg: Config, loss_fn: Callable,
         per_client = _build_sgd_client_step(step_cfg, loss_fn,
                                             None if sketch_late else sketch,
                                             padded_batch_size)
+
+    def client_round_fused(ps_weights, client_states: ClientStates,
+                           batch, client_ids, rng,
+                           fedavg_lr=1.0) -> RoundResult:
+        del rng, fedavg_lr
+        total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+
+        def global_loss(p):
+            def one(b):
+                loss, metrics = loss_fn(p, b)
+                n = jnp.sum(b["mask"])
+                # guard all-padding clients: their (meaningless) loss
+                # must not poison the weighted sum (cf. the non-fused
+                # path's validity masking in core/grad.py)
+                w = jnp.where(n > 0, loss * n, 0.0)
+                mets = tuple((n > 0) * m
+                             for m in (loss,) + tuple(metrics))
+                return w, mets
+
+            weighted, metrics = jax.vmap(one)(batch)
+            return jnp.sum(weighted) / total, metrics
+
+        (_, metrics), g = jax.value_and_grad(
+            global_loss, has_aux=True)(ps_weights)
+        if cfg.weight_decay != 0:
+            # Σ_i (wd/num_workers)·p·n_i / total = (wd/num_workers)·p
+            g = g + (cfg.weight_decay / cfg.num_workers) * ps_weights
+        aggregated = sketch.sketch(g) if cfg.mode == "sketch" else g
+        return RoundResult(aggregated, metrics, client_states)
 
     def client_round(ps_weights, client_states: ClientStates, batch,
                      client_ids, rng, fedavg_lr=1.0) -> RoundResult:
@@ -151,7 +199,7 @@ def build_client_round(cfg: Config, loss_fn: Callable,
         )
         return RoundResult(aggregated, metrics, states)
 
-    return client_round
+    return client_round_fused if fused_grad else client_round
 
 
 def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh):

@@ -106,6 +106,11 @@ class CountSketch:
     # error feedback re-surfaces missed heavy hitters next round — but
     # off by default for exact reference parity.
     approx_topk: bool = False
+    # recall target for approx_topk: lower = smaller internal sort =
+    # faster (measured ~2x at 0.85, which still selects ~94% of the
+    # true top-k on gaussian data); missed coordinates stay in the
+    # error accumulator and resurface next round
+    approx_recall: float = 0.95
     # "auto" | "xla" | "pallas" | "pallas_interpret": auto picks the
     # fused Pallas kernels (ops/sketch_pallas.py) on TPU when the
     # geometry supports them (c lane-aligned, table VMEM-resident) and
@@ -148,13 +153,31 @@ class CountSketch:
                         ^ rot_seed)
         return (h % np.uint32(self.c)).astype(np.int64)
 
+    @property
+    def _one_mix_signs(self) -> bool:
+        """r <= 16: all rows' signs come from distinct high bits of a
+        SINGLE murmur mix per coordinate (bits are independent after
+        fmix32) — 1/r the hashing cost, the dominant cost of the fused
+        kernels. Larger r falls back to one mix per (row, coord)."""
+        return self.r <= 16
+
+    def _sign_hash(self, idx: jax.Array) -> jax.Array:
+        """uint32 per-coordinate sign hash (one-mix scheme)."""
+        _, sign_seed = self._seeds()
+        return _mix(idx ^ sign_seed)
+
     def _signs_row(self, row: int | jax.Array) -> jax.Array:
         """(padded_d,) float32 signs for one row."""
         _, sign_seed = self._seeds()
         idx = jnp.arange(self._padded_d, dtype=jnp.uint32)
-        h = _mix(idx ^ (jnp.uint32(row) * jnp.uint32(0x9E3779B9))
-                 ^ sign_seed)
-        return 1.0 - 2.0 * ((h >> 16) & 1).astype(jnp.float32)
+        if self._one_mix_signs:
+            h = self._sign_hash(idx)
+            bit = (h >> (jnp.uint32(16) + jnp.uint32(row))) & 1
+        else:
+            h = _mix(idx ^ (jnp.uint32(row) * jnp.uint32(0x9E3779B9))
+                     ^ sign_seed)
+            bit = (h >> 16) & 1
+        return 1.0 - 2.0 * bit.astype(jnp.float32)
 
     def hashes(self, idx: jax.Array):
         """(buckets, signs) for int32 coordinate indices: buckets
@@ -168,8 +191,13 @@ class CountSketch:
         buckets = (j + jnp.take_along_axis(
             jnp.broadcast_to(rot, (self.r, self._m)), t, axis=1)) \
             % jnp.uint32(self.c)
-        h = _mix(i ^ (rows * jnp.uint32(0x9E3779B9)) ^ sign_seed)
-        signs = 1.0 - 2.0 * ((h >> 16) & 1).astype(jnp.float32)
+        if self._one_mix_signs:
+            h = self._sign_hash(i)
+            bit = (h >> (jnp.uint32(16) + rows)) & 1
+        else:
+            h = _mix(i ^ (rows * jnp.uint32(0x9E3779B9)) ^ sign_seed)
+            bit = (h >> 16) & 1
+        signs = 1.0 - 2.0 * bit.astype(jnp.float32)
         return buckets, signs
 
     # --- sketching (accumulateVec) --------------------------------------
@@ -196,7 +224,8 @@ class CountSketch:
             _, sign_seed = self._seeds()
             return sketch_pallas(vp, jnp.asarray(self._rotations()),
                                  c, self.r, int(sign_seed),
-                                 backend == "pallas_interpret")
+                                 backend == "pallas_interpret",
+                                 one_mix=self._one_mix_signs)
         rot = self._rotations()  # host constants -> static rolls
 
         if m <= _UNROLL_LIMIT:
@@ -241,7 +270,8 @@ class CountSketch:
             _, sign_seed = self._seeds()
             est = estimates_pallas(table, jnp.asarray(self._rotations()),
                                    c, self.r, int(sign_seed),
-                                   backend == "pallas_interpret")
+                                   backend == "pallas_interpret",
+                                   one_mix=self._one_mix_signs)
             return est[: self.d]
         rot = self._rotations()
 
@@ -272,7 +302,9 @@ class CountSketch:
         k = min(k, self.d)
         est = self.estimates(table)
         if self.approx_topk:
-            _, idx = jax.lax.approx_max_k(jax.lax.square(est), k)
+            _, idx = jax.lax.approx_max_k(
+                jax.lax.square(est), k,
+                recall_target=self.approx_recall)
         else:
             _, idx = jax.lax.top_k(jax.lax.square(est), k)
         return jnp.zeros(self.d, jnp.float32).at[idx].set(

@@ -87,16 +87,34 @@ def supported(d: int, c: int, r: int) -> bool:
     return r * m <= 2048
 
 
+def _sign_hash_chunk(t, sign_seed: np.uint32, c: int, S: int, L: int,
+                     r: int):
+    """One-mix sign scheme (CountSketch._one_mix_signs, r <= 16): a
+    single murmur mix of the global index per chunk element; row r's
+    sign is bit 16+r. Hoisted out of the kernels' row loops — hashing
+    was the dominant kernel cost at 1 mix per (row, coord)."""
+    assert r <= 16
+    s_idx = jax.lax.broadcasted_iota(jnp.uint32, (S, L), 0)
+    l_idx = jax.lax.broadcasted_iota(jnp.uint32, (S, L), 1)
+    g = t.astype(jnp.uint32) * jnp.uint32(c) + s_idx * jnp.uint32(L) + l_idx
+    return _mix_u32(g ^ sign_seed)
+
+
+def _sign_from_hash(h, row: int):
+    # Mosaic has no uint32->f32 cast; the bit is 0/1 so int32 is safe
+    bit = ((h >> (16 + row)) & 1).astype(jnp.int32)
+    return 1.0 - 2.0 * bit.astype(jnp.float32)
+
+
 def _signs_chunk(t, row: int, sign_seed: np.uint32, c: int, S: int, L: int):
-    """(S, L) float32 ±1 signs for chunk ``t`` of row ``row`` —
-    replicates ops.sketch.CountSketch._signs_row on global indices
+    """Per-(row, coord) mix fallback for r > 16 — replicates
+    ops.sketch.CountSketch._signs_row on global indices
     ``t*c + s*L + l``. ``row`` is a Python int; ``t`` is traced."""
     s_idx = jax.lax.broadcasted_iota(jnp.uint32, (S, L), 0)
     l_idx = jax.lax.broadcasted_iota(jnp.uint32, (S, L), 1)
     g = t.astype(jnp.uint32) * jnp.uint32(c) + s_idx * jnp.uint32(L) + l_idx
     row_const = (np.uint32((row * 0x9E3779B9) & 0xFFFFFFFF) ^ sign_seed)
     h = _mix_u32(g ^ jnp.uint32(row_const))
-    # Mosaic has no uint32->f32 cast; the bit is 0/1 so int32 is safe
     bit = ((h >> 16) & 1).astype(jnp.int32)
     return 1.0 - 2.0 * bit.astype(jnp.float32)
 
@@ -133,9 +151,10 @@ def _median_network(vals):
     return 0.5 * (v[n // 2 - 1] + v[n // 2])
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
 def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
-                  interpret: bool = False, lanes: int | None = None):
+                  interpret: bool = False, lanes: int | None = None,
+                  one_mix: bool = False):
     """(padded_d,) signed-rotate-accumulate -> (r, c) table.
 
     ``vp`` is the zero-padded flat vector (padded_d = m*c); ``rot`` is
@@ -155,8 +174,14 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
             out_ref[:] = jnp.zeros_like(out_ref)
 
         chunk = v_ref[:]  # (S, L) chunk t, streamed
+        if one_mix:
+            h = _sign_hash_chunk(t, seed, c, S, L, r)
+            signs = [_sign_from_hash(h, row) for row in range(r)]
+        else:
+            signs = [_signs_chunk(t, row, seed, c, S, L)
+                     for row in range(r)]
         for row in range(r):
-            signed = chunk * _signs_chunk(t, row, seed, c, S, L)
+            signed = chunk * signs[row]
             rolled = _roll1d(signed, rot_ref[row, t], S, L)
             sl = slice(row * S, (row + 1) * S)
             out_ref[sl, :] = out_ref[sl, :] + rolled
@@ -178,9 +203,10 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
     return out.reshape(r, c)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
 def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
-                     interpret: bool = False, lanes: int | None = None):
+                     interpret: bool = False, lanes: int | None = None,
+                     one_mix: bool = False):
     """(r, c) table -> (padded_d,) median-of-rows estimates, fused
     (the (r, padded_d) intermediate of the XLA path never exists)."""
     L = lanes or _pick_lanes(c)
@@ -191,13 +217,19 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
 
     def kernel(rot_ref, tab_ref, out_ref):
         t = pl.program_id(0)
+        if one_mix:
+            h = _sign_hash_chunk(t, seed, c, S, L, r)
+            signs = [_sign_from_hash(h, row) for row in range(r)]
+        else:
+            signs = [_signs_chunk(t, row, seed, c, S, L)
+                     for row in range(r)]
         vals = []
         for row in range(r):
             trow = tab_ref[row * S:(row + 1) * S, :]
             o = rot_ref[row, t]
             back = (jnp.int32(c) - o) % jnp.int32(c)
             unrolled = _roll1d(trow, back, S, L)
-            vals.append(unrolled * _signs_chunk(t, row, seed, c, S, L))
+            vals.append(unrolled * signs[row])
         out_ref[:] = _median_network(vals)
 
     out = pl.pallas_call(
