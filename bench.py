@@ -43,7 +43,7 @@ def main():
                  num_workers=W, local_batch_size=B,
                  k=50000, num_rows=5, num_cols=524288, num_blocks=20,
                  dataset_name="CIFAR10", seed=21, approx_topk=True,
-                 approx_recall=0.85)
+                 approx_recall=0.95)
 
     module = get_model("ResNet9")(num_classes=10, dtype=jnp.bfloat16)
     params = module.init(jax.random.PRNGKey(0),
@@ -83,7 +83,7 @@ def main():
             ps, ss = carry
             res = client_round(ps, cs, batch, ids,
                                jax.random.fold_in(key, r), 1.0)
-            ps, ss, _, _ = server_round(ps, ss, res.aggregated,
+            ps, ss, _, _, _ = server_round(ps, ss, res.aggregated,
                                         jnp.float32(0.1))
             return ps, ss
         ps, ss = jax.lax.fori_loop(0, ROUNDS, body, (ps, ss))

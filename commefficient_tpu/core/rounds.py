@@ -359,7 +359,10 @@ def build_server_round(cfg: Config) -> Callable:
     """Returns jit-able ``server_round(ps_weights, server_state,
     aggregated, lr, client_velocities, client_ids, noise_rng) ->
     (new_ps_weights, new_server_state, new_client_velocities,
-    weight_update)``.
+    weight_update, support)``. ``support`` is ((k,) indices, (k,)
+    values) of the update for k-sparse modes, None for dense modes —
+    it lets the host-side download accounting avoid ever transferring
+    the dense update.
 
     Covers FedOptimizer.step (fed_aggregator.py:431-460) including
     true_topk's masking of participating clients' local velocities at
@@ -384,6 +387,6 @@ def build_server_round(cfg: Config) -> Callable:
             rows = client_velocities[client_ids]
             rows = rows * res.client_velocity_keep.astype(rows.dtype)
             new_vel = client_velocities.at[client_ids].set(rows)
-        return new_ps, res.state, new_vel, res.weight_update
+        return new_ps, res.state, new_vel, res.weight_update, res.support
 
     return server_round

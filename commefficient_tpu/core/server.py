@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from commefficient_tpu.config import Config
 from commefficient_tpu.ops.sketch import CountSketch
-from commefficient_tpu.ops.topk import topk
+from commefficient_tpu.ops.topk import topk_with_support
 
 
 class ServerState(NamedTuple):
@@ -45,6 +45,20 @@ class ServerUpdate(NamedTuple):
     # true_topk's momentum factor masking of *client* velocities
     # (fed_aggregator.py:530-535); None for other modes
     client_velocity_keep: Optional[jax.Array]
+    # sparse support of the update for k-sparse modes: ((k,) indices,
+    # (k,) values). None means dense (every coordinate may have
+    # changed) — the host-side download accounting then never needs
+    # the dense update shipped off device
+    support: Optional[Tuple[jax.Array, jax.Array]] = None
+
+
+def _lr_scaled_support(idx, vals, lr):
+    """Support of the *weight* update: values scaled by the (scalar or
+    per-coordinate) LR, so coordinates with an effective LR of 0 read
+    as unchanged — matching a value-compare on ``update * lr``."""
+    lr_arr = jnp.asarray(lr, jnp.float32)
+    scale = lr_arr[idx] if lr_arr.ndim else lr_arr
+    return idx, vals * scale
 
 
 def server_update(cfg: Config,
@@ -97,7 +111,8 @@ def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
     Vvel = gradient + cfg.virtual_momentum * state.Vvelocity
     Verr = state.Verror + Vvel
 
-    update = topk(Verr, k=cfg.k)
+    update, idx, vals = topk_with_support(Verr,
+                                          min(cfg.k, cfg.grad_size))
     keep = update == 0
     # error feedback + momentum factor masking at transmitted coords
     Verr = jnp.where(keep, Verr, 0.0)
@@ -106,7 +121,8 @@ def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
     # coords by the round engine (the reference does this from the
     # optimizer via globals; here the mask travels in the result —
     # avoiding the reference's latent unset-global bug, SURVEY.md §2.1)
-    return ServerUpdate(update * lr, ServerState(Vvel, Verr), keep)
+    return ServerUpdate(update * lr, ServerState(Vvel, Verr), keep,
+                        _lr_scaled_support(idx, vals, lr))
 
 
 def _local_topk(cfg, local_topk_grad, state, lr, sketch, noise_rng):
@@ -139,7 +155,8 @@ def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng):
         # like the reference (fed_aggregator.py:581-587 never assigns)
         Verr = state.Verror
 
-    update = sketch.unsketch(Verr, k=cfg.k)
+    update, idx, vals = sketch.unsketch(Verr, k=cfg.k,
+                                        with_support=True)
 
     # re-sketch the recovered update to find which table buckets it
     # occupies (fed_aggregator.py:595-597)
@@ -155,4 +172,5 @@ def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng):
     if cfg.error_type == "local":
         Verr = Vvel
 
-    return ServerUpdate(update * lr, ServerState(Vvel, Verr), None)
+    return ServerUpdate(update * lr, ServerState(Vvel, Verr), None,
+                        _lr_scaled_support(idx, vals, lr))

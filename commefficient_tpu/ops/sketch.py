@@ -294,11 +294,17 @@ class CountSketch:
                                  table, rot_dev)
         return jnp.median(ests, axis=0)[: self.d]
 
-    @partial(jax.jit, static_argnums=(0, 2))
-    def unsketch(self, table: jax.Array, k: int) -> jax.Array:
+    @partial(jax.jit, static_argnums=(0, 2, 3))
+    def unsketch(self, table: jax.Array, k: int,
+                 with_support: bool = False):
         """(r, c) table -> dense (d,) vector keeping only the k
         largest-magnitude estimated coordinates (reference
-        ``CSVec.unSketch(k)``; server use at fed_aggregator.py:592)."""
+        ``CSVec.unSketch(k)``; server use at fed_aggregator.py:592).
+
+        ``with_support=True`` additionally returns the (k,) selected
+        indices and their values — the sparse form of the update, used
+        so downstream consumers (download-byte accounting) never need
+        the dense vector on the host."""
         k = min(k, self.d)
         est = self.estimates(table)
         if self.approx_topk:
@@ -307,8 +313,12 @@ class CountSketch:
                 recall_target=self.approx_recall)
         else:
             _, idx = jax.lax.top_k(jax.lax.square(est), k)
-        return jnp.zeros(self.d, jnp.float32).at[idx].set(
-            est[idx], mode="promise_in_bounds")
+        vals = est[idx]
+        dense = jnp.zeros(self.d, jnp.float32).at[idx].set(
+            vals, mode="promise_in_bounds")
+        if with_support:
+            return dense, idx, vals
+        return dense
 
     # --- norms -----------------------------------------------------------
 

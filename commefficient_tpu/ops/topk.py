@@ -38,3 +38,13 @@ def topk_values_indices(vec: jax.Array, k: int):
     when measuring upload bytes (k floats, fed_aggregator.py:296-297)."""
     _, idx = jax.lax.top_k(jax.lax.square(vec), min(k, vec.shape[-1]))
     return vec[idx], idx
+
+
+def topk_with_support(vec: jax.Array, k: int):
+    """``(dense, indices, values)`` top-k of a 1-D vector: the zeroed
+    dense form plus its sparse support in one place (the canonical
+    scatter lives here so sparse-support consumers don't re-derive it)."""
+    vals, idx = topk_values_indices(vec, k)
+    dense = jnp.zeros_like(vec).at[idx].set(vals,
+                                            mode="promise_in_bounds")
+    return dense, idx, vals

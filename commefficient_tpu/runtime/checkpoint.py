@@ -189,6 +189,7 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
         model.client_last_seen = np.asarray(z["client_last_seen"])
         model.round_index = meta["round_index"]
         model._update_round = meta["update_round"]
+        model._rebuild_round_counts()
         model.fedavg_lr = meta["fedavg_lr"]
         opt._step_count = meta["opt_step_count"]
         if scheduler is not None and "scheduler_step" in meta:

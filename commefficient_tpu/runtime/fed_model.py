@@ -136,6 +136,7 @@ class FedModel:
         self.last_updated = np.full(args.grad_size, -1, np.int64)
         self.client_last_seen = np.full(num_clients, -1, np.int64)
         self._update_round = 0
+        self._rebuild_round_counts()
 
         _CURRENT_MODEL = self
 
@@ -207,6 +208,17 @@ class FedModel:
         return metrics + list(self._account_bytes(ids_np,
                                                   batch["mask"]))
 
+    def _rebuild_round_counts(self):
+        """Histogram of ``last_updated`` by round (index = round + 1).
+        ``#coords changed since a client last synced at round s`` =
+        the suffix sum from index s + 2 — O(k) to maintain per round
+        and O(#rounds) to query, replacing the old O(W x grad_size)
+        host compare (and, with sparse support, the dense update
+        transfer) per round."""
+        self._round_counts = np.bincount(
+            self.last_updated + 1,
+            minlength=self._update_round + 2).astype(np.int64)
+
     def _account_bytes(self, ids_np, mask=None):
         """Per-round download/upload byte accounting (see module
         docstring; reference fed_aggregator.py:171-196, 240-300).
@@ -214,9 +226,11 @@ class FedModel:
         dropped clients (--dropout_prob) downloaded weights but
         uploaded nothing."""
         download_bytes = np.zeros(self.num_clients)
-        changed = self.last_updated[None, :] > \
-            self.client_last_seen[ids_np, None]
-        download_bytes[ids_np] = 4.0 * changed.sum(axis=1)
+        suffix = np.cumsum(self._round_counts[::-1])[::-1]
+        q = self.client_last_seen[ids_np] + 2
+        changed = np.where(
+            q < len(suffix), suffix[np.minimum(q, len(suffix) - 1)], 0)
+        download_bytes[ids_np] = 4.0 * changed
         self.client_last_seen[ids_np] = self._update_round
         upload_bytes = np.zeros(self.num_clients)
         up_ids = ids_np
@@ -237,11 +251,42 @@ class FedModel:
             batch["mask"].shape[0], -1).sum(axis=1)
         return [out[:, i] for i in range(out.shape[1])] + [counts]
 
-    def note_update(self, weight_update):
-        """Record the server update's support for download accounting."""
-        changed = np.asarray(weight_update != 0)
+    def note_update(self, support=None):
+        """Record the server update's support for download accounting.
+
+        ``support`` forms:
+        - ((k,) indices, (k,) values): sparse support of the weight
+          update (values post-LR) — only ~k values cross to the host;
+        - None: dense update, every coordinate marked changed with no
+          device transfer (the only deviation from the reference's
+          value-compare: dense-mode coordinates whose update is
+          exactly 0.0 still count as changed — measure-zero under
+          momentum);
+        - a dense update array: host-side ``!= 0`` compare (modes
+          whose update is sparse but with non-static support size,
+          e.g. local_topk without virtual momentum)."""
         self._update_round += 1
-        self.last_updated[changed] = self._update_round
+        r = self._update_round
+        if len(self._round_counts) < r + 2:
+            self._round_counts = np.concatenate(
+                [self._round_counts,
+                 np.zeros(r + 2 - len(self._round_counts) + 64,
+                          np.int64)])
+        if support is None:
+            self.last_updated[:] = r
+            self._round_counts[:] = 0
+            self._round_counts[r + 1] = self.args.grad_size
+            return
+        if isinstance(support, tuple):
+            idx = np.asarray(support[0])
+            vals = np.asarray(support[1])
+            idx = idx[vals != 0]
+        else:
+            idx = np.nonzero(np.asarray(support) != 0)[0]
+        old = self.last_updated[idx] + 1
+        np.subtract.at(self._round_counts, old, 1)
+        self._round_counts[r + 1] += len(idx)
+        self.last_updated[idx] = r
 
 
 class FedOptimizer:
@@ -308,17 +353,32 @@ class FedOptimizer:
         self._step_count += 1
         noise_rng = jax.random.fold_in(self._noise_rng,
                                        self._step_count)
-        new_ps, self.server_state, new_vel, update = self._server_round(
-            m.ps_weights, self.server_state, m.pending_aggregated,
-            jnp.asarray(lr, jnp.float32),
-            m.client_states.velocities, m.pending_client_ids,
-            noise_rng)
+        new_ps, self.server_state, new_vel, update, support = \
+            self._server_round(
+                m.ps_weights, self.server_state, m.pending_aggregated,
+                jnp.asarray(lr, jnp.float32),
+                m.client_states.velocities, m.pending_client_ids,
+                noise_rng)
         m.ps_weights = new_ps
         if new_vel is not None:
             m.client_states = m.client_states._replace(
                 velocities=new_vel)
         m.pending_aggregated = None
-        m.note_update(update)
+        if support is None:
+            # dense-update modes. fedavg/momentum updates touch every
+            # coordinate; the exceptions that don't: a zero scalar LR
+            # (nothing moved) and local_topk without virtual momentum
+            # (update stays ~W*k-sparse forever — fall back to the
+            # value-compare on the dense update rather than overcount)
+            lr_np = np.asarray(lr)
+            if (self.args.mode != "fedavg" and lr_np.ndim == 0
+                    and float(lr_np) == 0):
+                support = (np.zeros(0, np.int64), np.zeros(0))
+            elif (self.args.mode == "local_topk"
+                  and self.args.virtual_momentum == 0) \
+                    or lr_np.ndim > 0:
+                support = update  # host-side != 0 compare
+        m.note_update(support)
 
     def zero_grad(self):
         raise NotImplementedError(

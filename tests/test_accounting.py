@@ -1,0 +1,114 @@
+"""Download/upload byte accounting: the round-histogram structure vs
+a brute-force ``last_updated > last_seen`` compare (the semantics of
+reference fed_aggregator.py:171-196, 240-300 under this framework's
+last-updated-round simplification — see runtime/fed_model.py module
+docstring)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.runtime import FedModel
+
+
+def make_model(grad_size=50, num_clients=6):
+    import flax.linen as nn
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(grad_size // 2, use_bias=False)(x)
+
+    module = Lin()
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 2)))[
+        "params"]
+    args = Config(mode="uncompressed", error_type="none",
+                  local_momentum=0.0, num_workers=2,
+                  local_batch_size=2, num_clients=num_clients,
+                  dataset_name="CIFAR10", seed=0)
+
+    def loss(p, batch, cfg):
+        return jnp.float32(0.0), ()
+
+    return FedModel(module, params, loss, args)
+
+
+class BruteForce:
+    """Reference implementation: dense last_updated compare."""
+
+    def __init__(self, grad_size, num_clients):
+        self.last_updated = np.full(grad_size, -1, np.int64)
+        self.last_seen = np.full(num_clients, -1, np.int64)
+        self.round = 0
+
+    def note(self, changed_idx):
+        self.round += 1
+        self.last_updated[changed_idx] = self.round
+
+    def download(self, ids):
+        out = np.array([4.0 * np.sum(self.last_updated
+                                     > self.last_seen[c])
+                        for c in ids])
+        self.last_seen[ids] = self.round
+        return out
+
+
+def test_sparse_support_matches_brute_force():
+    rng = np.random.RandomState(0)
+    m = make_model()
+    d = m.args.grad_size
+    bf = BruteForce(d, m.num_clients)
+    for _ in range(40):
+        k = rng.randint(1, 10)
+        idx = rng.choice(d, k, replace=False)
+        vals = rng.randn(k)
+        vals[rng.rand(k) < 0.3] = 0.0  # zero values don't count
+        m.note_update((idx, vals))
+        bf.note(idx[vals != 0])
+        ids = rng.choice(m.num_clients, 2, replace=False)
+        got, _ = m._account_bytes(ids)
+        want = bf.download(ids)
+        np.testing.assert_array_equal(got[ids], want)
+
+
+def test_dense_none_marks_everything():
+    m = make_model()
+    d = m.args.grad_size
+    m.note_update(None)
+    got, _ = m._account_bytes(np.array([0, 3]))
+    np.testing.assert_array_equal(got[[0, 3]], [4.0 * d, 4.0 * d])
+    # same clients sync again with no new update: nothing to download
+    got2, _ = m._account_bytes(np.array([0, 3]))
+    np.testing.assert_array_equal(got2[[0, 3]], [0.0, 0.0])
+
+
+def test_dense_array_host_compare():
+    m = make_model()
+    d = m.args.grad_size
+    upd = np.zeros(d, np.float32)
+    upd[[2, 5, 7]] = 1.0
+    m.note_update(upd)
+    got, _ = m._account_bytes(np.array([1]))
+    assert got[1] == 4.0 * 3
+
+
+def test_empty_support_changes_nothing():
+    m = make_model()
+    m.note_update((np.zeros(0, np.int64), np.zeros(0)))
+    got, _ = m._account_bytes(np.array([2]))
+    assert got[2] == 0.0
+
+
+def test_rebuild_round_counts_is_lossless():
+    rng = np.random.RandomState(1)
+    m = make_model()
+    d = m.args.grad_size
+    for _ in range(10):
+        idx = rng.choice(d, 5, replace=False)
+        m.note_update((idx, rng.randn(5)))
+        m._account_bytes(rng.choice(m.num_clients, 2, replace=False))
+    counts_before = m._round_counts[:m._update_round + 2].copy()
+    m._rebuild_round_counts()  # what checkpoint restore runs
+    np.testing.assert_array_equal(
+        counts_before, m._round_counts[:m._update_round + 2])

@@ -1,6 +1,16 @@
 """End-to-end mode-lattice tests: the JAX round engine vs an
 independent NumPy mirror of the reference semantics, plus closed-form
-hand checks (reference unit_test.py:79-118 step-1 traces)."""
+hand checks (reference unit_test.py:79-118 step-1 traces).
+
+Why only the step-1 traces: the reference unit test's later expected
+weights (w2=0.3808 one-param; the two-param k=1 trace ending at
+(-0.3008, 0.34)) encode a pre-refactor optimizer — e.g. the k=1 trace
+is true_topk + local momentum with NO server-side error accumulation,
+a combination the current reference *asserts against*
+(fed_aggregator.py:514 requires error_type=="virtual" for true_topk; a
+virtual-error run double-counts the coord-0 residual and lands at
+w2≈(-0.58, 0.34) instead). The current-semantics step-2 behaviour is
+covered by the closed-form tests below and the NumPy mirror."""
 
 import dataclasses
 
@@ -67,7 +77,7 @@ def run_engine(cfg, w0, rounds, lr, num_clients=4):
                            jax.random.fold_in(rng, rnd_i),
                            jnp.float32(lr))
         cs = res.client_states
-        ps, ss, new_vel, _ = server_round(
+        ps, ss, new_vel, _, _ = server_round(
             ps, ss, res.aggregated, jnp.float32(lr),
             cs.velocities, jnp.asarray(ids))
         if new_vel is not None:

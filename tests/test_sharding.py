@@ -53,7 +53,7 @@ def _run_round(cfg, batch, ids, shard=False):
             lambda x: jax.device_put(x, sh), batch)
         ids = jax.device_put(ids, sh)
     res = client_round(ps, cs, batch, ids, jax.random.PRNGKey(0), 1.0)
-    ps2, ss2, _, upd = server_round(ps, ss, res.aggregated,
+    ps2, ss2, _, upd, _ = server_round(ps, ss, res.aggregated,
                                     jnp.float32(0.01))
     return np.asarray(res.aggregated), np.asarray(ps2)
 
