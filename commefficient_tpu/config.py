@@ -150,6 +150,9 @@ class Config:
     compute_dtype: str = "float32"  # set bfloat16 for MXU throughput
     approx_topk: bool = False  # lax.approx_max_k in unsketch (faster)
     approx_recall: float = 0.95  # recall target for --approx_topk
+    # rounds the host may run ahead of the device before materialising
+    # metrics/accounting (1 = synchronous, reference-faithful timing)
+    pipeline_depth: int = 1
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -167,6 +170,8 @@ class Config:
         assert self.dp_mode in DP_MODES, self.dp_mode
         assert 0.0 < self.approx_recall <= 1.0, \
             "--approx_recall must be in (0, 1]"
+        assert self.pipeline_depth >= 1, \
+            "--pipeline_depth must be >= 1"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -355,6 +360,7 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--compute_dtype", type=str, default="float32")
     parser.add_argument("--approx_topk", action="store_true")
     parser.add_argument("--approx_recall", type=float, default=0.95)
+    parser.add_argument("--pipeline_depth", type=int, default=1)
 
     return parser
 

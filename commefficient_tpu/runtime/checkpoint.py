@@ -45,6 +45,12 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
                     extra: Optional[dict] = None,
                     loader=None) -> str:
     """Serialise the full runtime state to ``path`` (.npz)."""
+    if getattr(model, "_inflight", None):
+        # flushing here would drop the flushed rounds' metrics and
+        # desync the trainer's pending queue — the caller must drain
+        raise RuntimeError("checkpoint requested with pipelined rounds "
+                           "inflight; drain with model.flush(force="
+                           "True) (the trainers do this at epoch end)")
     arrays = {"ps_weights": np.asarray(jax.device_get(model.ps_weights))}
     cs = model.client_states
     for name, val in (("cs_velocities", cs.velocities),

@@ -138,6 +138,17 @@ class FedModel:
         self._update_round = 0
         self._rebuild_round_counts()
 
+        # --pipeline_depth > 1: rounds are dispatched without waiting
+        # for their metrics/accounting; the host runs ahead of the
+        # device by up to `depth` rounds and materialises in batches
+        # via flush() (per-round math is unchanged — only when results
+        # cross to the host changes)
+        self.pipeline_depth = max(1, int(getattr(args,
+                                                 "pipeline_depth", 1)))
+        self._inflight = []   # per round: device metric arrays
+        self._oplog = []      # ordered ("account", ids, mask) /
+        #                       ("note", support) deferred host ops
+
         _CURRENT_MODEL = self
 
     # --- reference API surface ------------------------------------------
@@ -204,9 +215,37 @@ class FedModel:
         self.pending_client_ids = ids
         self.round_index += 1
 
+        if self.pipeline_depth > 1:
+            self._oplog.append(("account", ids_np,
+                                np.asarray(batch["mask"])))
+            self._inflight.append(list(res.metrics))
+            return None
         metrics = [np.asarray(m) for m in res.metrics]
         return metrics + list(self._account_bytes(ids_np,
                                                   batch["mask"]))
+
+    def flush(self, force=True):
+        """Materialise buffered pipelined rounds, replaying the
+        deferred accounting ops in dispatch order. Returns the list of
+        per-round outputs in the same format a synchronous
+        ``model(batch)`` call returns; empty until ``pipeline_depth``
+        rounds are buffered unless ``force``."""
+        if self.pipeline_depth <= 1 or not self._inflight:
+            return []
+        if not force and len(self._inflight) < self.pipeline_depth:
+            return []
+        rounds = iter([[np.asarray(m) for m in ms]
+                       for ms in self._inflight])
+        self._inflight = []
+        oplog, self._oplog = self._oplog, []
+        results = []
+        for op in oplog:
+            if op[0] == "account":
+                down, up = self._account_bytes(op[1], op[2])
+                results.append(next(rounds) + [down, up])
+            else:
+                self._apply_note(op[1])
+        return results
 
     def _rebuild_round_counts(self):
         """Histogram of ``last_updated`` by round (index = round + 1).
@@ -252,7 +291,8 @@ class FedModel:
         return [out[:, i] for i in range(out.shape[1])] + [counts]
 
     def note_update(self, support=None):
-        """Record the server update's support for download accounting.
+        """Record the server update's support for download accounting
+        (deferred to flush() when pipelining).
 
         ``support`` forms:
         - ((k,) indices, (k,) values): sparse support of the weight
@@ -265,6 +305,12 @@ class FedModel:
         - a dense update array: host-side ``!= 0`` compare (modes
           whose update is sparse but with non-static support size,
           e.g. local_topk without virtual momentum)."""
+        if self.pipeline_depth > 1:
+            self._oplog.append(("note", support))
+            return
+        self._apply_note(support)
+
+    def _apply_note(self, support):
         self._update_round += 1
         r = self._update_round
         if len(self._round_counts) < r + 2:
@@ -287,6 +333,17 @@ class FedModel:
         np.subtract.at(self._round_counts, old, 1)
         self._round_counts[r + 1] += len(idx)
         self.last_updated[idx] = r
+
+
+def drain_rounds(model, pending, process, force):
+    """Trainer-side pipeline drain: pop ``model.flush()`` results in
+    dispatch order, pairing each with its queued dispatch-time context
+    tuple from ``pending``. Returns False as soon as ``process`` does
+    (divergence abort)."""
+    for metrics in model.flush(force=force):
+        if not process(metrics, *pending.pop(0)):
+            return False
+    return True
 
 
 class FedOptimizer:

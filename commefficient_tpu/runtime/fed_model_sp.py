@@ -70,6 +70,9 @@ class SeqParallelFedModel(FedModel):
         super().__init__(module, params, compute_loss, args,
                          compute_loss_val=compute_loss_val,
                          padded_batch_size=padded_batch_size)
+        # this subclass's _call_train accounts synchronously; keep the
+        # base pipeline machinery off so the op ordering stays valid
+        self.pipeline_depth = 1
 
         sp_cfg = dataclasses.replace(gpt2_cfg,
                                      seq_impl=args.seq_impl)

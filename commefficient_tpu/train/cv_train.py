@@ -27,7 +27,8 @@ from commefficient_tpu.data import (FedLoader, FedSampler, ValLoader,
                                     get_dataset_cls)
 from commefficient_tpu.data import transforms as T
 from commefficient_tpu.models import get_model
-from commefficient_tpu.runtime import FedModel, FedOptimizer, LambdaLR
+from commefficient_tpu.runtime import (FedModel, FedOptimizer, LambdaLR,
+                                       drain_rounds)
 from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
                                      TSVLogger, Timer, steps_per_epoch)
 
@@ -113,7 +114,39 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
         upload_total = np.zeros(model.num_clients)
         spe = len(loader)
         max_batches = max(1, int(spe * epoch_fraction))
-        step_t0 = time.time()
+        state = {"t0": time.time()}
+        pending = []
+
+        def process(metrics, i, w, lr):
+            loss, acc, download, upload = (metrics[0], metrics[1],
+                                           metrics[-2], metrics[-1])
+            download_total[:] += download
+            upload_total[:] += upload
+            # weight per-client metrics by real sample counts so
+            # dropped clients (--dropout_prob) and ragged batches
+            # don't dilute the reported numbers; fully-dropped rounds
+            # trained on nothing and are excluded from the epoch means
+            if w.sum() == 0:
+                return True
+            losses.append(float(np.sum(loss * w) / w.sum()))
+            accs.append(float(np.sum(acc * w) / w.sum()))
+            if args.dataset_name == "EMNIST":
+                # per-round progress line (reference cv_train.py:
+                # 233-237); lr captured at dispatch time so pipelined
+                # drains report each round's own LR (Time becomes
+                # burst-shaped under pipelining — inherent)
+                print("LR: {:0.5f}, Loss: {:0.5f}, Acc: {:0.5f}, "
+                      "Time: {:0.2f}".format(
+                          lr, losses[-1], accs[-1],
+                          time.time() - state["t0"]))
+                state["t0"] = time.time()
+            if not math.isfinite(losses[-1]) or \
+                    losses[-1] > args.nan_threshold:
+                print(f"Stopping at batch {i}: diverged "
+                      f"(loss {losses[-1]})")
+                return False
+            return True
+
         for i, batch in enumerate(loader):
             if i >= max_batches:
                 break
@@ -128,33 +161,21 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                     g["lr"] = 1e-10
             metrics = model(batch)
             opt.step()
-            loss, acc, download, upload = (metrics[0], metrics[1],
-                                           metrics[-2], metrics[-1])
-            download_total += download
-            upload_total += upload
-            # weight per-client metrics by real sample counts so
-            # dropped clients (--dropout_prob) and ragged batches
-            # don't dilute the reported numbers; fully-dropped rounds
-            # trained on nothing and are excluded from the epoch means
             w = np.asarray(batch["mask"]).sum(axis=1)
-            if w.sum() == 0:
-                continue
-            losses.append(float(np.sum(loss * w) / w.sum()))
-            accs.append(float(np.sum(acc * w) / w.sum()))
-            if args.dataset_name == "EMNIST":
-                # per-round progress line (reference cv_train.py:233-237)
-                print("LR: {:0.5f}, Loss: {:0.5f}, Acc: {:0.5f}, "
-                      "Time: {:0.2f}".format(
-                          float(opt.param_groups[0]["lr"]), losses[-1],
-                          accs[-1], time.time() - step_t0))
-                step_t0 = time.time()
-            if not math.isfinite(losses[-1]) or \
-                    losses[-1] > args.nan_threshold:
-                print(f"Stopping at batch {i}: diverged "
-                      f"(loss {losses[-1]})")
+            lr_now = float(opt.param_groups[0]["lr"])
+            if metrics is None:
+                # pipelined (--pipeline_depth > 1): results arrive in
+                # batches; the device runs ahead of this loop
+                pending.append((i, w, lr_now))
+                if not drain_rounds(model, pending, process,
+                                    force=False):
+                    return None
+            elif not process(metrics, i, w, lr_now):
                 return None
             if args.do_test:
                 break
+        if not drain_rounds(model, pending, process, force=True):
+            return None
         if not losses:  # every round fully dropped
             return (float("nan"), float("nan"),
                     download_total, upload_total)

@@ -31,7 +31,8 @@ from commefficient_tpu.data.tokenizer import (SPECIAL_TOKENS,
                                               load_tokenizer)
 from commefficient_tpu.models.gpt2 import (GPT2Config, GPT2DoubleHeads,
                                            gpt2_double_heads_loss)
-from commefficient_tpu.runtime import FedModel, FedOptimizer, LambdaLR
+from commefficient_tpu.runtime import (FedModel, FedOptimizer, LambdaLR,
+                                       drain_rounds)
 from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
                                      Timer, steps_per_epoch)
 
@@ -99,22 +100,36 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
     if training:
         model.train(True)
         losses = []
-        for i, batch in enumerate(loader):
-            lr_scheduler.step()
-            metrics = model(batch)
-            opt.step()
+        pending = []
+
+        def process(metrics, i, w):
             # sample-count weighting: see cv_train.run_batches;
             # fully-dropped rounds trained on nothing — excluded
-            w = np.asarray(batch["mask"]).sum(axis=1)
             if w.sum() == 0:
-                continue
+                return True
             loss = float(np.sum(metrics[0] * w) / w.sum())
             losses.append(loss)
             if not math.isfinite(loss) or loss > args.nan_threshold:
                 print(f"diverged at round {i} (loss {loss})")
+                return False
+            return True
+
+        for i, batch in enumerate(loader):
+            lr_scheduler.step()
+            metrics = model(batch)
+            opt.step()
+            w = np.asarray(batch["mask"]).sum(axis=1)
+            if metrics is None:  # --pipeline_depth > 1
+                pending.append((i, w))
+                if not drain_rounds(model, pending, process,
+                                    force=False):
+                    return None
+            elif not process(metrics, i, w):
                 return None
             if args.do_test:
                 break
+        if not drain_rounds(model, pending, process, force=True):
+            return None
         return float(np.mean(losses)) if losses else float("nan")
     else:
         model.train(False)
