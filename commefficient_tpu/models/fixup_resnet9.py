@@ -25,6 +25,20 @@ import numpy as np
 from commefficient_tpu.models import register_model
 
 
+def _scalars(module, dtype, *names):
+    """Declare scalar fixup params (f32 storage; multiplicative
+    scale/mul params init to one, additive biases to zero) and return
+    them cast to the compute dtype — adding a raw f32 scalar to a bf16
+    tensor would silently promote the activation back to f32."""
+    return tuple(
+        module.param(n,
+                     nn.initializers.ones
+                     if n.startswith(("scale", "mul"))
+                     else nn.initializers.zeros,
+                     (1,)).astype(dtype)
+        for n in names)
+
+
 def _fixup_conv_init(scale: float = 1.0):
     """He-style normal init, std = scale * sqrt(2 / (k*k*c_out)).
 
@@ -40,14 +54,16 @@ def _fixup_conv_init(scale: float = 1.0):
     return init
 
 
-def _conv3x3(c_out, stride=1, init_scale=1.0):
+def _conv3x3(c_out, stride=1, init_scale=1.0, dtype=jnp.float32):
     return nn.Conv(c_out, (3, 3), strides=(stride, stride), padding=1,
-                   use_bias=False, kernel_init=_fixup_conv_init(init_scale))
+                   use_bias=False, dtype=dtype,
+                   kernel_init=_fixup_conv_init(init_scale))
 
 
-def _conv1x1(c_out, stride=1, init_scale=1.0):
+def _conv1x1(c_out, stride=1, init_scale=1.0, dtype=jnp.float32):
     return nn.Conv(c_out, (1, 1), strides=(stride, stride), padding=0,
-                   use_bias=False, kernel_init=_fixup_conv_init(init_scale))
+                   use_bias=False, dtype=dtype,
+                   kernel_init=_fixup_conv_init(init_scale))
 
 
 class FixupBasicBlock(nn.Module):
@@ -59,19 +75,21 @@ class FixupBasicBlock(nn.Module):
     num_layers: int  # total residual blocks in the network (for init)
     stride: int = 1
     downsample: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        b1a = self.param("bias1a", nn.initializers.zeros, (1,))
-        b1b = self.param("bias1b", nn.initializers.zeros, (1,))
-        b2a = self.param("bias2a", nn.initializers.zeros, (1,))
-        b2b = self.param("bias2b", nn.initializers.zeros, (1,))
-        scale = self.param("scale", nn.initializers.ones, (1,))
+        # scalar params stay f32 but are applied in the compute dtype,
+        # else f32 + bf16 promotion silently undoes --bf16
+        sp = _scalars(self, self.dtype,
+                      "bias1a", "bias1b", "bias2a", "bias2b", "scale")
+        b1a, b1b, b2a, b2b, scale = sp
 
         out = _conv3x3(self.c_out, self.stride,
-                       self.num_layers ** -0.5)(x + b1a)
+                       self.num_layers ** -0.5, self.dtype)(x + b1a)
         out = nn.relu(out + b1b)
-        out = _conv3x3(self.c_out, 1, 0.0)(out + b2a)  # zero-init
+        out = _conv3x3(self.c_out, 1, 0.0,
+                       self.dtype)(out + b2a)  # zero-init
         out = out * scale + b2b
         if self.downsample:
             identity = nn.avg_pool(x + b1a, (1, 1),
@@ -90,19 +108,21 @@ class FixupLayer(nn.Module):
     num_blocks: int
     net_num_layers: int
     pool: bool = True
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        b1a = self.param("bias1a", nn.initializers.zeros, (1,))
-        b1b = self.param("bias1b", nn.initializers.zeros, (1,))
-        scale = self.param("scale", nn.initializers.ones, (1,))
-        x = _conv3x3(self.c_out)(x + b1a) * scale + b1b
+        b1a, b1b, scale = _scalars(self, self.dtype,
+                                   "bias1a", "bias1b", "scale")
+        x = _conv3x3(self.c_out, dtype=self.dtype)(x + b1a) \
+            * scale + b1b
         x = nn.relu(x)
         if self.pool:
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         for _ in range(self.num_blocks):
             x = FixupBasicBlock(self.c_out,
-                                num_layers=self.net_num_layers)(x)
+                                num_layers=self.net_num_layers,
+                                dtype=self.dtype)(x)
         return x
 
 
@@ -113,27 +133,32 @@ class FixupResNet9(nn.Module):
     linear head with a scalar pre-bias."""
     num_classes: int = 10
     channels: Optional[Dict[str, int]] = None
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         ch = self.channels or {"prep": 64, "layer1": 128,
                                "layer2": 256, "layer3": 512}
         num_layers = 2  # reference fixup_resnet9.py:36
-        b1a = self.param("bias1a", nn.initializers.zeros, (1,))
-        b1b = self.param("bias1b", nn.initializers.zeros, (1,))
-        scale = self.param("scale", nn.initializers.ones, (1,))
-        out = _conv3x3(ch["prep"])(x + b1a) * scale + b1b
+        b1a, b1b, scale = _scalars(self, self.dtype,
+                                   "bias1a", "bias1b", "scale")
+        x = x.astype(self.dtype)
+        out = _conv3x3(ch["prep"], dtype=self.dtype)(x + b1a) \
+            * scale + b1b
         out = nn.relu(out)
-        out = FixupLayer(ch["layer1"], 1, num_layers)(out)
-        out = FixupLayer(ch["layer2"], 0, num_layers)(out)
-        out = FixupLayer(ch["layer3"], 1, num_layers)(out)
+        out = FixupLayer(ch["layer1"], 1, num_layers,
+                         dtype=self.dtype)(out)
+        out = FixupLayer(ch["layer2"], 0, num_layers,
+                         dtype=self.dtype)(out)
+        out = FixupLayer(ch["layer3"], 1, num_layers,
+                         dtype=self.dtype)(out)
         out = nn.max_pool(out, (4, 4), strides=(4, 4))
         out = out.reshape((out.shape[0], -1))
-        b2 = self.param("bias2", nn.initializers.zeros, (1,))
-        out = nn.Dense(self.num_classes,
+        (b2,) = _scalars(self, self.dtype, "bias2")
+        out = nn.Dense(self.num_classes, dtype=self.dtype,
                        kernel_init=nn.initializers.zeros,
                        bias_init=nn.initializers.zeros)(out + b2)
-        return out
+        return out.astype(jnp.float32)
 
     @staticmethod
     def test_config(num_classes: int = 10) -> Dict[str, Any]:
@@ -153,27 +178,27 @@ class FixupBottleneck(nn.Module):
     stride: int = 1
     project: bool = False
     expansion: int = 4
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        b1a = self.param("bias1a", nn.initializers.zeros, (1,))
-        b1b = self.param("bias1b", nn.initializers.zeros, (1,))
-        b2a = self.param("bias2a", nn.initializers.zeros, (1,))
-        b2b = self.param("bias2b", nn.initializers.zeros, (1,))
-        b3a = self.param("bias3a", nn.initializers.zeros, (1,))
-        b3b = self.param("bias3b", nn.initializers.zeros, (1,))
-        scale = self.param("scale", nn.initializers.ones, (1,))
+        sp = _scalars(self, self.dtype, "bias1a", "bias1b", "bias2a",
+                      "bias2b", "bias3a", "bias3b", "scale")
+        b1a, b1b, b2a, b2b, b3a, b3b, scale = sp
 
         s = self.num_layers ** -0.25
-        out = _conv1x1(self.planes, 1, s)(x + b1a)
+        out = _conv1x1(self.planes, 1, s, self.dtype)(x + b1a)
         out = nn.relu(out + b1b)
-        out = _conv3x3(self.planes, self.stride, s)(out + b2a)
+        out = _conv3x3(self.planes, self.stride, s,
+                       self.dtype)(out + b2a)
         out = nn.relu(out + b2b)
-        out = _conv1x1(self.planes * self.expansion, 1, 0.0)(out + b3a)
+        out = _conv1x1(self.planes * self.expansion, 1, 0.0,
+                       self.dtype)(out + b3a)
         out = out * scale + b3b
         if self.project:
             identity = _conv1x1(self.planes * self.expansion,
-                                self.stride)(x + b1a)
+                                self.stride,
+                                dtype=self.dtype)(x + b1a)
         else:
             identity = x
         return nn.relu(out + identity)
@@ -187,14 +212,15 @@ class FixupResNet50(nn.Module):
     zero-init fc. Used by imagenet.sh (SURVEY.md §6)."""
     num_classes: int = 1000
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         L = sum(self.stage_sizes)
-        b1 = self.param("bias1", nn.initializers.zeros, (1,))
-        b2 = self.param("bias2", nn.initializers.zeros, (1,))
+        b1, b2 = _scalars(self, self.dtype, "bias1", "bias2")
+        x = x.astype(self.dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3,
-                    use_bias=False,
+                    use_bias=False, dtype=self.dtype,
                     kernel_init=_fixup_conv_init())(x)
         x = nn.relu(x + b1)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1),
@@ -208,11 +234,12 @@ class FixupResNet50(nn.Module):
                     planes, num_layers=L,
                     stride=stride if b == 0 else 1,
                     project=(b == 0 and
-                             (stride != 1 or in_ch != planes * 4)))(x)
+                             (stride != 1 or in_ch != planes * 4)),
+                    dtype=self.dtype)(x)
                 in_ch = planes * 4
             planes *= 2
         x = jnp.mean(x, axis=(1, 2))
-        x = nn.Dense(self.num_classes,
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
                      kernel_init=nn.initializers.zeros,
                      bias_init=nn.initializers.zeros)(x + b2)
-        return x
+        return x.astype(jnp.float32)

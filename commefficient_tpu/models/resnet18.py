@@ -18,9 +18,12 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from typing import Any
+
 from commefficient_tpu.models import register_model
 from commefficient_tpu.models.fixup_resnet9 import (_conv1x1, _conv3x3,
-                                                    _fixup_conv_init)
+                                                    _fixup_conv_init,
+                                                    _scalars)
 from commefficient_tpu.models.norms import BatchStatNorm
 
 _he = nn.initializers.he_normal()
@@ -54,22 +57,22 @@ class FixupBlock(nn.Module):
     c_out: int
     num_layers: int
     stride: int = 1
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        a1a = self.param("add1a", nn.initializers.zeros, (1,))
-        a1b = self.param("add1b", nn.initializers.zeros, (1,))
-        a2a = self.param("add2a", nn.initializers.zeros, (1,))
-        a2b = self.param("add2b", nn.initializers.zeros, (1,))
-        mul = self.param("mul", nn.initializers.ones, (1,))
+        a1a, a1b, a2a, a2b, mul = _scalars(
+            self, self.dtype, "add1a", "add1b", "add2a", "add2b",
+            "mul")
         if self.stride != 1 or x.shape[-1] != self.c_out:
-            shortcut = _conv1x1(self.c_out, self.stride)(x)
+            shortcut = _conv1x1(self.c_out, self.stride,
+                                dtype=self.dtype)(x)
         else:
             shortcut = x
         out = _conv3x3(self.c_out, self.stride,
-                       self.num_layers ** -0.5)(x + a1a)
+                       self.num_layers ** -0.5, self.dtype)(x + a1a)
         out = nn.relu(out + a1b)
-        out = _conv3x3(self.c_out, 1, 0.0)(out + a2a)
+        out = _conv3x3(self.c_out, 1, 0.0, self.dtype)(out + a2a)
         out = out * mul + a2b
         return nn.relu(out + shortcut)
 
@@ -105,18 +108,22 @@ class FixupResNet18(nn.Module):
     """reference fixup_resnet18.py:66-135 (zero-init classifier)."""
     num_classes: int = 10
     num_blocks: Sequence[int] = (2, 2, 2, 2)
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         L = sum(self.num_blocks)
+        x = x.astype(self.dtype)
         x = nn.relu(nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                            dtype=self.dtype,
                             kernel_init=_fixup_conv_init())(x))
         for c_out, n, stride in zip((64, 128, 256, 256),
                                     self.num_blocks, (1, 2, 2, 2)):
             for b in range(n):
-                x = FixupBlock(c_out, L, stride if b == 0 else 1)(x)
+                x = FixupBlock(c_out, L, stride if b == 0 else 1,
+                               dtype=self.dtype)(x)
         x = _avg_max_head(x)
-        x = nn.Dense(self.num_classes,
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
                      kernel_init=nn.initializers.zeros,
                      bias_init=nn.initializers.zeros)(x)
-        return x
+        return x.astype(jnp.float32)

@@ -90,6 +90,42 @@ class TestImagenetModels:
         assert k.shape == (3, 3, 4, 128), k.shape
 
 
+class TestFixupBf16:
+    """--bf16 for the Fixup family: compute must actually run in
+    bfloat16 (the scalar fixup biases/scales are f32 params and would
+    silently promote activations back to f32 if not cast at use),
+    while params and the returned logits stay float32."""
+
+    @pytest.mark.parametrize("name,shape", [
+        ("FixupResNet9", (2, 32, 32, 3)),
+        ("FixupResNet18", (2, 32, 32, 3)),
+        ("FixupResNet50", (1, 64, 64, 3)),
+    ])
+    def test_bf16_compute_dtype(self, name, shape):
+        cls = get_model(name)
+        kw = {"num_classes": 4, "dtype": jnp.bfloat16}
+        if name == "FixupResNet9":
+            kw.update(cls.test_config(4))
+        elif name == "FixupResNet50":
+            kw["stage_sizes"] = (1, 1, 1, 1)
+        else:
+            kw["num_blocks"] = (1, 1, 1, 1)
+        module = cls(**kw)
+        x = jnp.asarray(np.random.RandomState(0).randn(*shape),
+                        jnp.float32)
+        variables = module.init(jax.random.PRNGKey(0), x)
+        for leaf in jax.tree_util.tree_leaves(variables["params"]):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+        # intercept an intermediate activation to prove bf16 engaged
+        _, state = module.apply(variables, x, capture_intermediates=True)
+        inter = jax.tree_util.tree_leaves(state["intermediates"])
+        assert any(getattr(a, "dtype", None) == jnp.bfloat16
+                   for a in inter), \
+            "no bfloat16 intermediate found — promotion undid --bf16"
+        out = module.apply(variables, x)
+        assert out.dtype == jnp.float32
+
+
 class TestBatchNormUnderClientVmap:
     """SURVEY §7 hard part: with --batchnorm, batch statistics must
     stay per-client under the vmapped round — sync-BN-style mixing
