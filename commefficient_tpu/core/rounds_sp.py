@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+from commefficient_tpu.models.gpt2 import (GPT2Config, GPT2DoubleHeads,
+                                           token_nll)
 from commefficient_tpu.parallel.mesh import CLIENT_AXIS, shard_map
 
 SEQ_AXIS = "seq"
@@ -88,18 +89,13 @@ def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
         params = unravel(flat)
         lm_logits, mc_logits = model.apply(
             {"params": params}, ids, mc_ids, tt)
-        valid = ((labels != ignore).astype(jnp.float32)
-                 * ex_mask[:, None, None])
-        safe = jnp.where(labels != ignore, labels, 0)
-        logp = jax.nn.log_softmax(lm_logits)
-        nll = -jnp.take_along_axis(logp, safe[..., None],
-                                   axis=-1)[..., 0]
+        nll, valid = token_nll(lm_logits, labels, ignore)
+        valid = valid * ex_mask[:, None, None]
         lm_sum = jnp.sum(nll * valid)
         lm_cnt = jnp.sum(valid)
-        mc_logp = jax.nn.log_softmax(mc_logits, axis=-1)
-        mc_nll = -jnp.take_along_axis(mc_logp, mc_labels[..., None],
-                                      axis=-1)[..., 0]
-        mc = (jnp.sum(mc_nll * ex_mask)
+        mc_nll, _ = token_nll(mc_logits[..., None, :],
+                              mc_labels[..., None], ignore)
+        mc = (jnp.sum(mc_nll[..., 0] * ex_mask)
               / jnp.maximum(jnp.sum(ex_mask), 1.0))
         return lm_sum, lm_cnt, mc
 

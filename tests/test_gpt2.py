@@ -151,6 +151,67 @@ class TestGpt2TrainSmoke:
         assert np.isfinite(results[0]["val_ppl"])
 
 
+class TestBatchedTrainLoss:
+    def test_matches_per_example_double_heads_loss(self):
+        """The batched train loss must equal the mask-weighted mean of
+        gpt2_double_heads_loss applied example by example (the
+        formulation it replaced for speed)."""
+        import jax
+        import jax.numpy as jnp
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.models.gpt2 import (
+            GPT2Config, GPT2DoubleHeads, gpt2_double_heads_loss)
+        from commefficient_tpu.train.gpt2_train import (
+            make_compute_loss_train)
+
+        cfg = Config(mode="uncompressed", error_type="none",
+                     local_momentum=0.0, num_workers=2,
+                     local_batch_size=2, num_clients=4,
+                     dataset_name="PERSONA", seed=0,
+                     lm_coef=2.0, mc_coef=0.5)
+        gcfg = GPT2Config.tiny()
+        module = GPT2DoubleHeads(gcfg)
+        rng = np.random.RandomState(0)
+        B, N, T = 3, 2, 12
+        batch = {
+            "input_ids": jnp.asarray(
+                rng.randint(0, gcfg.vocab_size, (B, N, T)), jnp.int32),
+            "token_type_ids": jnp.asarray(
+                rng.randint(0, gcfg.vocab_size, (B, N, T)), jnp.int32),
+            "lm_labels": jnp.asarray(np.where(
+                rng.rand(B, N, T) < 0.3, -1,
+                rng.randint(0, gcfg.vocab_size, (B, N, T))), jnp.int32),
+            "mc_token_ids": jnp.asarray(rng.randint(0, T, (B, N)),
+                                        jnp.int32),
+            "mc_labels": jnp.asarray(rng.randint(0, N, (B,)),
+                                     jnp.int32),
+            "mask": jnp.asarray([1.0, 1.0, 0.0]),
+        }
+        params = module.init(jax.random.PRNGKey(0),
+                             batch["input_ids"],
+                             batch["mc_token_ids"],
+                             batch["input_ids"])["params"]
+        got, _ = make_compute_loss_train(module, cfg)(params, batch,
+                                                      cfg)
+
+        lm_logits, mc_logits = module.apply(
+            {"params": params}, batch["input_ids"],
+            batch["mc_token_ids"], batch["token_type_ids"])
+        per = []
+        for i in range(B):
+            loss_i, _, _ = gpt2_double_heads_loss(
+                lm_logits[i:i + 1], mc_logits[i:i + 1],
+                batch["lm_labels"][i:i + 1],
+                batch["mc_labels"][i:i + 1],
+                lm_coef=cfg.lm_coef, mc_coef=cfg.mc_coef,
+                ignore_index=-1)
+            per.append(float(loss_i))
+        m = np.asarray(batch["mask"])
+        want = float(np.sum(np.asarray(per) * m) / m.sum())
+        np.testing.assert_allclose(float(got), want, rtol=2e-5)
+
+
 class TestSavePretrained:
     def test_model_and_tokenizer_roundtrip(self, tmp_path):
         """reference fed_aggregator.py:205-212 / gpt2_train.py:278-283:
