@@ -374,7 +374,11 @@ class FedOptimizer:
                 inds.append(jnp.asarray(v))
             self._lr_indicators = inds
         self.server_state = ServerState.init(self.args)
-        self._server_round = jax.jit(build_server_round(self.args))
+        # donate weights + server state: both are replaced by the
+        # round's outputs and the stale buffers are never read again —
+        # at GPT-2 scale that's ~1 GB of peak HBM saved per step
+        self._server_round = jax.jit(build_server_round(self.args),
+                                     donate_argnums=(0, 1))
         self._noise_rng = jax.random.PRNGKey(self.args.seed + 1)
         self._step_count = 0
 
