@@ -153,6 +153,9 @@ class Config:
     # rounds the host may run ahead of the device before materialising
     # metrics/accounting (1 = synchronous, reference-faithful timing)
     pipeline_depth: int = 1
+    # GPT-2: rematerialise transformer blocks in backward (activation
+    # memory ~ 1/n_layer, ~1/3 extra FLOPs) — the long-context lever
+    do_remat: bool = False
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -361,6 +364,8 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--approx_topk", action="store_true")
     parser.add_argument("--approx_recall", type=float, default=0.95)
     parser.add_argument("--pipeline_depth", type=int, default=1)
+    parser.add_argument("--remat", action="store_true",
+                        dest="do_remat")
 
     return parser
 

@@ -225,6 +225,8 @@ def build_model_and_tokenizer(args: Config):
                          n_positions=1024)
     if args.do_bf16:
         cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+    if args.do_remat:
+        cfg = dataclasses.replace(cfg, remat=True)
     module = GPT2DoubleHeads(cfg)
     dummy = jnp.zeros((1, args.num_candidates, 8), jnp.int32)
     params = module.init(jax.random.PRNGKey(args.seed), dummy,

@@ -151,6 +151,45 @@ class TestGpt2TrainSmoke:
         assert np.isfinite(results[0]["val_ppl"])
 
 
+class TestRemat:
+    def test_remat_identical_outputs_and_grads(self):
+        """--remat must not change the math — same forward logits and
+        same gradients, only the backward's memory/FLOP schedule."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from commefficient_tpu.models.gpt2 import (GPT2Config,
+                                                   GPT2DoubleHeads)
+
+        cfg = GPT2Config.tiny()
+        rng = np.random.RandomState(0)
+        B, N, T = 2, 2, 10
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, N, T)),
+                          jnp.int32)
+        mc = jnp.asarray(rng.randint(0, T, (B, N)), jnp.int32)
+        base = GPT2DoubleHeads(cfg)
+        remat = GPT2DoubleHeads(dataclasses.replace(cfg, remat=True))
+        params = base.init(jax.random.PRNGKey(0), ids, mc)["params"]
+
+        lm0, mc0 = base.apply({"params": params}, ids, mc)
+        lm1, mc1 = remat.apply({"params": params}, ids, mc)
+        np.testing.assert_array_equal(np.asarray(lm0), np.asarray(lm1))
+        np.testing.assert_array_equal(np.asarray(mc0), np.asarray(mc1))
+
+        def loss(module, p):
+            lm, _ = module.apply({"params": p}, ids, mc)
+            return jnp.sum(lm ** 2)
+
+        g0 = jax.grad(lambda p: loss(base, p))(params)
+        g1 = jax.grad(lambda p: loss(remat, p))(params)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+
 class TestBatchedTrainLoss:
     def test_matches_per_example_double_heads_loss(self):
         """The batched train loss must equal the mask-weighted mean of
