@@ -86,7 +86,7 @@ def bf16_round_trains():
     res = cr(flat, ClientStates.init(cfg, 100, flat), batch,
              jnp.arange(W, dtype=jnp.int32), jax.random.PRNGKey(0),
              1.0)
-    ps2, _, _, upd = sr(flat, ServerState.init(cfg), res.aggregated,
+    ps2, _, _, upd, _ = sr(flat, ServerState.init(cfg), res.aggregated,
                         jnp.float32(0.1))
     assert bool(jnp.isfinite(ps2).all())
     nnz = int((np.asarray(upd) != 0).sum())
