@@ -304,7 +304,8 @@ class FedModel:
           momentum);
         - a dense update array: host-side ``!= 0`` compare (modes
           whose update is sparse but with non-static support size,
-          e.g. local_topk without virtual momentum)."""
+          e.g. local_topk — with any momentum setting, its update's
+          support is the union of past top-k selections)."""
         if self.pipeline_depth > 1:
             self._oplog.append(("note", support))
             return
@@ -428,16 +429,16 @@ class FedOptimizer:
         if support is None:
             # dense-update modes. fedavg/momentum updates touch every
             # coordinate; the exceptions that don't: a zero scalar LR
-            # (nothing moved) and local_topk without virtual momentum
-            # (update stays ~W*k-sparse forever — fall back to the
-            # value-compare on the dense update rather than overcount)
+            # (nothing moved) and local_topk (even with virtual
+            # momentum the update's support is only the union of past
+            # top-k selections, ~W*k coords early on — the reference
+            # value-compares weight_update != 0, so marking all
+            # grad_size coords would overcount download bytes)
             lr_np = np.asarray(lr)
             if (self.args.mode != "fedavg" and lr_np.ndim == 0
                     and float(lr_np) == 0):
                 support = (np.zeros(0, np.int64), np.zeros(0))
-            elif (self.args.mode == "local_topk"
-                  and self.args.virtual_momentum == 0) \
-                    or lr_np.ndim > 0:
+            elif self.args.mode == "local_topk" or lr_np.ndim > 0:
                 support = update  # host-side != 0 compare
         m.note_update(support)
 

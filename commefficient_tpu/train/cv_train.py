@@ -80,6 +80,24 @@ def make_compute_loss(module, init_stats=None):
     return compute_loss
 
 
+def fixup_bias_name(name: str) -> bool:
+    """Fixup 0.1x 'bias' group membership by parameter-path name.
+
+    The reference matches torch names like 'add1a.bias' with a plain
+    'bias' substring (cv_train.py:366-376; fixup_resnet18 wraps each
+    scalar in an AddBias module). Our flax FixupResNet18 names the
+    additive scalars add1a/add1b/add2a/add2b directly, so match those
+    too.
+    """
+    return "bias" in name or "add" in name
+
+
+def fixup_scale_name(name: str) -> bool:
+    """Fixup 0.1x 'scale' group: 'mul.scale' in the reference; our
+    FixupResNet18 names the multiplicative scalar 'mul'."""
+    return "scale" in name or "['mul']" in name
+
+
 def apply_mixup(batch, alpha, rng):
     """Host-side mixup (the classic mixup_data recipe): one lambda ~
     Beta(alpha, alpha) per round; inputs are mixed with a permutation
@@ -415,7 +433,7 @@ def main(argv=None):
         # nominal-LR group comes first so logged LR is the schedule's.
         from commefficient_tpu.ops.vec import param_group_indices
         bias_idx, scale_idx, other_idx = param_group_indices(
-            params, lambda n: "bias" in n, lambda n: "scale" in n)
+            params, fixup_bias_name, fixup_scale_name)
         param_groups = [{"lr": 1.0, "index": other_idx},
                         {"lr": 0.1, "index": bias_idx},
                         {"lr": 0.1, "index": scale_idx}]

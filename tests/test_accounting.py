@@ -114,6 +114,50 @@ def test_rebuild_round_counts_is_lossless():
         counts_before, m._round_counts[:m._update_round + 2])
 
 
+def test_local_topk_virtual_momentum_sparse_download():
+    """local_topk with virtual_momentum > 0 must still account
+    downloads by value-comparing the dense update (reference compares
+    weight_update != 0, fed_aggregator.py:240-300): the update support
+    is only the union of past top-k selections, so a first-round
+    download is ~W*k coords, not grad_size."""
+    import flax.linen as nn
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(64, use_bias=False)(x)
+
+    module = Lin()
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 32)))[
+        "params"]
+    args = Config(mode="local_topk", error_type="local", k=5,
+                  local_momentum=0.9, virtual_momentum=0.9,
+                  num_workers=2, local_batch_size=2, num_clients=6,
+                  dataset_name="CIFAR10", seed=0)
+
+    def loss(p, batch, cfg):
+        pred = module.apply({"params": p}, batch["x"])
+        per = jnp.sum((pred - batch["y"][..., None]) ** 2, -1)
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        return jnp.sum(per * batch["mask"]) / n, ()
+
+    from commefficient_tpu.runtime import FedOptimizer
+    m = FedModel(module, params, loss, args)
+    opt = FedOptimizer([{"lr": 0.1}], args)
+    d = args.grad_size
+    rng = np.random.RandomState(0)
+    batch = {"x": rng.randn(2, 2, 32).astype(np.float32),
+             "y": rng.randn(2, 2).astype(np.float32),
+             "mask": np.ones((2, 2), np.float32),
+             "client_ids": np.array([0, 1], np.int32)}
+    m(batch)
+    opt.step()
+    got, _ = m._account_bytes(np.array([5]))
+    # support after one round is at most num_workers * k coords
+    assert 0 < got[5] <= 4.0 * args.num_workers * args.k
+    assert got[5] < 4.0 * d
+
+
 class TestPipelinedFlush:
     """Multi-round pipeline replay: interleaved account/note ops and
     pending alignment across several rounds of a real FedModel, vs a

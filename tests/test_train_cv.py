@@ -68,11 +68,53 @@ class TestFixupLrGroups:
                    jnp.zeros((1, 32, 32, 3)))["params"]
         flat, _ = flatten_params(p)
         bias, scale, other = param_group_indices(
-            p, lambda n: "bias" in n, lambda n: "scale" in n)
+            p, cv_train.fixup_bias_name, cv_train.fixup_scale_name)
         all_idx = np.concatenate([bias, scale, other])
         assert len(all_idx) == flat.size
         assert len(np.unique(all_idx)) == flat.size
         assert len(bias) > 0 and len(scale) > 0 and len(other) > 0
+
+    def test_resnet18_scalars_in_01x_groups(self):
+        """FixupResNet18 names its fixup scalars add1a/add1b/add2a/
+        add2b/mul — every one of them (and nothing kernel-shaped) must
+        land in a 0.1x group, matching the reference's substring match
+        on 'add1a.bias'/'mul.scale' torch names (fixup_resnet18.py)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.tree_util import keystr, tree_flatten_with_path
+
+        from commefficient_tpu.models import get_model
+        from commefficient_tpu.ops.vec import (flatten_params,
+                                               param_group_indices)
+
+        cls = get_model("FixupResNet18")
+        m = cls()
+        p = m.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 32, 32, 3)))["params"]
+        flat, _ = flatten_params(p)
+        bias, scale, other = param_group_indices(
+            p, cv_train.fixup_bias_name, cv_train.fixup_scale_name)
+        # partition
+        all_idx = np.concatenate([bias, scale, other])
+        assert len(all_idx) == flat.size
+        assert len(np.unique(all_idx)) == flat.size
+        # every scalar leaf (the fixup params are all scalars) is in a
+        # 0.1x group; every kernel is in the 1.0x group
+        leaves, _ = tree_flatten_with_path(p)
+        offset = 0
+        tenth = set(bias.tolist()) | set(scale.tolist())
+        n_scalars = 0
+        for path, leaf in leaves:
+            n = int(np.prod(leaf.shape)) if leaf.shape else 1
+            span = set(range(offset, offset + n))
+            if leaf.size == 1 and "kernel" not in keystr(path):
+                n_scalars += 1
+                assert span <= tenth, f"scalar {keystr(path)} not 0.1x"
+            elif "kernel" in keystr(path):
+                assert span.isdisjoint(tenth), \
+                    f"kernel {keystr(path)} wrongly 0.1x"
+            offset += n
+        assert n_scalars > 0
 
     def test_lr_vector_alignment(self):
         """FedOptimizer.get_lr with index groups: each coordinate gets
@@ -100,7 +142,7 @@ class TestFixupLrGroups:
 
         model = FedModel(m, p, loss, args)
         bias, scale, other = param_group_indices(
-            p, lambda n: "bias" in n, lambda n: "scale" in n)
+            p, cv_train.fixup_bias_name, cv_train.fixup_scale_name)
         opt = FedOptimizer([{"lr": 0.1, "index": bias},
                             {"lr": 0.1, "index": scale},
                             {"lr": 1.0, "index": other}], args)
