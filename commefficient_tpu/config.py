@@ -128,6 +128,11 @@ class Config:
     # GPT-2 / text
     model_checkpoint: str = "gpt2"
     num_candidates: int = 2
+    # candidates evaluated at validation. The reference restricts
+    # candidates only when training (fed_persona.py:251-254) — val MC
+    # accuracy is over the item's full ~20 candidates. 0 = auto-detect
+    # (the maximum candidate count across the val set).
+    val_candidates: int = 0
     max_history: int = 2
     local_batch_size: int = 8
     valid_batch_size: int = 8
@@ -342,6 +347,7 @@ def build_parser(default_lr: Optional[float] = None,
     # GPT2 args
     parser.add_argument("--model_checkpoint", type=str, default="gpt2")
     parser.add_argument("--num_candidates", type=int, default=2)
+    parser.add_argument("--val_candidates", type=int, default=0)
     parser.add_argument("--max_history", type=int, default=2)
     parser.add_argument("--local_batch_size", type=int, default=8)
     parser.add_argument("--valid_batch_size", type=int, default=8)

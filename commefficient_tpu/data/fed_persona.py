@@ -28,7 +28,6 @@ import json
 import os
 import random
 from collections import defaultdict
-from itertools import chain
 
 import numpy as np
 
@@ -239,26 +238,55 @@ def raw_to_input(tokenizer, personality, history, candidates):
 
 def build_input_from_segments(persona, history, reply, tokenizer,
                               lm_labels=False, with_eos=True):
-    """(reference fed_persona.py:330-358) — lm label padding is -1."""
+    """Serialize one (persona, history, reply) triple into the flat
+    GPT-2 double-heads token protocol. The token streams must match
+    the reference's (fed_persona.py:330-358 *semantics*; golden-tested
+    in tests/test_gpt2.py) exactly, since checkpoints and eval numbers
+    depend on them. Protocol, accumulated segment by segment:
+
+    - header: ``<bos>`` + all persona sentences flattened, token type
+      ``speaker1``;
+    - one segment per dialog turn (history turns, then the reply, with
+      ``<eos>`` appended when ``with_eos``). Each is prefixed with a
+      speaker token chosen so the *reply* is always ``speaker2`` and
+      speakers alternate backwards from it. The token *type* of turn t
+      is ``speaker2`` for even t — by turn index, not by the prefixed
+      speaker, so the two disagree for odd history lengths (the
+      reference's index-parity quirk, kept as-is);
+    - ``mc_token_ids``: index of the final token, where the MC head
+      reads its summary;
+    - ``lm_labels``: -1 (ignore) everywhere except, on the gold
+      candidate (``lm_labels=True``), the reply tokens and eos — each
+      predicted from its predecessor, so the speaker prefix gets -1.
+    """
     bos, eos, speaker1, speaker2 = tokenizer.convert_tokens_to_ids(
         SPECIAL_TOKENS[:-1])
-    instance = {}
-    sequence = [[bos] + list(chain(*persona))] + history
-    sequence += [reply + ([eos] if with_eos else [])]
-    sequence = [sequence[0]] + [
-        [speaker2 if (len(sequence) - i) % 2 == 0 else speaker1] + s
-        for i, s in enumerate(sequence[1:])]
-    instance["input_ids"] = list(chain(*sequence))
-    instance["token_type_ids"] = [speaker2 if i % 2 else speaker1
-                                  for i, s in enumerate(sequence)
-                                  for _ in s]
-    instance["mc_token_ids"] = len(instance["input_ids"]) - 1
-    instance["lm_labels"] = [-1] * len(instance["input_ids"])
-    if lm_labels:
-        instance["lm_labels"] = \
-            [-1] * sum(len(s) for s in sequence[:-1])
-        instance["lm_labels"] += [-1] + sequence[-1][1:]
-    return instance
+
+    input_ids = [bos]
+    for sentence in persona:
+        input_ids.extend(sentence)
+    token_types = [speaker1] * len(input_ids)
+    labels = [-1] * len(input_ids)
+
+    turns = list(history)
+    turns.append(list(reply) + ([eos] if with_eos else []))
+    gold = len(turns) - 1
+    for t, turn in enumerate(turns):
+        prefix = speaker2 if (gold - t) % 2 == 0 else speaker1
+        input_ids.append(prefix)
+        input_ids.extend(turn)
+        ttype = speaker2 if t % 2 == 0 else speaker1
+        token_types.extend([ttype] * (len(turn) + 1))
+        if lm_labels and t == gold:
+            labels.append(-1)          # the speaker prefix
+            labels.extend(turn)
+        else:
+            labels.extend([-1] * (len(turn) + 1))
+
+    return {"input_ids": input_ids,
+            "token_type_ids": token_types,
+            "mc_token_ids": len(input_ids) - 1,
+            "lm_labels": labels}
 
 
 def persona_collate(records, num_candidates, max_seq_len, pad_id=0):
@@ -275,6 +303,9 @@ def persona_collate(records, num_candidates, max_seq_len, pad_id=0):
         "lm_labels": np.full((B, N, T), -1, np.int32),
         "mc_token_ids": np.zeros((B, N), np.int32),
         "mc_labels": np.zeros((B,), np.int32),
+        # 1.0 on real candidate slots; val consumers mask the MC
+        # argmax with this so padded slots can never be predicted
+        "cand_mask": np.zeros((B, N), np.float32),
     }
     client_ids = np.zeros((B,), np.int32)
     for b, rec in enumerate(records):
@@ -297,6 +328,7 @@ def persona_collate(records, num_candidates, max_seq_len, pad_id=0):
             out["token_type_ids"][b, j, :L] = ttj
             out["lm_labels"][b, j, :L] = lab
             out["mc_token_ids"][b, j] = min(mc_tok[j], L - 1)
+            out["cand_mask"][b, j] = 1.0
     return client_ids, out
 
 

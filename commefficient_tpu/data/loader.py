@@ -313,6 +313,8 @@ class PersonaValLoader(_ShardedValBase):
                 "mc_token_ids": np.zeros((self.S, self.B, self.N),
                                          np.int32),
                 "mc_labels": np.zeros((self.S, self.B), np.int32),
+                "cand_mask": np.zeros((self.S, self.B, self.N),
+                                      np.float32),
                 "mask": np.zeros((self.S, self.B), np.float32),
             }
             for s in range(self.S):
@@ -324,7 +326,7 @@ class PersonaValLoader(_ShardedValBase):
                                           self.pad_id)
                 n = len(records)
                 for k in ("input_ids", "token_type_ids", "lm_labels",
-                          "mc_token_ids", "mc_labels"):
+                          "mc_token_ids", "mc_labels", "cand_mask"):
                     batch[k][s, :n] = arrs[k]
                 batch["mask"][s, :n] = 1.0
             yield batch

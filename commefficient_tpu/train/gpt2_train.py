@@ -91,6 +91,11 @@ def make_compute_loss_val(module, args):
         valid = valid * m[..., None, None]
         nll = jnp.sum(tok_nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
+        # padded candidate slots (val items pad up to the loader's
+        # static N) must never win the argmax
+        cand = batch.get("cand_mask")
+        if cand is not None:
+            mc_logits = jnp.where(cand > 0, mc_logits, -jnp.inf)
         pred = jnp.argmax(mc_logits, axis=-1)
         acc = jnp.sum((pred == batch["mc_labels"]) * m) \
             / jnp.maximum(jnp.sum(m), 1.0)
@@ -285,8 +290,17 @@ def get_data_loaders(args: Config, tokenizer):
     train_loader = PersonaFedLoader(
         train_ds, sampler, args.num_candidates, MAX_SEQ_LEN, pad_id,
         dropout_prob=args.dropout_prob, dropout_seed=args.seed)
+    # full-candidate validation (reference fed_persona.py:251-254
+    # restricts candidates only for train items): evaluate MC accuracy
+    # over every candidate the val item carries, not num_candidates
+    n_val = args.val_candidates
+    if n_val <= 0:
+        # exact max over the raw val JSON (candidate counts can vary
+        # per utterance) — no tokenization needed
+        n_val = max((len(u["candidates"]) for d in val_ds.raw_val_set
+                     for u in d["utterances"]), default=2)
     val_loader = PersonaValLoader(
-        val_ds, args.valid_batch_size, max(args.num_candidates, 2),
+        val_ds, args.valid_batch_size, max(n_val, 2),
         MAX_SEQ_LEN, pad_id,
         shards_per_step=max(1, args.num_workers))
     return train_loader, val_loader, train_ds

@@ -116,6 +116,53 @@ class TestPersonaInputs:
         assert all(l == -1 for l in labels[:n_prefix])
         assert labels[-(len(reply) + 1):] == [30, 31, eos]
 
+    def test_build_input_golden_streams(self):
+        """Hardcoded golden token streams for the serialization
+        protocol (generated from the reference algorithm,
+        fed_persona.py:330-358): exact ids/types/labels/mc positions,
+        covering empty persona, empty history, odd/even history
+        lengths (the type-vs-speaker parity quirk) and with_eos."""
+        from commefficient_tpu.data.fed_persona import \
+            build_input_from_segments
+        from commefficient_tpu.data.tokenizer import (ByteTokenizer,
+                                                      SPECIAL_TOKENS)
+        tok = ByteTokenizer()
+        tok.add_special_tokens(SPECIAL_TOKENS)
+        golden = [
+            (dict(persona=[[10, 11]], history=[[20], [21]],
+                  reply=[30, 31], lm_labels=True, with_eos=True),
+             [256, 10, 11, 259, 20, 258, 21, 259, 30, 31, 257],
+             [258, 258, 258, 259, 259, 258, 258, 259, 259, 259, 259],
+             [-1, -1, -1, -1, -1, -1, -1, -1, 30, 31, 257], 10),
+            (dict(persona=[[10, 11], [12]],
+                  history=[[20], [21], [22]], reply=[30],
+                  lm_labels=False, with_eos=True),
+             [256, 10, 11, 12, 258, 20, 259, 21, 258, 22, 259, 30,
+              257],
+             [258, 258, 258, 258, 259, 259, 258, 258, 259, 259, 258,
+              258, 258],
+             [-1] * 13, 12),
+            (dict(persona=[[5]], history=[], reply=[7, 8, 9],
+                  lm_labels=True, with_eos=False),
+             [256, 5, 259, 7, 8, 9],
+             [258, 258, 259, 259, 259, 259],
+             [-1, -1, -1, 7, 8, 9], 5),
+            (dict(persona=[], history=[[1], [2], [3], [4]],
+                  reply=[6], lm_labels=True, with_eos=True),
+             [256, 259, 1, 258, 2, 259, 3, 258, 4, 259, 6, 257],
+             [258, 259, 259, 258, 258, 259, 259, 258, 258, 259, 259,
+              259],
+             [-1, -1, -1, -1, -1, -1, -1, -1, -1, -1, 6, 257], 11),
+        ]
+        for kw, ids, tt, lm, mc in golden:
+            inst = build_input_from_segments(
+                kw["persona"], kw["history"], kw["reply"], tok,
+                lm_labels=kw["lm_labels"], with_eos=kw["with_eos"])
+            assert inst["input_ids"] == ids
+            assert inst["token_type_ids"] == tt
+            assert inst["lm_labels"] == lm
+            assert inst["mc_token_ids"] == mc
+
     def test_synthetic_archive_and_dataset(self, tmp_path):
         from commefficient_tpu.data.fed_persona import (
             FedPERSONA, generate_synthetic_personachat)
@@ -149,6 +196,106 @@ class TestGpt2TrainSmoke:
         assert len(results) == 1
         assert np.isfinite(results[0]["train_loss"])
         assert np.isfinite(results[0]["val_ppl"])
+
+
+class TestFullCandidateValidation:
+    """Reference restricts candidates only when *training*
+    (fed_persona.py:251-254): val MC accuracy is measured over the
+    item's full candidate list, not num_candidates."""
+
+    def _val_ds(self, tmp_path, n_cands):
+        from commefficient_tpu.data.fed_persona import (
+            FedPERSONA, generate_synthetic_personachat)
+        from commefficient_tpu.data.tokenizer import (ByteTokenizer,
+                                                      SPECIAL_TOKENS)
+        generate_synthetic_personachat(str(tmp_path),
+                                       num_candidates=n_cands)
+        tok = ByteTokenizer()
+        tok.add_special_tokens(SPECIAL_TOKENS)
+        # num_candidates=2 restriction must NOT apply to val items
+        return FedPERSONA(tok, 2, 2, 1, str(tmp_path), "PERSONA",
+                          train=False)
+
+    def test_val_items_keep_all_candidates(self, tmp_path):
+        ds = self._val_ds(tmp_path, n_cands=5)
+        cid, input_ids, mc_tok, lm_lab, mc_lab, tt = ds[0]
+        assert cid == -1
+        assert len(input_ids) == 5          # all candidates kept
+        assert mc_lab == 4                  # gold is last
+
+    def test_val_loader_pads_and_masks(self, tmp_path):
+        from commefficient_tpu.data.loader import PersonaValLoader
+        ds = self._val_ds(tmp_path, n_cands=5)
+        loader = PersonaValLoader(ds, 2, 8, 64, pad_id=0,
+                                  shards_per_step=1)
+        batch = next(iter(loader))
+        assert batch["input_ids"].shape[2] == 8
+        # real rows: 5 valid candidate slots, 3 padded; gold index 4
+        rows = np.nonzero(batch["mask"])
+        np.testing.assert_array_equal(
+            batch["cand_mask"][rows][:, :5], 1.0)
+        np.testing.assert_array_equal(
+            batch["cand_mask"][rows][:, 5:], 0.0)
+        np.testing.assert_array_equal(batch["mc_labels"][rows], 4)
+
+    def test_mc_argmax_never_picks_padded_slot(self):
+        """compute_loss_val masks mc_logits with cand_mask: a padded
+        slot carrying the max raw logit must not be predicted."""
+        import jax.numpy as jnp
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.train.gpt2_train import \
+            make_compute_loss_val
+
+        class StubModule:
+            def apply(self, variables, input_ids, mc_token_ids,
+                      token_type_ids):
+                lm = jnp.zeros(input_ids.shape + (16,))
+                mc = jnp.zeros(input_ids.shape[:-1], jnp.float32)
+                mc = mc.at[..., -1].set(10.0)  # padded slot: max
+                mc = mc.at[..., 1].set(5.0)    # gold slot: runner-up
+                return lm, mc
+
+        args = Config(mode="uncompressed", error_type="none",
+                      local_momentum=0.0, num_workers=1,
+                      local_batch_size=2, num_clients=2,
+                      dataset_name="PERSONA", seed=0)
+        loss_fn = make_compute_loss_val(StubModule(), args)
+        S, B, N, T = 1, 2, 4, 8
+        batch = {
+            "input_ids": np.zeros((S, B, N, T), np.int32),
+            "token_type_ids": np.zeros((S, B, N, T), np.int32),
+            "lm_labels": np.full((S, B, N, T), -1, np.int32),
+            "mc_token_ids": np.zeros((S, B, N), np.int32),
+            "mc_labels": np.full((S, B), 1, np.int32),
+            "cand_mask": np.zeros((S, B, N), np.float32),
+            "mask": np.ones((S, B), np.float32),
+        }
+        batch["cand_mask"][..., :2] = 1.0  # only slots 0,1 are real
+        _, (acc,) = loss_fn(None, batch, None)
+        assert float(acc) == 1.0  # masked argmax lands on gold (1)
+        # without the mask the padded slot 3 would win and acc = 0
+        del batch["cand_mask"]
+        _, (acc_unmasked,) = loss_fn(None, batch, None)
+        assert float(acc_unmasked) == 0.0
+
+    def test_end_to_end_full_candidates(self, tmp_path):
+        """A random-init --test run over a 5-candidate archive: val MC
+        accuracy is measured over all 5 (chance ~1/5, and certainly
+        below the 2-candidate chance of 1/2 it used to report)."""
+        from commefficient_tpu.data.fed_persona import \
+            generate_synthetic_personachat
+        from commefficient_tpu.train import gpt2_train
+        generate_synthetic_personachat(str(tmp_path), num_candidates=5)
+        results = gpt2_train.main([
+            "--test", "--dataset_name", "PERSONA",
+            "--dataset_dir", str(tmp_path),
+            "--mode", "uncompressed", "--error_type", "none",
+            "--local_momentum", "0", "--num_workers", "2",
+            "--local_batch_size", "2", "--num_epochs", "1",
+            "--lr_scale", "0.0",
+        ])
+        assert 0.0 <= results[0]["val_acc"] <= 0.45
 
 
 class TestRemat:
