@@ -77,6 +77,10 @@ class RoundResult(NamedTuple):
     aggregated: jax.Array        # transmit-sum / total datapoints
     metrics: tuple               # per-client batch-mean metrics, each (W,)
     client_states: ClientStates
+    # (stats_pytree, alive_scalar) when a stats_fn is configured —
+    # the sample-weighted mean of participating clients' batch
+    # statistics this round (BatchNorm running-stats parity mode)
+    bn_stats: Optional[tuple] = None
 
 
 def args2sketch(cfg: Config) -> Optional[CountSketch]:
@@ -91,7 +95,7 @@ def args2sketch(cfg: Config) -> Optional[CountSketch]:
 
 def build_client_round(cfg: Config, loss_fn: Callable,
                        padded_batch_size: int,
-                       mesh=None) -> Callable:
+                       mesh=None, stats_fn: Callable = None) -> Callable:
     """Returns jit-able
     ``client_round(ps_weights, client_states, batch, client_ids, rng,
     fedavg_lr) -> RoundResult``.
@@ -164,7 +168,8 @@ def build_client_round(cfg: Config, loss_fn: Callable,
             # Σ_i (wd/num_workers)·p·n_i / total = (wd/num_workers)·p
             g = g + (cfg.weight_decay / cfg.num_workers) * ps_weights
         aggregated = sketch.sketch(g) if cfg.mode == "sketch" else g
-        return RoundResult(aggregated, metrics, client_states)
+        return RoundResult(aggregated, metrics, client_states,
+                           _round_bn_stats(stats_fn, ps_weights, batch))
 
     def client_round(ps_weights, client_states: ClientStates, batch,
                      client_ids, rng, fedavg_lr=1.0) -> RoundResult:
@@ -197,9 +202,29 @@ def build_client_round(cfg: Config, loss_fn: Callable,
             _scatter(client_states.errors, client_ids, new_err),
             _scatter(client_states.weights, client_ids, new_wts),
         )
-        return RoundResult(aggregated, metrics, states)
+        return RoundResult(aggregated, metrics, states,
+                           _round_bn_stats(stats_fn, ps_weights, batch))
 
     return client_round_fused if fused_grad else client_round
+
+
+def _round_bn_stats(stats_fn, ps_weights, batch):
+    """Sample-weighted mean of participating clients' batch statistics
+    (the federated replacement for per-worker torch running-stats
+    updates): one extra forward per client, only in --batchnorm
+    configs. Dropped/padded clients get zero weight; ``alive`` lets
+    the server skip the blend on a fully-dropped round."""
+    if stats_fn is None:
+        return None
+    n = jax.vmap(lambda b: jnp.sum(b["mask"]))(batch)   # (W,)
+    total = jnp.maximum(jnp.sum(n), 1.0)
+    per_client = jax.vmap(stats_fn, in_axes=(None, 0))(ps_weights,
+                                                       batch)
+    w = n / total
+    mean_stats = jax.tree_util.tree_map(
+        lambda s: jnp.tensordot(w.astype(s.dtype), s, axes=(0, 0)),
+        per_client)
+    return mean_stats, jnp.sum(n)
 
 
 def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh):
@@ -341,10 +366,24 @@ def _build_fedavg_client_step(cfg, loss_fn, padded_batch_size):
     return step
 
 
-def build_val_fn(cfg: Config, loss_fn: Callable) -> Callable:
+def build_val_fn(cfg: Config, loss_fn: Callable,
+                 stateful: bool = False) -> Callable:
     """Validation shard evaluator: metrics only, batch-mean over the
     shard (reference _call_val + forward_grad(compute_grad=False),
-    fed_aggregator.py:339-366)."""
+    fed_aggregator.py:339-366). With ``stateful``, ``loss_fn`` takes
+    an extra model-state pytree (BatchNorm running stats) that is
+    passed per call — an argument, not a closure, so updated stats
+    never trigger a re-trace."""
+    if stateful:
+        def val_shards_state(ps_weights, model_state, batch):
+            def one(b):
+                loss, metrics = loss_fn(ps_weights, b, model_state)
+                return jnp.stack((loss,) + tuple(metrics))
+
+            return jax.vmap(one)(batch)
+
+        return val_shards_state
+
     eval_metrics = make_eval_metrics(loss_fn)
 
     def val_shards(ps_weights, batch):

@@ -61,6 +61,11 @@ class FedPERSONA(FedDataset):
 
     @property
     def data_per_client(self):
+        # cached: at natural scale (17,568 clients) this is an
+        # O(#dialogs) reduction, and __getitem__ consults it per item
+        # in iid mode
+        if self._dpc_cache is not None:
+            return self._dpc_cache
         if self.do_iid:
             n = len(self)
             upc = (np.ones(self.num_clients, dtype=int) * n
@@ -68,11 +73,15 @@ class FedPERSONA(FedDataset):
             extra = n % self.num_clients
             if extra:
                 upc[self.num_clients - extra:] += 1
+            self._dpc_cache = upc
             return upc
-        cumsum = np.hstack([[0], np.cumsum(self.dialogs_per_client)])
-        return np.array([
-            sum(self.train_utterances_per_dialog[s:s + dpc])
-            for s, dpc in zip(cumsum, self.dialogs_per_client)])
+        # utterances per client = segmented sum of utterances-per-
+        # dialog over each client's dialog span
+        upd_cumsum = np.hstack(
+            [[0], np.cumsum(self.train_utterances_per_dialog)])
+        spans = np.hstack([[0], np.cumsum(self.dialogs_per_client)])
+        self._dpc_cache = np.diff(upd_cumsum[spans])
+        return self._dpc_cache
 
     @property
     def num_clients(self):
@@ -89,6 +98,16 @@ class FedPERSONA(FedDataset):
             stats["train_utterances_per_dialog"]
         self.val_utterances_per_dialog = \
             stats["val_utterances_per_dialog"]
+        # index->dialog->client lookups are done per __getitem__; at
+        # 17,568 clients / 130k dialogs the cumsums must not be
+        # recomputed per access (round-1 review, "host-side scale")
+        self._train_upd_cumsum = np.cumsum(
+            self.train_utterances_per_dialog)
+        self._dialog_cumsum = np.cumsum(self.dialogs_per_client)
+        self._val_upd_cumsum = np.cumsum(
+            self.val_utterances_per_dialog)
+        self._dpc_cache = None
+        self._iid_dpc_cumsum = None
 
     def __len__(self):
         if self.type == "train":
@@ -144,16 +163,16 @@ class FedPERSONA(FedDataset):
         if self.do_iid:
             idx = self.iid_shuffle[idx]
 
-        cumsum = np.cumsum(self.train_utterances_per_dialog)
+        cumsum = self._train_upd_cumsum
         dialog_id = int(np.searchsorted(cumsum, idx, side="right"))
-        cumsum = np.hstack([[0], cumsum[:-1]])
-        idx_within_dialog = int(idx - cumsum[dialog_id])
+        idx_within_dialog = int(idx - (cumsum[dialog_id - 1]
+                                       if dialog_id else 0))
 
-        cumsum = np.cumsum(self.dialogs_per_client)
+        cumsum = self._dialog_cumsum
         client_id = int(np.searchsorted(cumsum, dialog_id,
                                         side="right"))
-        cumsum = np.hstack([[0], cumsum[:-1]])
-        idx_within_client = int(dialog_id - cumsum[client_id])
+        idx_within_client = int(dialog_id - (cumsum[client_id - 1]
+                                             if client_id else 0))
 
         dataset = self._load_client(client_id)
         dialog = dataset[idx_within_client]
@@ -168,16 +187,17 @@ class FedPERSONA(FedDataset):
         model_input = self.utterance_to_input(personality, utterance)
 
         if self.do_iid:
-            cumsum = np.cumsum(self.data_per_client)
-            client_id = int(np.searchsorted(cumsum, orig_idx,
-                                            side="right"))
+            if self._iid_dpc_cumsum is None:
+                self._iid_dpc_cumsum = np.cumsum(self.data_per_client)
+            client_id = int(np.searchsorted(self._iid_dpc_cumsum,
+                                            orig_idx, side="right"))
         return (client_id,) + model_input
 
     def _get_val_item_full(self, idx):
-        cumsum = np.cumsum(self.val_utterances_per_dialog)
+        cumsum = self._val_upd_cumsum
         dialog_id = int(np.searchsorted(cumsum, idx, side="right"))
-        cumsum = np.hstack([[0], cumsum[:-1]])
-        idx_within = int(idx - cumsum[dialog_id])
+        idx_within = int(idx - (cumsum[dialog_id - 1]
+                                if dialog_id else 0))
         dialog = self.raw_val_set[dialog_id]
         return (-1,) + self.utterance_to_input(
             list(dialog["personality"]),

@@ -6,12 +6,16 @@ residual blocks, a bias-free linear head scaled by 0.125 (``Mul``).
 
 TPU notes:
 - NHWC layout (XLA's native conv layout on TPU).
-- BatchNorm ("--batchnorm") computes batch statistics both in training
-  and eval. The reference keeps torch running stats in each worker
-  process, which never federate and diverge per-worker
-  (SURVEY.md §7 "BatchNorm under client-vmap"); batch-stat eval is the
-  well-defined equivalent. The BN-free default path is identical to
-  the reference's default (do_batchnorm=False, utils.py:138).
+- BatchNorm ("--batchnorm") trains on current-batch statistics
+  (masked to real rows — padded ragged-client rows are excluded, as
+  the reference's dynamically-sized torch batches naturally are) and
+  records them into a ``batch_stats`` collection; the server blends
+  participating clients' stats into one running-stats state and eval
+  normalizes with it (``train=False``), so eval metrics don't depend
+  on eval batch composition — running-stats parity with the
+  reference's torch BN (models/resnet9.py:32-59). The BN-free default
+  path is identical to the reference's default (do_batchnorm=False,
+  utils.py:138).
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from commefficient_tpu.models import register_model
+from commefficient_tpu.models.norms import BatchStatNorm
 
 _conv_init = nn.initializers.he_normal()
 
@@ -35,18 +40,21 @@ class ConvBN(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, mask=None):
         x = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
                     kernel_init=_conv_init, dtype=self.dtype)(x)
         if self.do_batchnorm:
-            # batch statistics in train and eval; running averages are
-            # never used (see module docstring), so mark them
-            # non-collectable by always recomputing
-            x = nn.BatchNorm(
-                use_running_average=False,
-                scale_init=nn.initializers.constant(self.bn_weight_init),
-                dtype=self.dtype,
-            )(x)
+            # train: current batch statistics (recorded raw into the
+            # batch_stats collection; the server blends them into its
+            # running stats). eval: the server's running stats, so
+            # metrics don't depend on eval batch composition — the
+            # running-stats parity mode for the reference's torch BN
+            # eval (models/resnet9.py:32-59).
+            x = BatchStatNorm(
+                scale_init=self.bn_weight_init,
+                use_running_average=not train,
+                track_stats=True,
+            )(x, mask)
         x = nn.relu(x)
         if self.pool:
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
@@ -60,9 +68,11 @@ class Residual(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
-        y = ConvBN(self.c, self.do_batchnorm, dtype=self.dtype)(x, train)
-        y = ConvBN(self.c, self.do_batchnorm, dtype=self.dtype)(y, train)
+    def __call__(self, x, train: bool = True, mask=None):
+        y = ConvBN(self.c, self.do_batchnorm,
+                   dtype=self.dtype)(x, train, mask)
+        y = ConvBN(self.c, self.do_batchnorm,
+                   dtype=self.dtype)(y, train, mask)
         return x + nn.relu(y)
 
 
@@ -79,22 +89,22 @@ class ResNet9(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, mask=None):
         ch = self.channels or {"prep": 64, "layer1": 128,
                                "layer2": 256, "layer3": 512}
         x = x.astype(self.dtype)
         x = ConvBN(ch["prep"], self.do_batchnorm,
-                   dtype=self.dtype)(x, train)
+                   dtype=self.dtype)(x, train, mask)
         x = ConvBN(ch["layer1"], self.do_batchnorm, pool=True,
-                   dtype=self.dtype)(x, train)
+                   dtype=self.dtype)(x, train, mask)
         x = Residual(ch["layer1"], self.do_batchnorm,
-                     dtype=self.dtype)(x, train)
+                     dtype=self.dtype)(x, train, mask)
         x = ConvBN(ch["layer2"], self.do_batchnorm, pool=True,
-                   dtype=self.dtype)(x, train)
+                   dtype=self.dtype)(x, train, mask)
         x = ConvBN(ch["layer3"], self.do_batchnorm, pool=True,
-                   dtype=self.dtype)(x, train)
+                   dtype=self.dtype)(x, train, mask)
         x = Residual(ch["layer3"], self.do_batchnorm,
-                     dtype=self.dtype)(x, train)
+                     dtype=self.dtype)(x, train, mask)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(self.num_classes, use_bias=False,

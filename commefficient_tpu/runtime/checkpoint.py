@@ -63,6 +63,14 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
     arrays["ss_Verror"] = np.asarray(jax.device_get(ss.Verror))
     arrays["last_updated"] = model.last_updated
     arrays["client_last_seen"] = model.client_last_seen
+    if getattr(model, "model_state", None) is not None:
+        # BatchNorm running stats: flatten the pytree with stable,
+        # path-derived keys
+        from jax.tree_util import keystr, tree_flatten_with_path
+        leaves, _ = tree_flatten_with_path(model.model_state)
+        for leaf_path, leaf in leaves:
+            arrays["bnstats:" + keystr(leaf_path)] = \
+                np.asarray(jax.device_get(leaf))
 
     meta = {
         "format": _FMT,
@@ -193,6 +201,19 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
                                        jnp.asarray(z["ss_Verror"]))
         model.last_updated = np.asarray(z["last_updated"])
         model.client_last_seen = np.asarray(z["client_last_seen"])
+        if getattr(model, "model_state", None) is not None:
+            from jax.tree_util import keystr, tree_flatten_with_path
+            leaves, treedef = tree_flatten_with_path(model.model_state)
+            restored = []
+            for path, leaf in leaves:
+                key = "bnstats:" + keystr(path)
+                if key not in z.files:
+                    raise ValueError(
+                        f"checkpoint lacks BN running stats {key} "
+                        "but this run tracks them")
+                restored.append(jnp.asarray(z[key]))
+            model.model_state = jax.tree_util.tree_unflatten(
+                treedef, restored)
         model.round_index = meta["round_index"]
         model._update_round = meta["update_round"]
         model._rebuild_round_counts()

@@ -62,13 +62,25 @@ class FedModel:
     def __init__(self, module, params, compute_loss: Callable,
                  args: Config, compute_loss_val: Optional[Callable] = None,
                  padded_batch_size: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, stats_fn: Optional[Callable] = None,
+                 init_model_state=None):
         global _CURRENT_MODEL
         args.validate_runtime()
         self.module = module
         self.args = args
         self.compute_loss_train = compute_loss
         self.compute_loss_val = compute_loss_val or compute_loss
+        # BatchNorm running-stats parity mode: ``stats_fn(params,
+        # client_batch) -> stats_pytree`` records each participating
+        # client's batch statistics; the server blends their round
+        # average into ``model_state`` (torch momentum 0.1) and eval
+        # reads it — so eval metrics don't depend on eval batch
+        # composition (reference models/resnet9.py BN eval). When set,
+        # ``compute_loss_val`` must take (params, batch, args, state).
+        self.stats_fn = stats_fn
+        self.model_state = (jax.tree_util.tree_map(jnp.asarray,
+                                                   init_model_state)
+                            if stats_fn is not None else None)
 
         flat, unravel = flatten_params(params)
         args.grad_size = int(flat.size)
@@ -110,18 +122,32 @@ class FedModel:
         def loss_flat(flat_params, batch, loss=compute_loss):
             return loss(self.unravel(flat_params), batch, args)
 
-        def loss_flat_val(flat_params, batch):
-            return self.compute_loss_val(self.unravel(flat_params),
-                                         batch, args)
+        stats_fn_flat = None
+        if stats_fn is not None:
+            def stats_fn_flat(flat_params, batch):
+                return stats_fn(self.unravel(flat_params), batch)
+
+            def loss_flat_val_state(flat_params, batch, model_state):
+                return self.compute_loss_val(
+                    self.unravel(flat_params), batch, args,
+                    model_state)
+        else:
+            def loss_flat_val(flat_params, batch):
+                return self.compute_loss_val(self.unravel(flat_params),
+                                             batch, args)
 
         # donate the per-client state buffers: the round returns their
         # updated versions and the stale ones are never read again —
         # halves peak memory for local-momentum/-error modes at scale
         self._client_round = jax.jit(
             build_client_round(args, loss_flat, padded_batch_size,
-                               mesh=self.mesh),
+                               mesh=self.mesh, stats_fn=stats_fn_flat),
             donate_argnums=(1,))
-        self._val_fn = jax.jit(build_val_fn(args, loss_flat_val))
+        if stats_fn is not None:
+            self._val_fn = jax.jit(build_val_fn(
+                args, loss_flat_val_state, stateful=True))
+        else:
+            self._val_fn = jax.jit(build_val_fn(args, loss_flat_val))
 
         # pending round state consumed by FedOptimizer.step
         self.pending_aggregated = None
@@ -214,6 +240,15 @@ class FedModel:
         self.pending_aggregated = res.aggregated
         self.pending_client_ids = ids
         self.round_index += 1
+        if res.bn_stats is not None:
+            # running-stats blend (torch BN momentum 0.1); a fully
+            # dropped round contributes nothing. Lazy device ops on
+            # per-channel vectors — no host sync.
+            new_stats, alive = res.bn_stats
+            self.model_state = jax.tree_util.tree_map(
+                lambda ra, s: jnp.where(alive > 0,
+                                        0.9 * ra + 0.1 * s, ra),
+                self.model_state, new_stats)
 
         if self.pipeline_depth > 1:
             self._oplog.append(("account", ids_np,
@@ -281,7 +316,11 @@ class FedModel:
     def _call_val(self, batch):
         dev_batch = shard_batch(self.mesh, jax.tree_util.tree_map(
             jnp.asarray, batch))
-        out = np.asarray(self._val_fn(self.ps_weights, dev_batch))
+        if self.stats_fn is not None:
+            out = np.asarray(self._val_fn(self.ps_weights,
+                                          self.model_state, dev_batch))
+        else:
+            out = np.asarray(self._val_fn(self.ps_weights, dev_batch))
         # (S, n_metrics) -> per-shard metric arrays, like the
         # reference's split_results (fed_aggregator.py:617-618), plus
         # per-shard real-sample counts so callers can weight out the

@@ -51,33 +51,75 @@ def make_compute_loss(module, init_stats=None):
     def compute_loss(params, batch, args):
         variables = {"params": params}
         if init_stats is not None:
+            # masked batch statistics: padded rows must not enter
+            # (the reference's torch batches are dynamically sized,
+            # so its BN only ever sees real samples)
             variables["batch_stats"] = init_stats
             logits, _ = module.apply(variables, batch["x"],
+                                     mask=batch["mask"],
                                      mutable=["batch_stats"])
         else:
             logits = module.apply(variables, batch["x"])
-        labels = batch["y"]
-        logp = jax.nn.log_softmax(logits)
-
-        def nll_of(lab):
-            return -jnp.take_along_axis(logp, lab[..., None],
-                                        axis=-1)[..., 0]
-
-        if "y_b" in batch:
-            lam = batch["lam"]  # per-sample (broadcast of round lam)
-            nll = lam * nll_of(labels) \
-                + (1.0 - lam) * nll_of(batch["y_b"])
-            dominant = jnp.where(lam >= 0.5, labels, batch["y_b"])
-        else:
-            nll = nll_of(labels)
-            dominant = labels
-        loss = masked_mean(nll, batch["mask"])
-        acc = masked_mean(
-            (jnp.argmax(logits, -1) == dominant).astype(jnp.float32),
-            batch["mask"])
-        return loss, (acc,)
+        return _ce_loss_and_acc(logits, batch)
 
     return compute_loss
+
+
+def _ce_loss_and_acc(logits, batch):
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits)
+
+    def nll_of(lab):
+        return -jnp.take_along_axis(logp, lab[..., None],
+                                    axis=-1)[..., 0]
+
+    if "y_b" in batch:
+        lam = batch["lam"]  # per-sample (broadcast of round lam)
+        nll = lam * nll_of(labels) \
+            + (1.0 - lam) * nll_of(batch["y_b"])
+        dominant = jnp.where(lam >= 0.5, labels, batch["y_b"])
+    else:
+        nll = nll_of(labels)
+        dominant = labels
+    loss = masked_mean(nll, batch["mask"])
+    acc = masked_mean(
+        (jnp.argmax(logits, -1) == dominant).astype(jnp.float32),
+        batch["mask"])
+    return loss, (acc,)
+
+
+def make_compute_loss_eval(module):
+    """Eval loss for stateful-BN models: normalize by the server's
+    running statistics (model_state), so metrics are invariant to the
+    eval batch composition — the reference's torch BN eval behavior
+    (models/resnet9.py:32-59 via nn.BatchNorm2d)."""
+
+    def compute_loss(params, batch, args, model_state):
+        logits = module.apply({"params": params,
+                               "batch_stats": model_state},
+                              batch["x"], train=False)
+        return _ce_loss_and_acc(logits, batch)
+
+    return compute_loss
+
+
+def make_bn_stats_fn(module, init_stats):
+    """One client's raw batch statistics: a train-mode forward with a
+    mutable batch_stats collection (BatchStatNorm records the masked
+    batch mean/var; the server does the running blend). This is a
+    second forward per client on top of the gradient pass — accepted
+    tradeoff: threading the stats out through the grad/metrics
+    machinery would complicate every mode path, and --batchnorm is a
+    parity mode, not the perf path (benches are BN-free)."""
+
+    def stats_fn(params, batch):
+        _, upd = module.apply({"params": params,
+                               "batch_stats": init_stats},
+                              batch["x"], mask=batch["mask"],
+                              mutable=["batch_stats"])
+        return upd["batch_stats"]
+
+    return stats_fn
 
 
 def fixup_bias_name(name: str) -> bool:
@@ -423,8 +465,14 @@ def main(argv=None):
         params = load_finetune_params(args, params)
     compute_loss = make_compute_loss(module, init_stats)
 
+    stats_fn = loss_val = None
+    if init_stats:  # stateful BN (--batchnorm): running-stats eval
+        stats_fn = make_bn_stats_fn(module, init_stats)
+        loss_val = make_compute_loss_eval(module)
     model = FedModel(module, params, compute_loss, args,
-                     padded_batch_size=train_loader.B)
+                     compute_loss_val=loss_val,
+                     padded_batch_size=train_loader.B,
+                     stats_fn=stats_fn, init_model_state=init_stats)
 
     if args.model.startswith("Fixup") and args.mode != "fedavg":
         # Fixup LR groups (reference cv_train.py:366-376): bias and

@@ -1,0 +1,181 @@
+"""Host data-plane benchmarks at natural-partition scale.
+
+Round-1 review: "17,568-client PERSONA prep and 3500-writer EMNIST
+packing have never been timed". This script fabricates synthetic
+archives at the natural *client counts* (dialog/image payloads scaled
+down — the client-count axis is what stresses the host: file counts,
+cumsum sizes, fd behavior) and times:
+
+- PERSONA: archive parse + per-client split (prepare_datasets),
+  dataset construction, item access rate, FedSampler round rate
+- FEMNIST: LEAF json parse + packed-memmap write (prepare_datasets),
+  item access rate
+
+Usage:  python scripts/host_scale_bench.py [--persona_clients 17568]
+        [--emnist_writers 3500] [--emnist_images 20] [--workdir DIR]
+
+Results are recorded in BENCHMARKS.md ("Host data-plane at natural
+scale").
+"""
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+
+def bench_persona(root, num_clients):
+    from commefficient_tpu.data.fed_persona import FedPERSONA
+    from commefficient_tpu.data.fed_sampler import FedSampler
+    from commefficient_tpu.data.tokenizer import (ByteTokenizer,
+                                                  SPECIAL_TOKENS)
+
+    d = os.path.join(root, "persona")
+    os.makedirs(d, exist_ok=True)
+    rng = random.Random(0)
+    words = ["i", "like", "cats", "dogs", "music", "food", "sports",
+             "reading", "travel", "coding", "you", "me", "the", "a"]
+
+    def sentence():
+        return " ".join(rng.choice(words) for _ in range(5))
+
+    t0 = time.time()
+    data = {"train": [], "valid": []}
+    for p in range(num_clients):
+        personality = [f"p{p} " + sentence() for _ in range(3)]
+        utterances = [{"history": [sentence()],
+                       "candidates": [sentence() for _ in range(20)]}
+                      for _ in range(3)]
+        data["train"].append({"personality": personality,
+                              "utterances": utterances})
+    for _ in range(64):
+        data["valid"].append({
+            "personality": [sentence() for _ in range(3)],
+            "utterances": [{"history": [sentence()],
+                            "candidates": [sentence()
+                                           for _ in range(20)]}]})
+    with open(os.path.join(d, "personachat_self_original.json"),
+              "w") as f:
+        json.dump(data, f)
+    gen_s = time.time() - t0
+
+    tok = ByteTokenizer()
+    tok.add_special_tokens(SPECIAL_TOKENS)
+
+    t0 = time.time()
+    ds = FedPERSONA(tok, 2, 2, 1, d, "PERSONA", train=True)
+    prep_s = time.time() - t0  # includes prepare_datasets (first run)
+
+    t0 = time.time()
+    ds2 = FedPERSONA(tok, 2, 2, 1, d, "PERSONA", train=True)
+    load_s = time.time() - t0  # stats-only reload
+
+    n_items = len(ds2)
+    n_access = min(5000, n_items)
+    idxs = random.Random(1).sample(range(n_items), n_access)
+    t0 = time.time()
+    for i in idxs:
+        ds2[i]
+    access_s = time.time() - t0
+
+    sampler = FedSampler(ds2, 16, 4, seed=0)
+    t0 = time.time()
+    rounds = 0
+    for spec in sampler:
+        rounds += 1
+        if rounds >= 200:
+            break
+    sample_s = time.time() - t0
+
+    return {
+        "persona_clients": num_clients,
+        "persona_items": n_items,
+        "persona_archive_gen_s": round(gen_s, 2),
+        "persona_prepare_s": round(prep_s, 2),
+        "persona_reload_s": round(load_s, 3),
+        "persona_item_access_per_s": round(n_access / access_s),
+        "persona_sampler_rounds_per_s": round(200 / sample_s),
+    }
+
+
+def bench_emnist(root, writers, images_per_writer):
+    from commefficient_tpu.data.fed_emnist import FedEMNIST
+
+    d = os.path.join(root, "emnist")
+    for sub in ("train", "test"):
+        os.makedirs(os.path.join(d, sub), exist_ok=True)
+    rng = random.Random(0)
+
+    t0 = time.time()
+    # LEAF-format shards: ~100 writers per json file like LEAF emits
+    per_shard = 100
+    for shard in range(0, writers, per_shard):
+        user_data = {}
+        for w in range(shard, min(shard + per_shard, writers)):
+            n = images_per_writer
+            user_data[f"w{w}"] = {
+                "x": [[rng.random() for _ in range(784)]
+                      for _ in range(n)],
+                "y": [rng.randrange(62) for _ in range(n)],
+            }
+        blob = {"users": list(user_data), "user_data": user_data}
+        with open(os.path.join(d, "train",
+                               f"all_data_{shard}.json"), "w") as f:
+            json.dump(blob, f)
+    # small test split
+    user_data = {f"t{w}": {"x": [[0.0] * 784 for _ in range(4)],
+                           "y": [rng.randrange(62) for _ in range(4)]}
+                 for w in range(20)}
+    with open(os.path.join(d, "test", "all_data_0.json"), "w") as f:
+        json.dump({"users": list(user_data),
+                   "user_data": user_data}, f)
+    gen_s = time.time() - t0
+
+    t0 = time.time()
+    ds = FedEMNIST(d, "EMNIST", train=True)
+    prep_s = time.time() - t0
+
+    n_items = len(ds)
+    n_access = min(20000, n_items)
+    idxs = random.Random(1).sample(range(n_items), n_access)
+    t0 = time.time()
+    for i in idxs:
+        ds[i]
+    access_s = time.time() - t0
+
+    return {
+        "emnist_writers": writers,
+        "emnist_images": n_items,
+        "emnist_leaf_gen_s": round(gen_s, 2),
+        "emnist_prepare_s": round(prep_s, 2),
+        "emnist_item_access_per_s": round(n_access / access_s),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--persona_clients", type=int, default=17568)
+    ap.add_argument("--emnist_writers", type=int, default=3500)
+    ap.add_argument("--emnist_images", type=int, default=20)
+    ap.add_argument("--workdir", type=str, default=None)
+    args = ap.parse_args()
+
+    root = args.workdir or tempfile.mkdtemp(prefix="host_scale_")
+    print(f"workdir: {root}", file=sys.stderr)
+    out = {}
+    try:
+        out.update(bench_persona(root, args.persona_clients))
+        out.update(bench_emnist(root, args.emnist_writers,
+                                args.emnist_images))
+    finally:
+        if args.workdir is None:
+            shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

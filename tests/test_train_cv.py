@@ -167,6 +167,148 @@ class TestFixupLrGroups:
         assert np.isfinite(results[-1]["train_loss"])
 
 
+class TestBatchNormRunningStats:
+    """--batchnorm parity mode: the server blends participating
+    clients' batch statistics into one running-stats state and eval
+    normalizes with it — so eval metrics are invariant to the eval
+    batch composition (reference models/resnet9.py BN eval via
+    nn.BatchNorm2d running stats)."""
+
+    def _setup(self):
+        import jax
+        import jax.numpy as jnp
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.models import get_model
+        from commefficient_tpu.runtime import FedModel, FedOptimizer
+        from commefficient_tpu.train.cv_train import (
+            make_bn_stats_fn, make_compute_loss,
+            make_compute_loss_eval)
+
+        cls = get_model("ResNet9")
+        module = cls(do_batchnorm=True, **cls.test_config())
+        variables = module.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 32, 32, 3)), train=True)
+        params, init_stats = variables["params"], \
+            variables["batch_stats"]
+        assert init_stats  # BN collection exists
+        args = Config(mode="uncompressed", error_type="none",
+                      local_momentum=0.0, virtual_momentum=0.9,
+                      num_workers=2, local_batch_size=4,
+                      num_clients=6, dataset_name="CIFAR10", seed=0)
+        model = FedModel(
+            module, params, make_compute_loss(module, init_stats),
+            args, compute_loss_val=make_compute_loss_eval(module),
+            stats_fn=make_bn_stats_fn(module, init_stats),
+            init_model_state=init_stats)
+        opt = FedOptimizer([{"lr": 0.05}], args)
+        return model, opt, init_stats
+
+    def _train_round(self, model, opt, seed=0):
+        rng = np.random.RandomState(seed)
+        batch = {
+            "x": rng.randn(2, 4, 32, 32, 3).astype(np.float32),
+            "y": rng.randint(0, 10, (2, 4)),
+            "mask": np.ones((2, 4), np.float32),
+            "client_ids": np.array([0, 1], np.int32),
+        }
+        model(batch)
+        opt.step()
+        return batch
+
+    def test_stats_update_and_blend(self):
+        import jax
+
+        model, opt, init_stats = self._setup()
+        before = jax.tree_util.tree_leaves(init_stats)
+        self._train_round(model, opt)
+        after = jax.tree_util.tree_leaves(model.model_state)
+        # running stats moved off init by the 0.1 blend
+        changed = [not np.allclose(np.asarray(a), np.asarray(b))
+                   for a, b in zip(before, after)]
+        assert any(changed)
+        # vars stay positive (0.9*1 + 0.1*batch_var)
+        for path_leaf in jax.tree_util.tree_leaves(model.model_state):
+            assert np.all(np.isfinite(np.asarray(path_leaf)))
+
+    def test_eval_invariant_to_batch_composition(self):
+        model, opt, _ = self._setup()
+        self._train_round(model, opt)
+        model.train(False)
+
+        rng = np.random.RandomState(1)
+        S, B = 2, 4
+        x = rng.randn(S * B, 32, 32, 3).astype(np.float32)
+        y = rng.randint(0, 10, S * B)
+
+        def run_val(order, s, b):
+            xo, yo = x[order], y[order]
+            batch = {
+                "x": xo.reshape(s, b, 32, 32, 3),
+                "y": yo.reshape(s, b),
+                "mask": np.ones((s, b), np.float32),
+            }
+            loss_s, acc_s, counts = model(batch)
+            # weighted mean over shards = sample mean (mask all-real)
+            w = counts / counts.sum()
+            return (np.sum(loss_s * w), np.sum(acc_s * w))
+
+        base = run_val(np.arange(S * B), S, B)
+        perm = rng.permutation(S * B)
+        shuffled = run_val(perm, S, B)
+        resized = run_val(np.arange(S * B), 4, 2)  # different shards
+        np.testing.assert_allclose(base, shuffled, rtol=1e-5)
+        np.testing.assert_allclose(base, resized, rtol=1e-5)
+
+    def test_masked_stats_ignore_padded_rows(self):
+        """Recorded batch statistics over a padded batch equal the
+        statistics of the unpadded batch: padded zero rows must not
+        dilute the mean or skew the variance."""
+        import jax
+        import jax.numpy as jnp
+
+        from commefficient_tpu.models.norms import BatchStatNorm
+
+        norm = BatchStatNorm(track_stats=True)
+        rng = np.random.RandomState(0)
+        real = rng.randn(3, 4, 4, 2).astype(np.float32) + 1.5
+        padded = np.concatenate(
+            [real, np.zeros((5, 4, 4, 2), np.float32)])
+        mask = np.array([1, 1, 1, 0, 0, 0, 0, 0], np.float32)
+
+        v = norm.init(jax.random.PRNGKey(0), jnp.asarray(real))
+        _, upd_real = norm.apply(v, jnp.asarray(real),
+                                 jnp.ones(3, jnp.float32),
+                                 mutable=["batch_stats"])
+        _, upd_pad = norm.apply(v, jnp.asarray(padded),
+                                jnp.asarray(mask),
+                                mutable=["batch_stats"])
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(upd_pad["batch_stats"][k]),
+                np.asarray(upd_real["batch_stats"][k]), rtol=1e-5)
+
+    def test_checkpoint_roundtrip_carries_stats(self, tmp_path):
+        import jax
+
+        from commefficient_tpu.runtime.checkpoint import (
+            load_checkpoint, save_checkpoint)
+
+        model, opt, _ = self._setup()
+        self._train_round(model, opt)
+        want = [np.asarray(leaf) for leaf in
+                jax.tree_util.tree_leaves(model.model_state)]
+        path = str(tmp_path / "c.npz")
+        save_checkpoint(path, model, opt)
+
+        model2, opt2, _ = self._setup()
+        load_checkpoint(path, model2, opt2)
+        got = [np.asarray(leaf) for leaf in
+               jax.tree_util.tree_leaves(model2.model_state)]
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+
+
 class TestFinetune:
     def test_merge_replaces_only_mismatched_head(self):
         import jax
