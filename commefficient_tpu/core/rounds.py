@@ -119,15 +119,16 @@ def build_client_round(cfg: Config, loss_fn: Callable,
     # (+ the analytic weight-decay term). One backward pass then
     # accumulates straight into a single (d,) vector — the (W, d)
     # per-client gradient buffer, its dynamic-update-slices and the
-    # cross-client reduction disappear from the program. Single-device
-    # only: on a mesh the per-device sum + psum-of-sketch-tables path
-    # below keeps inter-chip traffic compressed.
+    # cross-client reduction disappear from the program. On a mesh
+    # (clients divisible across devices) each device runs the fused
+    # backward over its local clients and ONE psum crosses the ICI —
+    # of (r, c) sketch tables in sketch mode (compressed traffic, the
+    # FetchSGD linearity identity), of the dense gradient otherwise.
     fused_grad = (
         cfg.mode in ("sketch", "uncompressed", "true_topk")
         and cfg.local_momentum == 0 and cfg.error_type != "local"
         and not cfg.do_topk_down and not cfg.do_dp
-        and cfg.max_grad_norm is None and cfg.microbatch_size <= 0
-        and (mesh is None or mesh.devices.size == 1))
+        and cfg.max_grad_norm is None and cfg.microbatch_size <= 0)
     if cfg.mode == "fedavg":
         per_client = _build_fedavg_client_step(cfg, loss_fn,
                                                padded_batch_size)
@@ -141,13 +142,14 @@ def build_client_round(cfg: Config, loss_fn: Callable,
                                             None if sketch_late else sketch,
                                             padded_batch_size)
 
-    def client_round_fused(ps_weights, client_states: ClientStates,
-                           batch, client_ids, rng,
-                           fedavg_lr=1.0) -> RoundResult:
-        del rng, fedavg_lr
-        total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+    def _fused_local(ps_weights, batch, total, n_shards):
+        """Fused backward over the clients in ``batch`` (all of them
+        single-device; one device's shard under shard_map), already
+        normalised by the GLOBAL datapoint total. The weight-decay
+        term is split evenly across shards so the cross-shard sum
+        reconstructs (wd/num_workers)·p exactly once."""
 
-        def global_loss(p):
+        def local_loss(p):
             def one(b):
                 loss, metrics = loss_fn(p, b)
                 n = jnp.sum(b["mask"])
@@ -163,11 +165,52 @@ def build_client_round(cfg: Config, loss_fn: Callable,
             return jnp.sum(weighted) / total, metrics
 
         (_, metrics), g = jax.value_and_grad(
-            global_loss, has_aux=True)(ps_weights)
+            local_loss, has_aux=True)(ps_weights)
         if cfg.weight_decay != 0:
             # Σ_i (wd/num_workers)·p·n_i / total = (wd/num_workers)·p
-            g = g + (cfg.weight_decay / cfg.num_workers) * ps_weights
-        aggregated = sketch.sketch(g) if cfg.mode == "sketch" else g
+            g = g + (cfg.weight_decay / cfg.num_workers
+                     / n_shards) * ps_weights
+        return (sketch.sketch(g) if cfg.mode == "sketch" else g), \
+            metrics
+
+    def client_round_fused(ps_weights, client_states: ClientStates,
+                           batch, client_ids, rng,
+                           fedavg_lr=1.0) -> RoundResult:
+        del rng, fedavg_lr
+        W = client_ids.shape[0]
+        total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        ndev = mesh.devices.size if mesh is not None else 1
+        if ndev > 1 and W % ndev == 0:
+            from jax.sharding import PartitionSpec as P
+
+            from commefficient_tpu.parallel.mesh import (CLIENT_AXIS,
+                                                         shard_map)
+
+            def block(p, local_batch, tot):
+                # mark the replicated params as device-varying before
+                # differentiating: otherwise shard_map's transpose
+                # rule auto-psums the DENSE per-device gradient to
+                # keep the cotangent replicated — a d-sized
+                # all-reduce that defeats the compressed-table
+                # traffic (and would double-count with ours)
+                if hasattr(jax.lax, "pcast"):
+                    p = jax.lax.pcast(p, CLIENT_AXIS, to="varying")
+                else:
+                    p = jax.lax.pvary(p, CLIENT_AXIS)
+                t, metrics = _fused_local(p, local_batch, tot, ndev)
+                # the round's ONE all-reduce (reference
+                # fed_worker.py:139-140 NCCL reduce): sketch tables in
+                # sketch mode — inter-chip traffic stays compressed
+                return jax.lax.psum(t, CLIENT_AXIS), metrics
+
+            aggregated, metrics = shard_map(
+                block, mesh=mesh,
+                in_specs=(P(), P(CLIENT_AXIS), P()),
+                out_specs=(P(), P(CLIENT_AXIS)))(ps_weights, batch,
+                                                 total)
+        else:
+            aggregated, metrics = _fused_local(ps_weights, batch,
+                                               total, 1)
         return RoundResult(aggregated, metrics, client_states,
                            _round_bn_stats(stats_fn, ps_weights, batch))
 

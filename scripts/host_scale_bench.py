@@ -29,38 +29,22 @@ import time
 
 
 def bench_persona(root, num_clients):
-    from commefficient_tpu.data.fed_persona import FedPERSONA
+    from commefficient_tpu.data.fed_persona import (
+        FedPERSONA, generate_synthetic_personachat)
     from commefficient_tpu.data.fed_sampler import FedSampler
     from commefficient_tpu.data.tokenizer import (ByteTokenizer,
                                                   SPECIAL_TOKENS)
 
     d = os.path.join(root, "persona")
     os.makedirs(d, exist_ok=True)
-    rng = random.Random(0)
-    words = ["i", "like", "cats", "dogs", "music", "food", "sports",
-             "reading", "travel", "coding", "you", "me", "the", "a"]
-
-    def sentence():
-        return " ".join(rng.choice(words) for _ in range(5))
 
     t0 = time.time()
-    data = {"train": [], "valid": []}
-    for p in range(num_clients):
-        personality = [f"p{p} " + sentence() for _ in range(3)]
-        utterances = [{"history": [sentence()],
-                       "candidates": [sentence() for _ in range(20)]}
-                      for _ in range(3)]
-        data["train"].append({"personality": personality,
-                              "utterances": utterances})
-    for _ in range(64):
-        data["valid"].append({
-            "personality": [sentence() for _ in range(3)],
-            "utterances": [{"history": [sentence()],
-                            "candidates": [sentence()
-                                           for _ in range(20)]}]})
-    with open(os.path.join(d, "personachat_self_original.json"),
-              "w") as f:
-        json.dump(data, f)
+    # the tests' archive generator at natural client count, ~natural
+    # candidate count
+    generate_synthetic_personachat(d, num_personalities=num_clients,
+                                   dialogs_per_personality=1,
+                                   utterances_per_dialog=3,
+                                   num_candidates=20)
     gen_s = time.time() - t0
 
     tok = ByteTokenizer()

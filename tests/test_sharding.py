@@ -146,3 +146,78 @@ class TestShardingInvariance:
         v8, e8 = run(True)
         np.testing.assert_allclose(v1, v8, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(e1, e8, rtol=1e-5, atol=1e-6)
+
+
+class TestFusedMeshPath:
+    """Round-1 review item: the fused-gradient fast path must engage
+    on multi-device meshes — per-device fused backward over local
+    clients + ONE psum (of sketch tables in sketch mode), equal to the
+    per-client path."""
+
+    def _compare(self, mode, **kw):
+        cfg = _setup(mode, **kw)
+        batch, ids = _batch(seed=11)
+        B = batch["x"].shape[1]
+        mesh = make_mesh()
+        fused = jax.jit(build_client_round(cfg, linear_loss, B,
+                                           mesh=mesh))
+        # microbatch_size=B is a semantic no-op (1 microbatch) that
+        # disqualifies the fused path -> per-client reference
+        pc_cfg = dataclasses.replace(cfg, microbatch_size=B)
+        per_client = jax.jit(build_client_round(pc_cfg, linear_loss,
+                                                B))
+        ps = jnp.zeros(cfg.grad_size, jnp.float32).at[0].set(0.5)
+        cs = ClientStates.init(cfg, 16, ps)
+        sh = client_sharding(mesh)
+        sharded = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), batch)
+        r_f = fused(ps, cs, sharded, ids, jax.random.PRNGKey(0), 1.0)
+        r_p = per_client(ps, cs, batch, ids, jax.random.PRNGKey(0),
+                         1.0)
+        np.testing.assert_allclose(np.asarray(r_f.aggregated),
+                                   np.asarray(r_p.aggregated),
+                                   rtol=1e-4, atol=1e-6)
+        for mf, mp in zip(r_f.metrics, r_p.metrics):
+            np.testing.assert_allclose(np.asarray(mf), np.asarray(mp),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_uncompressed_fused_mesh_equals_per_client(self, devices):
+        self._compare("uncompressed", error_type="none",
+                      weight_decay=5e-4)
+
+    def test_sketch_fused_mesh_equals_per_client(self, devices):
+        self._compare("sketch", weight_decay=5e-4)
+
+    def test_true_topk_fused_mesh_equals_per_client(self, devices):
+        self._compare("true_topk")
+
+    def test_one_tensor_allreduce_in_compiled_round(self, devices):
+        """The compiled fused-mesh round crosses the ICI with exactly
+        one tensor all-reduce — of the (r, c) sketch table, not a
+        (W, d) gradient buffer (reference one-NCCL-reduce-per-round,
+        fed_worker.py:139-140). A second scalar all-reduce (the global
+        datapoint total) is allowed."""
+        cfg = _setup("sketch")
+        batch, ids = _batch(seed=12)
+        mesh = make_mesh()
+        fused = build_client_round(cfg, linear_loss,
+                                   batch["x"].shape[1], mesh=mesh)
+        ps = jnp.zeros(cfg.grad_size, jnp.float32)
+        cs = ClientStates.init(cfg, 16, ps)
+        sh = client_sharding(mesh)
+        sharded = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), batch)
+        txt = jax.jit(fused).lower(
+            ps, cs, sharded, ids, jax.random.PRNGKey(0),
+            jnp.float32(1.0)).compile().as_text()
+        import re
+        ars = [l for l in txt.splitlines()
+               if re.search(r"all-reduce(-start)?\(", l)]
+        table_elems = cfg.num_rows * cfg.num_cols
+        big = [l for l in ars if f"f32[{cfg.num_rows},{cfg.num_cols}]"
+               in l or f"f32[{table_elems}]" in l]
+        assert len(big) == 1, f"want 1 table all-reduce, got:\n" + \
+            "\n".join(ars)
+        # nothing W*d-sized crosses the interconnect
+        assert not any(f"f32[{8 * cfg.grad_size}]" in l or
+                       f"f32[8,{cfg.grad_size}]" in l for l in ars)
