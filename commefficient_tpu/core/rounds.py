@@ -444,7 +444,9 @@ def build_server_round(cfg: Config) -> Callable:
     weight_update, support)``. ``support`` is ((k,) indices, (k,)
     values) of the update for k-sparse modes, None for dense modes —
     it lets the host-side download accounting avoid ever transferring
-    the dense update.
+    the dense update. ``weight_update`` is None on the large-d sparse
+    sketch path (prefer_sparse_resketch): the update was applied as a
+    k-sized scatter and only ``support`` carries its values.
 
     Covers FedOptimizer.step (fed_aggregator.py:431-460) including
     true_topk's masking of participating clients' local velocities at
@@ -461,7 +463,16 @@ def build_server_round(cfg: Config) -> Callable:
         eff_lr = 1.0 if cfg.mode == "fedavg" else lr
         res: ServerUpdate = server_update(cfg, aggregated, server_state,
                                           eff_lr, sketch, noise_rng)
-        new_ps = ps_weights - res.weight_update
+        if res.weight_update is None:
+            # large-d k-sparse modes: the support already carries the
+            # lr-scaled update values — apply them as a k-sized
+            # scatter instead of materialising + subtracting a dense
+            # (d,) vector (~6 ms saved per round at GPT-2's d=124M)
+            idx, scaled = res.support
+            new_ps = ps_weights.at[idx].add(
+                -scaled, mode="promise_in_bounds")
+        else:
+            new_ps = ps_weights - res.weight_update
         new_vel = client_velocities
         if (cfg.mode == "true_topk" and cfg.local_momentum > 0
                 and client_velocities is not None):

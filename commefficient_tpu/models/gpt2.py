@@ -156,11 +156,19 @@ class GPT2Transformer(nn.Module):
 
 @register_model("GPT2DoubleHeads")
 class GPT2DoubleHeads(nn.Module):
-    """LM logits + per-candidate MC logits."""
+    """LM logits + per-candidate MC logits.
+
+    ``return_hidden=True`` skips the LM head matmul and returns the
+    final hidden states + tied embedding instead of lm_logits — the
+    training loss then computes the LM cross-entropy in token chunks
+    (``lm_nll_sums_chunked``) so the (tokens, vocab) logits tensor is
+    never materialised (f32 it is ~6.6 GB at 65k tokens; its
+    store/reload chain dominated the large-batch profile)."""
     cfg: GPT2Config = GPT2Config()
 
     @nn.compact
-    def __call__(self, input_ids, mc_token_ids, token_type_ids=None):
+    def __call__(self, input_ids, mc_token_ids, token_type_ids=None,
+                 return_hidden=False):
         # flatten candidates into the batch axis
         B, N, T = input_ids.shape
         flat_ids = input_ids.reshape(B * N, T)
@@ -168,11 +176,14 @@ class GPT2DoubleHeads(nn.Module):
                    if token_type_ids is not None else None)
         h, wte = GPT2Transformer(self.cfg, name="transformer")(
             flat_ids, flat_tt)
-        # tied weights; logits accumulate in float32
-        lm_logits = jnp.einsum("btc,vc->btv", h.astype(self.cfg.dtype),
-                               wte.astype(self.cfg.dtype),
-                               preferred_element_type=jnp.float32)
-        lm_logits = lm_logits.reshape(B, N, T, -1)
+        flat_h = h
+        if not return_hidden:
+            # tied weights; logits accumulate in float32
+            lm_logits = jnp.einsum("btc,vc->btv",
+                                   h.astype(self.cfg.dtype),
+                                   wte.astype(self.cfg.dtype),
+                                   preferred_element_type=jnp.float32)
+            lm_logits = lm_logits.reshape(B, N, T, -1)
 
         h = h.reshape(B, N, T, -1)
         if self.cfg.seq_axis is not None:
@@ -191,6 +202,8 @@ class GPT2DoubleHeads(nn.Module):
                 h, idx[..., None, None], axis=2)[:, :, 0]  # (B, N, C)
         mc_logits = nn.Dense(1, kernel_init=_dense_init(self.cfg),
                              name="mc_head")(cls_h)[..., 0]  # (B, N)
+        if return_hidden:
+            return flat_h, wte, mc_logits
         return lm_logits, mc_logits
 
 
@@ -206,6 +219,54 @@ def token_nll(logits, labels, ignore_index=-100):
     tok = jnp.take_along_axis(logits, safe[..., None],
                               axis=-1)[..., 0].astype(jnp.float32)
     return lse - tok, valid.astype(jnp.float32)
+
+
+def lm_nll_sums_chunked(h, wte, labels, dtype, ignore_index=-100,
+                        tokens_per_chunk=1024):
+    """Per-example (Σ nll, Σ valid) of the tied-head LM cross-entropy
+    without materialising the (E, T, V) logits tensor.
+
+    ``h`` (E, Tm, C) are the final hidden states at the *predicting*
+    positions (callers pass ``h[:, :-1]``), ``labels`` (E, Tm) the
+    shifted targets. A ``lax.scan`` over token chunks computes each
+    chunk's logits, logsumexp and label gather in one compiler-fused
+    region; ``jax.checkpoint`` makes the backward recompute the chunk
+    logits instead of storing them, so peak logits memory is one chunk
+    (~200 MB f32 at 1024 tokens x 50k vocab) and the fwd+bwd HBM
+    traffic of the vocab head drops by the full-logits store/reload
+    chain. Same math as ``token_nll`` of the full logits (fp summation
+    order aside)."""
+    E, Tm, C = h.shape
+    tc = max(1, min(Tm, tokens_per_chunk // max(E, 1)))
+    num_chunks = -(-Tm // tc)
+    pad = num_chunks * tc - Tm
+    # cast ONCE before chunking (the transformer's final hidden may be
+    # f32 out of the last LayerNorm) and slice inside the scan rather
+    # than pre-transposing to a (chunks, E, tc, C) copy — the copy
+    # measured ~15 ms at 65k tokens
+    hp = jnp.pad(h.astype(dtype), ((0, 0), (0, pad), (0, 0)))
+    lp = jnp.pad(labels, ((0, 0), (0, pad)),
+                 constant_values=ignore_index)
+    wte_c = wte.astype(dtype)  # cast once, outside the scan
+
+    @jax.checkpoint
+    def chunk_sums(hc, lc, w):
+        logits = jnp.einsum("etc,vc->etv", hc, w,
+                            preferred_element_type=jnp.float32)
+        nll, valid = token_nll(logits, lc, ignore_index)
+        return jnp.sum(nll * valid, -1), jnp.sum(valid, -1)
+
+    def body(carry, i):
+        sn, sv = carry
+        hc = jax.lax.dynamic_slice_in_dim(hp, i * tc, tc, axis=1)
+        lc = jax.lax.dynamic_slice_in_dim(lp, i * tc, tc, axis=1)
+        n, v = chunk_sums(hc, lc, wte_c)
+        return (sn + n, sv + v), None
+
+    init = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
+    (sn, sv), _ = jax.lax.scan(body, init,
+                               jnp.arange(num_chunks, dtype=jnp.int32))
+    return sn, sv
 
 
 def gpt2_double_heads_loss(lm_logits, mc_logits, lm_labels, mc_labels,

@@ -51,6 +51,19 @@ from commefficient_tpu.parallel.mesh import client_sharding, shard_batch
 _CURRENT_MODEL: Optional["FedModel"] = None
 
 
+def _host(arr) -> np.ndarray:
+    """Materialise a device array on the host, multi-process safe:
+    arrays sharded across processes (per-client metrics on a
+    multi-host mesh) are allgathered first — every process returns the
+    same global value, preserving the replicated-server invariant."""
+    if (getattr(arr, "is_fully_addressable", True)
+            or getattr(arr, "is_fully_replicated", False)):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr,
+                                                        tiled=True))
+
+
 class FedModel:
     """One federated model + its client-side runtime.
 
@@ -255,7 +268,7 @@ class FedModel:
                                 np.asarray(batch["mask"])))
             self._inflight.append(list(res.metrics))
             return None
-        metrics = [np.asarray(m) for m in res.metrics]
+        metrics = [_host(m) for m in res.metrics]
         return metrics + list(self._account_bytes(ids_np,
                                                   batch["mask"]))
 
@@ -269,7 +282,7 @@ class FedModel:
             return []
         if not force and len(self._inflight) < self.pipeline_depth:
             return []
-        rounds = iter([[np.asarray(m) for m in ms]
+        rounds = iter([[_host(m) for m in ms]
                        for ms in self._inflight])
         self._inflight = []
         oplog, self._oplog = self._oplog, []
@@ -317,10 +330,10 @@ class FedModel:
         dev_batch = shard_batch(self.mesh, jax.tree_util.tree_map(
             jnp.asarray, batch))
         if self.stats_fn is not None:
-            out = np.asarray(self._val_fn(self.ps_weights,
-                                          self.model_state, dev_batch))
+            out = _host(self._val_fn(self.ps_weights,
+                                       self.model_state, dev_batch))
         else:
-            out = np.asarray(self._val_fn(self.ps_weights, dev_batch))
+            out = _host(self._val_fn(self.ps_weights, dev_batch))
         # (S, n_metrics) -> per-shard metric arrays, like the
         # reference's split_results (fed_aggregator.py:617-618), plus
         # per-shard real-sample counts so callers can weight out the

@@ -55,23 +55,34 @@ def make_compute_loss_train(module, args):
     loss); run with --num_results_train 1. Batched formulation of
     gpt2_double_heads_loss applied per example: identical math to a
     per-example vmap (which XLA lowers to a serial scan over examples
-    with a materialised f32 logits buffer — measured 10x the cost)."""
+    with a materialised f32 logits buffer — measured 10x the cost).
+    The LM term is computed by the chunked tied-head cross-entropy
+    (models/gpt2.py lm_nll_sums_chunked): the (tokens, vocab) logits
+    tensor never materialises — its f32 store/reload chain dominated
+    the large-batch training profile."""
+    from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
 
     def compute_loss(params, batch, cfg):
-        lm_logits, mc_logits = _apply(module, params, batch)
-        m = batch["mask"]
+        ids = batch["input_ids"]
+        B, N, T = ids.shape
+        h, wte, mc_logits = module.apply(
+            {"params": params}, ids, batch["mc_token_ids"],
+            batch["token_type_ids"], return_hidden=True)
 
         # shift: predict token t+1 from position t (per example i:
         # token-mean over its valid positions)
-        nll, vf = _token_nll(lm_logits[..., :-1, :],
-                             batch["lm_labels"][..., 1:])
-        lm_i = jnp.sum(nll * vf, axis=(1, 2)) \
-            / jnp.maximum(jnp.sum(vf, axis=(1, 2)), 1.0)
+        labels = batch["lm_labels"].reshape(B * N, T)
+        sn, sv = lm_nll_sums_chunked(h[:, :-1], wte, labels[:, 1:],
+                                     module.cfg.dtype,
+                                     ignore_index=-1)
+        lm_i = sn.reshape(B, N).sum(1) \
+            / jnp.maximum(sv.reshape(B, N).sum(1), 1.0)
 
         mc_nll, _ = _token_nll(mc_logits[..., None, :],
                                batch["mc_labels"][..., None])
         mc_i = mc_nll[..., 0]
 
+        m = batch["mask"]
         losses = cfg.lm_coef * lm_i + cfg.mc_coef * mc_i
         loss = jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
         return loss, ()

@@ -1,0 +1,136 @@
+"""Two-process multi-controller smoke: one federated round through the
+full trainer (round-1 review: ``initialize_multihost`` must be
+exercised by a real multi-process run, not just exist).
+
+The launcher spawns two worker processes on localhost CPU (the moral
+equivalent of the reference's single-host NCCL topology,
+fed_aggregator.py:161-165; SURVEY.md §4 "multi-node without a
+cluster"). Each worker joins the JAX multi-controller runtime via
+``initialize_multihost``, sees a mesh spanning both processes'
+devices, and runs a short synthetic `cv_train` — every process
+executes the same SPMD program, and process 0's metrics are checked
+finite and identical to process 1's.
+
+Usage:
+  python scripts/multihost_smoke.py            # launcher
+  (workers are spawned internally with --process_id)
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+DEVICES_PER_PROC = 2
+
+
+def worker(args):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from commefficient_tpu.parallel.mesh import initialize_multihost
+
+    pid = initialize_multihost(args.coordinator, args.num_processes,
+                               args.process_id)
+    assert pid == args.process_id
+    total = DEVICES_PER_PROC * args.num_processes
+    assert jax.device_count() == total, \
+        f"{jax.device_count()} != {total}"
+    assert jax.local_device_count() == DEVICES_PER_PROC
+
+    from commefficient_tpu.train import cv_train
+    results = cv_train.main([
+        "--test", "--dataset_name", "Synthetic",
+        "--mode", "sketch", "--error_type", "virtual",
+        "--local_momentum", "0",
+        "--num_clients", "10", "--num_workers", str(total),
+        "--local_batch_size", "4", "--num_epochs", "2",
+        "--lr_scale", "0.1", "--pivot_epoch", "1",
+    ])
+    import numpy as np
+    assert np.isfinite(results[-1]["train_loss"])
+    assert np.isfinite(results[-1]["test_acc"])
+    # SPMD determinism: every process computed identical metrics
+    print(f"WORKER{args.process_id}_RESULT "
+          f"{results[-1]['train_loss']:.9f}", flush=True)
+
+
+def launcher():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = []
+    logs = []
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    for i in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count="
+                      f"{DEVICES_PER_PROC}",
+            PYTHONPATH=repo_root + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        )
+        # temp files, not PIPEs: an undrained pipe buffer would
+        # deadlock a chatty worker against the poll loop below
+        log = tempfile.TemporaryFile(mode="w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--process_id", str(i), "--num_processes", "2",
+             "--coordinator", f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT))
+    # one shared deadline; if any worker dies or stalls, kill the
+    # peers too (a dead coordinator would otherwise hang its partner
+    # in jax.distributed.initialize, orphaned past the test timeout)
+    import time
+    deadline = time.time() + 600
+    pending = set(range(2))
+    failed = False
+    while pending and time.time() < deadline:
+        for i in list(pending):
+            rc = procs[i].poll()
+            if rc is not None:
+                pending.discard(i)
+                failed = failed or rc != 0
+        if failed:
+            break
+        time.sleep(0.5)
+    if pending:
+        for i in pending:
+            procs[i].kill()
+    outs = []
+    for p, log in zip(procs, logs):
+        p.wait(timeout=60)
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    codes = [p.returncode for p in procs]
+    results = []
+    for i, out in enumerate(outs):
+        for line in out.splitlines():
+            if line.startswith(f"WORKER{i}_RESULT"):
+                results.append(line.split()[1])
+    if codes != [0, 0] or len(results) != 2:
+        for i, out in enumerate(outs):
+            sys.stderr.write(f"--- worker {i} (exit {codes[i]}) ---\n")
+            sys.stderr.write(out[-4000:] + "\n")
+        sys.exit(1)
+    assert results[0] == results[1], \
+        f"processes disagree: {results}"
+    print(f"MULTIHOST_OK loss={results[0]}")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process_id", type=int, default=None)
+    ap.add_argument("--num_processes", type=int, default=2)
+    ap.add_argument("--coordinator", type=str, default=None)
+    args = ap.parse_args()
+    if args.process_id is None:
+        launcher()
+    else:
+        worker(args)

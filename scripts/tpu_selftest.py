@@ -86,10 +86,11 @@ def bf16_round_trains():
     res = cr(flat, ClientStates.init(cfg, 100, flat), batch,
              jnp.arange(W, dtype=jnp.int32), jax.random.PRNGKey(0),
              1.0)
-    ps2, _, _, upd, _ = sr(flat, ServerState.init(cfg), res.aggregated,
-                        jnp.float32(0.1))
+    ps2, _, _, upd, sup = sr(flat, ServerState.init(cfg),
+                             res.aggregated, jnp.float32(0.1))
     assert bool(jnp.isfinite(ps2).all())
-    nnz = int((np.asarray(upd) != 0).sum())
+    nnz = int((np.asarray(upd) != 0).sum()) if upd is not None \
+        else int((np.asarray(sup[1]) != 0).sum())
     assert 0 < nnz <= cfg.k
     return f"update nnz {nnz}"
 

@@ -28,3 +28,31 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, devs
     return devs
+
+
+# --- fast/slow tiers -----------------------------------------------------
+# ``pytest -m fast`` is the <2-minute oracle tier: compression-op math,
+# server-mode oracles, sharding invariance, accounting, data-layer
+# units. The full (unmarked) suite adds the compile-heavy trainer
+# end-to-ends; ``-m "not slow"`` skips only the multi-process smokes.
+
+FAST_MODULES = {
+    "test_ops",
+    "test_accounting",
+    "test_sharding",
+    "test_data_breadth",
+}
+FAST_CLASSES = {
+    "TestHandDerived",        # reference unit_test.py oracle traces
+    "TestSparseServerUpdate",
+    "TestPersonaInputs",
+    "TestFixupLrGroups",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        cls = item.cls.__name__ if item.cls is not None else ""
+        if mod in FAST_MODULES or cls in FAST_CLASSES:
+            item.add_marker(pytest.mark.fast)

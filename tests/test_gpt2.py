@@ -495,6 +495,58 @@ class TestBatchedTrainLoss:
         want = float(np.sum(np.asarray(per) * m) / m.sum())
         np.testing.assert_allclose(float(got), want, rtol=2e-5)
 
+    def test_chunked_lm_loss_gradients_match_full_logits(self):
+        """Gradients through the chunked (scan + checkpoint) LM loss
+        must match gradients of the same loss computed from full
+        logits — the chunking is a memory schedule, not new math."""
+        import jax
+        import jax.numpy as jnp
+
+        from commefficient_tpu.models.gpt2 import (
+            GPT2Config, GPT2DoubleHeads, lm_nll_sums_chunked,
+            token_nll)
+
+        gcfg = GPT2Config.tiny()
+        module = GPT2DoubleHeads(gcfg)
+        rng = np.random.RandomState(1)
+        B, N, T = 2, 2, 14  # T-1=13, tc=2: pad=1 exercises padding
+        ids = jnp.asarray(rng.randint(0, gcfg.vocab_size, (B, N, T)),
+                          jnp.int32)
+        mc = jnp.asarray(rng.randint(0, T, (B, N)), jnp.int32)
+        labels = jnp.asarray(np.where(
+            rng.rand(B * N, T) < 0.3, -1,
+            rng.randint(0, gcfg.vocab_size, (B * N, T))), jnp.int32)
+        params = module.init(jax.random.PRNGKey(0), ids, mc,
+                             ids)["params"]
+
+        def loss_chunked(p):
+            h, wte, _ = module.apply({"params": p}, ids, mc, ids,
+                                     return_hidden=True)
+            sn, sv = lm_nll_sums_chunked(h[:, :-1], wte,
+                                         labels[:, 1:], gcfg.dtype,
+                                         ignore_index=-1,
+                                         tokens_per_chunk=8)
+            return jnp.sum(sn) / jnp.maximum(jnp.sum(sv), 1.0)
+
+        def loss_full(p):
+            h, wte, _ = module.apply({"params": p}, ids, mc, ids,
+                                     return_hidden=True)
+            logits = jnp.einsum("btc,vc->btv",
+                                h[:, :-1].astype(gcfg.dtype),
+                                wte.astype(gcfg.dtype),
+                                preferred_element_type=jnp.float32)
+            nll, valid = token_nll(logits, labels[:, 1:], -1)
+            return jnp.sum(nll * valid) \
+                / jnp.maximum(jnp.sum(valid), 1.0)
+
+        lc, gc = jax.value_and_grad(loss_chunked)(params)
+        lf, gf = jax.value_and_grad(loss_full)(params)
+        np.testing.assert_allclose(float(lc), float(lf), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(gc),
+                        jax.tree_util.tree_leaves(gf)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-6)
+
 
 class TestSavePretrained:
     def test_model_and_tokenizer_roundtrip(self, tmp_path):

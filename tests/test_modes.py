@@ -364,3 +364,86 @@ class TestTopkDown:
                 and np.count_nonzero(new_w[2]) == k)
         np.testing.assert_array_equal(new_w[1], np.zeros(d))
         np.testing.assert_array_equal(new_w[3], np.zeros(d))
+
+
+class TestSparseServerUpdate:
+    def test_sparse_resketch_path_equals_dense(self, monkeypatch):
+        """The large-d sparse server path (sparse re-sketch + k-sized
+        weight scatter) must produce the same new weights, server
+        state, and support as the dense path it replaces."""
+        import jax
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.core.rounds import build_server_round
+        from commefficient_tpu.core.server import ServerState
+        from commefficient_tpu.ops.sketch import CountSketch
+
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     num_workers=2, local_batch_size=2, num_clients=4,
+                     dataset_name="CIFAR10", seed=0, k=16,
+                     num_rows=3, num_cols=256, num_blocks=1,
+                     grad_size=4096)
+        rng = np.random.RandomState(0)
+        ps = jnp.asarray(rng.randn(cfg.grad_size).astype(np.float32))
+        table = jnp.asarray(
+            rng.randn(cfg.num_rows, cfg.num_cols).astype(np.float32))
+        ss = ServerState.init(cfg)
+
+        def run(force_sparse):
+            monkeypatch.setattr(
+                CountSketch, "prefer_sparse_resketch",
+                lambda self, k: force_sparse)
+            fn = build_server_round(cfg)
+            new_ps, new_ss, _, upd, support = fn(
+                ps, ss, table, jnp.float32(0.05))
+            return (np.asarray(new_ps),
+                    np.asarray(new_ss.Vvelocity),
+                    np.asarray(new_ss.Verror),
+                    upd, support)
+
+        ps_d, vv_d, ve_d, upd_d, sup_d = run(False)
+        ps_s, vv_s, ve_s, upd_s, sup_s = run(True)
+        assert upd_d is not None and upd_s is None
+        np.testing.assert_allclose(ps_s, ps_d, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(vv_s, vv_d, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(ve_s, ve_d, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(sup_s[0]),
+                                      np.asarray(sup_d[0]))
+        np.testing.assert_allclose(np.asarray(sup_s[1]),
+                                   np.asarray(sup_d[1]), rtol=1e-6)
+
+    def test_sparse_path_with_lr_vector(self, monkeypatch):
+        """Per-coordinate LR vectors must scale the sparse scatter the
+        same way they scale the dense update."""
+        import jax
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.core.rounds import build_server_round
+        from commefficient_tpu.core.server import ServerState
+        from commefficient_tpu.ops.sketch import CountSketch
+
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.0,
+                     num_workers=2, local_batch_size=2, num_clients=4,
+                     dataset_name="CIFAR10", seed=1, k=8,
+                     num_rows=3, num_cols=128, num_blocks=1,
+                     grad_size=1024)
+        rng = np.random.RandomState(1)
+        ps = jnp.asarray(rng.randn(cfg.grad_size).astype(np.float32))
+        table = jnp.asarray(
+            rng.randn(cfg.num_rows, cfg.num_cols).astype(np.float32))
+        lr_vec = jnp.asarray(
+            rng.rand(cfg.grad_size).astype(np.float32))
+        ss = ServerState.init(cfg)
+
+        def run(force_sparse):
+            monkeypatch.setattr(
+                CountSketch, "prefer_sparse_resketch",
+                lambda self, k: force_sparse)
+            fn = build_server_round(cfg)
+            new_ps, *_ = fn(ps, ss, table, lr_vec)
+            return np.asarray(new_ps)
+
+        np.testing.assert_allclose(run(True), run(False),
+                                   rtol=1e-5, atol=1e-6)

@@ -1,0 +1,28 @@
+"""Two-process multi-controller smoke (see scripts/multihost_smoke.py).
+
+Exercises ``initialize_multihost`` for real: two localhost CPU
+processes join one JAX runtime, the mesh spans both, and a short
+synthetic ``cv_train`` runs one-round-per-epoch SPMD with the
+per-round psum crossing the process boundary — the moral equivalent of
+the reference's localhost NCCL topology (fed_aggregator.py:161-165).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_two_process_trainer_smoke():
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "multihost_smoke.py")
+    env = dict(os.environ)
+    # the launcher sets JAX_PLATFORMS/XLA_FLAGS for its workers; it
+    # needs no devices itself
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(script)], env=env,
+        capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "MULTIHOST_OK" in out.stdout

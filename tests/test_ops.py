@@ -150,6 +150,44 @@ class TestCountSketch:
         assert chi2 < cs.c + 5 * np.sqrt(2 * cs.c)
         assert abs(float(jnp.mean(signs))) < 0.05
 
+    def test_sketch_sparse_matches_dense(self, cs):
+        """sketch_sparse(idx, vals) must equal sketch of the dense
+        scatter — it replaces the server's O(d) re-sketch of the
+        k-sparse recovered update at large d."""
+        rng = np.random.RandomState(5)
+        idx = rng.choice(cs.d, 64, replace=False).astype(np.int32)
+        vals = rng.randn(64).astype(np.float32)
+        dense = np.zeros(cs.d, np.float32)
+        dense[idx] = vals
+        t_dense = np.asarray(cs.sketch(jnp.asarray(dense)))
+        t_sparse = np.asarray(cs.sketch_sparse(jnp.asarray(idx),
+                                               jnp.asarray(vals)))
+        np.testing.assert_allclose(t_dense, t_sparse, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_sketch_sparse_matches_dense_many_rows(self):
+        """r > 16 exercises the per-(row, coord) sign fallback in
+        hashes()."""
+        cs = CountSketch(d=1024, c=128, r=17)
+        rng = np.random.RandomState(6)
+        idx = rng.choice(cs.d, 32, replace=False).astype(np.int32)
+        vals = rng.randn(32).astype(np.float32)
+        dense = np.zeros(cs.d, np.float32)
+        dense[idx] = vals
+        np.testing.assert_allclose(
+            np.asarray(cs.sketch(jnp.asarray(dense))),
+            np.asarray(cs.sketch_sparse(jnp.asarray(idx),
+                                        jnp.asarray(vals))),
+            rtol=1e-5, atol=1e-6)
+
+    def test_prefer_sparse_resketch_heuristic(self):
+        # GPT-2 flagship geometry: sparse wins
+        assert CountSketch(d=124_000_000, c=524288, r=5) \
+            .prefer_sparse_resketch(50000)
+        # ResNet9 geometry: dense kernel wins
+        assert not CountSketch(d=6_600_000, c=524288, r=5) \
+            .prefer_sparse_resketch(50000)
+
 
 class TestKExceedingD:
     def test_topk_k_exceeding_d_is_total(self):
