@@ -39,10 +39,23 @@ from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
 MAX_SEQ_LEN = 256  # static pad length (persona sequences are short)
 
 
-def _apply(module, params, batch):
-    return module.apply({"params": params}, batch["input_ids"],
-                        batch["mc_token_ids"],
-                        batch["token_type_ids"])
+def _lm_nll_sums(module, params, batch):
+    """Shared forward for the train and val losses: hidden states +
+    MC logits from the module, then the chunked tied-head
+    cross-entropy (models/gpt2.py lm_nll_sums_chunked — the
+    (tokens, vocab) logits tensor never materialises). Returns
+    per-example ((B*N,) Σnll, (B*N,) Σvalid), mc_logits, B, N."""
+    from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
+
+    ids = batch["input_ids"]
+    B, N, T = ids.shape
+    h, wte, mc_logits = module.apply(
+        {"params": params}, ids, batch["mc_token_ids"],
+        batch["token_type_ids"], return_hidden=True)
+    labels = batch["lm_labels"].reshape(B * N, T)
+    sn, sv = lm_nll_sums_chunked(h[:, :-1], wte, labels[:, 1:],
+                                 module.cfg.dtype, ignore_index=-1)
+    return sn, sv, mc_logits, B, N
 
 
 def _token_nll(logits, labels, ignore_index=-1):
@@ -57,24 +70,14 @@ def make_compute_loss_train(module, args):
     per-example vmap (which XLA lowers to a serial scan over examples
     with a materialised f32 logits buffer — measured 10x the cost).
     The LM term is computed by the chunked tied-head cross-entropy
-    (models/gpt2.py lm_nll_sums_chunked): the (tokens, vocab) logits
-    tensor never materialises — its f32 store/reload chain dominated
-    the large-batch training profile."""
-    from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
+    (models/gpt2.py lm_nll_sums_chunked via _lm_nll_sums): the
+    (tokens, vocab) logits tensor never materialises — its f32
+    store/reload chain dominated the large-batch training profile."""
 
     def compute_loss(params, batch, cfg):
-        ids = batch["input_ids"]
-        B, N, T = ids.shape
-        h, wte, mc_logits = module.apply(
-            {"params": params}, ids, batch["mc_token_ids"],
-            batch["token_type_ids"], return_hidden=True)
-
-        # shift: predict token t+1 from position t (per example i:
-        # token-mean over its valid positions)
-        labels = batch["lm_labels"].reshape(B * N, T)
-        sn, sv = lm_nll_sums_chunked(h[:, :-1], wte, labels[:, 1:],
-                                     module.cfg.dtype,
-                                     ignore_index=-1)
+        # shift handled in _lm_nll_sums: position t predicts t+1;
+        # per example i: token-mean over its valid positions
+        sn, sv, mc_logits, B, N = _lm_nll_sums(module, params, batch)
         lm_i = sn.reshape(B, N).sum(1) \
             / jnp.maximum(sv.reshape(B, N).sum(1), 1.0)
 
@@ -91,16 +94,16 @@ def make_compute_loss_train(module, args):
 
 
 def make_compute_loss_val(module, args):
-    """(reference gpt2_train.py:55-86): token-mean NLL + MC accuracy."""
-
+    """(reference gpt2_train.py:55-86): token-mean NLL + MC accuracy.
+    The NLL uses the chunked tied-head cross-entropy: with
+    full-candidate validation (N ~ 20) a materialised f32
+    (B, N, T, V) logits tensor would be ~8 GB per val shard at the
+    natural PersonaChat candidate count."""
     def compute_loss(params, batch, cfg):
-        lm_logits, mc_logits = _apply(module, params, batch)
+        sn, sv, mc_logits, B, N = _lm_nll_sums(module, params, batch)
         m = batch["mask"]
-
-        tok_nll, valid = _token_nll(lm_logits[..., :-1, :],
-                                    batch["lm_labels"][..., 1:])
-        valid = valid * m[..., None, None]
-        nll = jnp.sum(tok_nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+        w = jnp.broadcast_to(m[:, None], (B, N)).reshape(B * N)
+        nll = jnp.sum(sn * w) / jnp.maximum(jnp.sum(sv * w), 1.0)
 
         # padded candidate slots (val items pad up to the loader's
         # static N) must never win the argmax

@@ -345,29 +345,36 @@ class TestFullCandidateValidation:
         from commefficient_tpu.train.gpt2_train import \
             make_compute_loss_val
 
+        from commefficient_tpu.models.gpt2 import GPT2Config
+
         class StubModule:
+            cfg = GPT2Config.tiny()
+
             def apply(self, variables, input_ids, mc_token_ids,
-                      token_type_ids):
-                lm = jnp.zeros(input_ids.shape + (16,))
-                mc = jnp.zeros(input_ids.shape[:-1], jnp.float32)
+                      token_type_ids, return_hidden=False):
+                assert return_hidden
+                B, N, T = input_ids.shape
+                h = jnp.zeros((B * N, T, 8), jnp.float32)
+                wte = jnp.zeros((16, 8), jnp.float32)
+                mc = jnp.zeros((B, N), jnp.float32)
                 mc = mc.at[..., -1].set(10.0)  # padded slot: max
                 mc = mc.at[..., 1].set(5.0)    # gold slot: runner-up
-                return lm, mc
+                return h, wte, mc
 
         args = Config(mode="uncompressed", error_type="none",
                       local_momentum=0.0, num_workers=1,
                       local_batch_size=2, num_clients=2,
                       dataset_name="PERSONA", seed=0)
         loss_fn = make_compute_loss_val(StubModule(), args)
-        S, B, N, T = 1, 2, 4, 8
+        B, N, T = 2, 4, 8
         batch = {
-            "input_ids": np.zeros((S, B, N, T), np.int32),
-            "token_type_ids": np.zeros((S, B, N, T), np.int32),
-            "lm_labels": np.full((S, B, N, T), -1, np.int32),
-            "mc_token_ids": np.zeros((S, B, N), np.int32),
-            "mc_labels": np.full((S, B), 1, np.int32),
-            "cand_mask": np.zeros((S, B, N), np.float32),
-            "mask": np.ones((S, B), np.float32),
+            "input_ids": np.zeros((B, N, T), np.int32),
+            "token_type_ids": np.zeros((B, N, T), np.int32),
+            "lm_labels": np.full((B, N, T), -1, np.int32),
+            "mc_token_ids": np.zeros((B, N), np.int32),
+            "mc_labels": np.full((B,), 1, np.int32),
+            "cand_mask": np.zeros((B, N), np.float32),
+            "mask": np.ones((B,), np.float32),
         }
         batch["cand_mask"][..., :2] = 1.0  # only slots 0,1 are real
         _, (acc,) = loss_fn(None, batch, None)
