@@ -99,8 +99,12 @@ def shard_batch(mesh: Mesh, tree):
     sharded. When W doesn't divide the mesh size (XLA requires
     divisibility) the batch is replicated instead — correct, just not
     load-balanced; pick num_workers divisible by the device count for
-    full throughput."""
+    full throughput. The fallback warns once per W so the perf cliff
+    is never silent (round-1 review, "mesh-shape perf cliffs")."""
     n = mesh.devices.size
+    leaves = jax.tree_util.tree_leaves(tree)
+    if leaves and leaves[0].shape[0] % n != 0:
+        _warn_unsharded(leaves[0].shape[0], n)
 
     def put(x):
         sh = (client_sharding(mesh) if x.shape[0] % n == 0
@@ -108,3 +112,19 @@ def shard_batch(mesh: Mesh, tree):
         return jax.device_put(x, sh)
 
     return jax.tree_util.tree_map(put, tree)
+
+
+_WARNED_UNSHARDED = set()
+
+
+def _warn_unsharded(w: int, n: int):
+    if n == 1 or (w, n) in _WARNED_UNSHARDED:
+        return
+    _WARNED_UNSHARDED.add((w, n))
+    import warnings
+    warnings.warn(
+        f"batch leading dim {w} does not divide the {n}-device mesh: "
+        f"replicating instead of sharding the client axis — every "
+        f"device computes all {w} clients. Pick --num_workers "
+        f"divisible by the device count for full throughput.",
+        RuntimeWarning, stacklevel=3)  # caller of shard_batch

@@ -221,3 +221,23 @@ class TestFusedMeshPath:
         # nothing W*d-sized crosses the interconnect
         assert not any(f"f32[{8 * cfg.grad_size}]" in l or
                        f"f32[8,{cfg.grad_size}]" in l for l in ars)
+
+
+def test_unsharded_fallback_warns(devices):
+    """W % n_devices != 0 must warn (the replication fallback is
+    correct but quietly unbalanced — round-1 review)."""
+    import warnings
+
+    from commefficient_tpu.parallel import mesh as mesh_mod
+    mesh = make_mesh()
+    mesh_mod._WARNED_UNSHARDED.clear()
+    batch = {"x": jnp.zeros((6, 2))}
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        mesh_mod.shard_batch(mesh, batch)
+    assert any("does not divide" in str(x.message) for x in w)
+    # divisible batches stay silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        mesh_mod.shard_batch(mesh, {"x": jnp.zeros((8, 2))})
+    assert not any("does not divide" in str(x.message) for x in w)
