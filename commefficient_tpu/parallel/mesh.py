@@ -102,14 +102,12 @@ def shard_batch(mesh: Mesh, tree):
     full throughput. The fallback warns once per W so the perf cliff
     is never silent (round-1 review, "mesh-shape perf cliffs")."""
     n = mesh.devices.size
-    leaves = jax.tree_util.tree_leaves(tree)
-    if leaves and leaves[0].shape[0] % n != 0:
-        _warn_unsharded(leaves[0].shape[0], n)
 
     def put(x):
-        sh = (client_sharding(mesh) if x.shape[0] % n == 0
-              else replicated(mesh))
-        return jax.device_put(x, sh)
+        if x.shape[0] % n == 0:
+            return jax.device_put(x, client_sharding(mesh))
+        _warn_unsharded(x.shape[0], n)  # once per (W, n)
+        return jax.device_put(x, replicated(mesh))
 
     return jax.tree_util.tree_map(put, tree)
 
@@ -127,4 +125,5 @@ def _warn_unsharded(w: int, n: int):
         f"replicating instead of sharding the client axis — every "
         f"device computes all {w} clients. Pick --num_workers "
         f"divisible by the device count for full throughput.",
-        RuntimeWarning, stacklevel=3)  # caller of shard_batch
+        RuntimeWarning, stacklevel=4)  # shard_batch's caller
+    # (stacklevel: warn <- _warn_unsharded <- put <- tree_map frames)

@@ -93,6 +93,23 @@ def test_dense_array_host_compare():
     assert got[1] == 4.0 * 3
 
 
+def test_bitmap_support_matches_dense_compare():
+    """The packed-bitmap support form (what local_topk ships instead
+    of the dense f32 update) must mark exactly the nonzero coords."""
+    m = make_model()
+    d = m.args.grad_size
+    upd = np.zeros(d, np.float32)
+    upd[[2, 5, 7, 31]] = 1.0
+    m.note_update({"bitmap": jnp.packbits(jnp.asarray(upd) != 0)})
+    got, _ = m._account_bytes(np.array([1]))
+    assert got[1] == 4.0 * 4
+
+    m2 = make_model()
+    m2.note_update(upd)
+    got2, _ = m2._account_bytes(np.array([1]))
+    assert got2[1] == got[1]
+
+
 def test_empty_support_changes_nothing():
     m = make_model()
     m.note_update((np.zeros(0, np.int64), np.zeros(0)))

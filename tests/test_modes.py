@@ -447,3 +447,50 @@ class TestSparseServerUpdate:
 
         np.testing.assert_allclose(run(True), run(False),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestFedavgInitialLr:
+    def test_round_before_first_step_transmits_nothing(self):
+        """The fedavg local-SGD LR must start at ZERO like the
+        reference's shared g_lr tensor (fed_aggregator.py:98-101):
+        clients read the value set by the previous round's
+        opt.step(), so a round dispatched before any step must
+        transmit zero weight deltas. (Initialising to 1.0 made round
+        0 take full-gradient local steps — instant divergence at
+        ResNet9 scale.)"""
+        import flax.linen as nn
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.runtime import FedModel
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(4, use_bias=False)(x)
+
+        module = Lin()
+        params = module.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 3)))["params"]
+        args = Config(mode="fedavg", error_type="none",
+                      local_momentum=0.0, virtual_momentum=0.0,
+                      num_workers=2, local_batch_size=-1,
+                      fedavg_batch_size=2, num_clients=4,
+                      dataset_name="CIFAR10", seed=0)
+
+        def loss(p, batch, cfg):
+            pred = module.apply({"params": p}, batch["x"])
+            per = jnp.sum((pred - batch["y"][..., None]) ** 2, -1)
+            n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+            return jnp.sum(per * batch["mask"]) / n, ()
+
+        model = FedModel(module, params, loss, args,
+                         padded_batch_size=4)
+        assert model.fedavg_lr == 0.0
+        rng = np.random.RandomState(0)
+        batch = {"x": rng.randn(2, 4, 3).astype(np.float32),
+                 "y": rng.randn(2, 4).astype(np.float32),
+                 "mask": np.ones((2, 4), np.float32),
+                 "client_ids": np.array([0, 1], np.int32)}
+        model(batch)
+        np.testing.assert_array_equal(
+            np.asarray(model.pending_aggregated), 0.0)
