@@ -153,7 +153,12 @@ class Config:
     mesh_shape: Optional[Sequence[int]] = None  # default: all local devices
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # set bfloat16 for MXU throughput
-    approx_topk: bool = False  # lax.approx_max_k in unsketch (faster)
+    # lax.approx_max_k (recall approx_recall) for every top-k
+    # selection: unsketch recovery AND the local_topk/true_topk/
+    # topk_down selections (exact top_k at k=50k over millions of
+    # coords lowers to a full sort on TPU). Missed coordinates stay
+    # in the error accumulators and resurface next round.
+    approx_topk: bool = False
     approx_recall: float = 0.95  # recall target for --approx_topk
     # rounds the host may run ahead of the device before materialising
     # metrics/accounting (1 = synchronous, reference-faithful timing)

@@ -71,7 +71,9 @@ def accumulate_and_compress(cfg: Config,
 
     if cfg.mode == "local_topk":
         assert cfg.error_type in ("local", "none")
-        to_transmit = topk(to_transmit, k=cfg.k)
+        to_transmit = topk(to_transmit, k=cfg.k,
+                           approx=cfg.approx_topk,
+                           recall=cfg.approx_recall)
         kept = to_transmit != 0
         if has_error:
             error = jnp.where(kept, 0.0, error)      # error feedback
@@ -97,5 +99,6 @@ def stale_weight_download(cfg: Config,
     difference to its stale local weights."""
     diff = ps_weights - client_weights
     if cfg.do_topk_down:
-        diff = topk(diff, k=cfg.k)
+        diff = topk(diff, k=cfg.k, approx=cfg.approx_topk,
+                    recall=cfg.approx_recall)
     return client_weights + diff

@@ -115,7 +115,9 @@ def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
     Verr = state.Verror + Vvel
 
     update, idx, vals = topk_with_support(Verr,
-                                          min(cfg.k, cfg.grad_size))
+                                          min(cfg.k, cfg.grad_size),
+                                          approx=cfg.approx_topk,
+                                          recall=cfg.approx_recall)
     keep = update == 0
     # error feedback + momentum factor masking at transmitted coords
     Verr = jnp.where(keep, Verr, 0.0)

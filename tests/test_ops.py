@@ -205,3 +205,40 @@ class TestKExceedingD:
         v = np.random.RandomState(0).randn(50).astype(np.float32)
         out = cs.unsketch(cs.sketch(v), k=100)  # k > d
         assert out.shape == (50,)
+
+
+class TestApproxTopk:
+    def test_approx_selects_heavy_hitters(self):
+        """approx topk keeps ~recall of the true top-k set; selected
+        values are preserved exactly and output stays k-sparse."""
+        rng = np.random.RandomState(0)
+        v = jnp.asarray(rng.randn(100_000).astype(np.float32))
+        out = np.asarray(topk(v, 1000, approx=True, recall=0.95))
+        nz = np.nonzero(out)[0]
+        assert len(nz) <= 1000
+        np.testing.assert_array_equal(out[nz], np.asarray(v)[nz])
+        true_set = set(np.argsort(np.abs(np.asarray(v)))[-1000:])
+        hit = len(true_set & set(nz.tolist())) / 1000
+        assert hit >= 0.90  # recall target 0.95 with slack
+
+    def test_approx_2d_rowwise(self):
+        rng = np.random.RandomState(1)
+        v = jnp.asarray(rng.randn(2, 50_000).astype(np.float32))
+        out = np.asarray(topk(v, 500, approx=True))
+        assert out.shape == v.shape
+        assert all(np.count_nonzero(out[i]) <= 500 for i in range(2))
+
+    def test_approx_with_support_consistent(self):
+        from commefficient_tpu.ops.topk import topk_with_support
+        rng = np.random.RandomState(2)
+        v = jnp.asarray(rng.randn(50_000).astype(np.float32))
+        dense, idx, vals = topk_with_support(v, 500, approx=True)
+        np.testing.assert_array_equal(
+            np.asarray(dense)[np.asarray(idx)], np.asarray(vals))
+        np.testing.assert_array_equal(
+            np.asarray(vals), np.asarray(v)[np.asarray(idx)])
+
+    def test_exact_default_unchanged(self):
+        v = jnp.array([1.0, -5.0, 3.0, 0.5, -2.0])
+        np.testing.assert_allclose(topk(v, 2),
+                                   [0.0, -5.0, 3.0, 0.0, 0.0])
