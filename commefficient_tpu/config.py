@@ -163,6 +163,29 @@ class Config:
     # rounds the host may run ahead of the device before materialising
     # metrics/accounting (1 = synchronous, reference-faithful timing)
     pipeline_depth: int = 1
+    # multi-host pod launch (jax.distributed): when set, the trainers
+    # call initialize_multihost(coordinator_address, num_processes,
+    # process_id) before building the mesh — one process per host,
+    # same command everywhere (the reference's NCCL init_process_group
+    # topology, fed_aggregator.py:161-165). On Cloud TPU pods leave
+    # all three unset: auto-detected from the environment.
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    # write the final GPT-2 model as pytorch_model.bin + HF config
+    # (loadable by transformers.from_pretrained) in addition to the
+    # flax msgpack — the reference's save_pretrained contract
+    # (fed_aggregator.py:209-212)
+    do_hf_export: bool = False
+    # Synthetic-dataset heterogeneity dial: classes held by each
+    # natural client (1 = the pathological one-class split; >1 =
+    # milder non-iid). Ignored by the on-disk datasets, whose splits
+    # come from the archives.
+    classes_per_client: int = 1
+    # Synthetic-dataset size dial: train items per class. 5000 with
+    # --num_clients 10000 reproduces the FetchSGD paper's CIFAR10
+    # federation shape (10 000 clients x 5 one-class images).
+    synthetic_per_class: int = 64
     # GPT-2: rematerialise transformer blocks in backward (activation
     # memory ~ 1/n_layer, ~1/3 extra FLOPs) — the long-context lever
     do_remat: bool = False
@@ -375,6 +398,14 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--approx_topk", action="store_true")
     parser.add_argument("--approx_recall", type=float, default=0.95)
     parser.add_argument("--pipeline_depth", type=int, default=1)
+    parser.add_argument("--classes_per_client", type=int, default=1)
+    parser.add_argument("--synthetic_per_class", type=int, default=64)
+    parser.add_argument("--hf_export", action="store_true",
+                        dest="do_hf_export")
+    parser.add_argument("--coordinator_address", type=str,
+                        default=None)
+    parser.add_argument("--num_processes", type=int, default=None)
+    parser.add_argument("--process_id", type=int, default=None)
     parser.add_argument("--remat", action="store_true",
                         dest="do_remat")
 

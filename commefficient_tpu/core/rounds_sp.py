@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from commefficient_tpu.models.gpt2 import (GPT2Config, GPT2DoubleHeads,
+                                           lm_nll_sums_chunked,
                                            token_nll)
 from commefficient_tpu.parallel.mesh import CLIENT_AXIS, shard_map
 
@@ -64,9 +65,12 @@ def shift_lm_labels(lm_labels, ignore_index: int = -1):
 def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
                         unravel: Callable, lm_coef: float = 1.0,
                         mc_coef: float = 1.0,
-                        ignore_index: int = -1):
+                        ignore_index: int = -1,
+                        tokens_per_chunk: int = 1024):
     """Returns jit-able ``round(flat_params, batch) -> (agg_grad,
-    mean_loss)``.
+    per_client_losses)`` — losses are per participating client (W,),
+    zero for clients with no real examples, so the trainer reports
+    per-client metrics exactly like the 1-D engine.
 
     ``batch`` (host layout, W = participating clients):
       input_ids / token_type_ids (W, B, N, T) int32,
@@ -85,14 +89,27 @@ def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
         """Local-shard loss contributions for ONE client:
         (lm_nll_sum_local, lm_valid_count_local, mc_nll_mean) —
         the seq-psum happens outside so grad sees pure locals.
-        ``ex_mask`` (B,) zeroes padded examples out of both terms."""
+        ``ex_mask`` (B,) zeroes padded examples out of both terms.
+
+        The LM term uses the chunked tied-head cross-entropy
+        (models/gpt2.py lm_nll_sums_chunked) on the LOCAL sequence
+        shard: the (B·N, T_local, V) logits tensor is never
+        materialised, so peak vocab-head memory is one token chunk —
+        SP keeps the long-context headroom it exists to provide
+        instead of re-capping it at real vocab sizes. Labels arrive
+        globally pre-shifted (shift_lm_labels), so local sums need no
+        halo and seq-psum to the exact global numerator/denominator."""
         params = unravel(flat)
-        lm_logits, mc_logits = model.apply(
-            {"params": params}, ids, mc_ids, tt)
-        nll, valid = token_nll(lm_logits, labels, ignore)
-        valid = valid * ex_mask[:, None, None]
-        lm_sum = jnp.sum(nll * valid)
-        lm_cnt = jnp.sum(valid)
+        B, N, Tl = ids.shape
+        h, wte, mc_logits = model.apply(
+            {"params": params}, ids, mc_ids, tt, return_hidden=True)
+        sn, sv = lm_nll_sums_chunked(
+            h, wte, labels.reshape(B * N, Tl), sp_cfg.dtype,
+            ignore_index=ignore, tokens_per_chunk=tokens_per_chunk)
+        e_mask = jnp.broadcast_to(ex_mask[:, None],
+                                  (B, N)).reshape(B * N)
+        lm_sum = jnp.sum(sn * e_mask)
+        lm_cnt = jnp.sum(sv * e_mask)
         mc_nll, _ = token_nll(mc_logits[..., None, :],
                               mc_labels[..., None], ignore)
         mc = (jnp.sum(mc_nll[..., 0] * ex_mask)
@@ -135,8 +152,11 @@ def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
         # g is already Sum_c w_c * grad_c, replicated everywhere
         n_clients = jnp.maximum(
             jax.lax.psum(jnp.sum(w), CLIENT_AXIS), 1.0)
-        loss_sum = jax.lax.psum(jnp.sum(losses * w), CLIENT_AXIS)
-        return g / n_clients, loss_sum / n_clients
+        # per-client reported losses, zeroed for non-participating
+        # rows; identical on every seq shard (the lm report is
+        # seq-psummed inside per_client), so a CLIENT_AXIS out-spec
+        # reassembles the global (W,) vector
+        return g / n_clients, losses * w
 
     tok = P(CLIENT_AXIS, None, None, SEQ_AXIS)
     per_client = P(CLIENT_AXIS)
@@ -144,7 +164,7 @@ def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
         block, mesh=mesh,
         in_specs=(P(), tok, tok, tok, per_client, per_client,
                   per_client),
-        out_specs=(P(), P()))
+        out_specs=(P(), per_client))
 
     def round_fn(flat_params, batch):
         return fn(flat_params, batch["input_ids"],

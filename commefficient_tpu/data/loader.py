@@ -213,16 +213,84 @@ def make_fed_loader(dataset, sampler, max_batch_size=None, seed=0,
 class PersonaFedLoader(_RoundLoaderBase):
     """PersonaChat rounds: adds the double-heads arrays
     input_ids/token_type_ids/lm_labels (W, B, N, T), mc_token_ids
-    (W, B, N), mc_labels (W, B)."""
+    (W, B, N), mc_labels (W, B).
+
+    ``prefetch_depth`` > 1 runs tokenization/collation on ONE
+    background thread, up to that many rounds ahead of the consumer —
+    host item prep overlaps the device round (the reference gets this
+    from its mp.Queue worker topology, fed_aggregator.py:137-158).
+    A single in-order producer keeps every RNG stream (sampler,
+    dataset ``_rng`` personality shuffles, dropout) byte-identical to
+    the synchronous path, so batches — and checkpointed RNG state at
+    epoch end — are deterministic per seed (tested in
+    tests/test_gpt2.py TestPersonaPrefetch)."""
 
     def __init__(self, dataset, sampler, num_candidates: int,
                  max_seq_len: int, pad_id: int = 0,
                  max_batch_size: Optional[int] = None,
-                 dropout_prob: float = 0.0, dropout_seed: int = 0):
+                 dropout_prob: float = 0.0, dropout_seed: int = 0,
+                 prefetch_depth: int = 2):
         super().__init__(dataset, sampler, max_batch_size,
                          dropout_prob=dropout_prob,
                          dropout_seed=dropout_seed)
         self.N, self.T, self.pad_id = num_candidates, max_seq_len, pad_id
+        self.prefetch_depth = prefetch_depth
+
+    def __iter__(self) -> Iterator[dict]:
+        if self.prefetch_depth <= 1:
+            yield from super().__iter__()
+            return
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
+        stop = threading.Event()
+
+        def produce():
+            try:
+                for round_spec in self.sampler:
+                    if stop.is_set():
+                        return
+                    if len(round_spec) < self.W:
+                        continue
+                    item = ("batch", self._apply_dropout(
+                        self.collate(round_spec)))
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    else:
+                        return
+            except BaseException as e:  # surface in the consumer
+                q.put(("error", e))
+                return
+            q.put(("done", None))
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="persona-prefetch")
+        t.start()
+        try:
+            while True:
+                kind, val = q.get()
+                if kind == "batch":
+                    yield val
+                elif kind == "error":
+                    raise val
+                else:
+                    break
+        finally:
+            # consumer abandoned mid-epoch (NaN abort): unblock and
+            # retire the producer so it can't race a later epoch's
+            # iteration of the same sampler
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
 
     def collate(self, round_spec) -> dict:
         from commefficient_tpu.data.fed_persona import persona_collate

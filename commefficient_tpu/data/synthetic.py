@@ -13,13 +13,22 @@ __all__ = ["FedSynthetic"]
 
 
 class FedSynthetic(FedDataset):
+    """``classes_per_client`` sets the heterogeneity dial: 1 (default)
+    is the pathological one-class-per-client split that defeats local
+    state at low participation (the paper's FedAvg-degradation story);
+    c > 1 gives each natural client an even mix of c consecutive
+    classes — the milder non-iid regime where fedavg/local_topk are
+    expected to learn."""
+
     def __init__(self, *args, num_classes=10, image_shape=(32, 32, 3),
-                 per_class=64, num_val=128, gen_seed=0, **kw):
+                 per_class=64, num_val=128, gen_seed=0,
+                 classes_per_client=1, **kw):
         self.num_classes = num_classes
         self.image_shape = image_shape
         self.per_class = per_class
         self.num_val = num_val
         self.gen_seed = gen_seed
+        self.classes_per_client = classes_per_client
         super().__init__(*args, **kw)
 
     # entirely in-memory: no disk prep
@@ -54,9 +63,14 @@ class FedSynthetic(FedDataset):
         rng = np.random.RandomState(
             self.gen_seed + 17 + int(client_id) * 100003
             + int(idx_within_client))
-        img = (self._means[client_id]
+        # client c holds classes {c, c+1, ..., c+cpc-1} (mod K),
+        # cycled over its items so the per-class counts stay even
+        label = (int(client_id)
+                 + int(idx_within_client) % self.classes_per_client) \
+            % self.num_classes
+        img = (self._means[label]
                + 0.5 * rng.randn(*self.image_shape).astype(np.float32))
-        return img, int(client_id)
+        return img, label
 
     def _get_val_item(self, idx):
         return self._val_x[idx], int(self._val_y[idx])

@@ -263,7 +263,12 @@ def lm_nll_sums_chunked(h, wte, labels, dtype, ignore_index=-100,
         n, v = chunk_sums(hc, lc, wte_c)
         return (sn + n, sv + v), None
 
-    init = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
+    # the zero init is derived from the inputs (x*0 sums) rather than
+    # jnp.zeros so that under shard_map it carries the same varying
+    # mesh axes as the body's output — a plain-zeros carry trips the
+    # scan carry-type check when this runs on a sequence shard
+    init = (jnp.sum(hp[:, :, 0] * 0.0, axis=1, dtype=jnp.float32),
+            jnp.sum(lp * 0, axis=1).astype(jnp.float32))
     (sn, sv), _ = jax.lax.scan(body, init,
                                jnp.arange(num_chunks, dtype=jnp.int32))
     return sn, sv
@@ -284,6 +289,78 @@ def gpt2_double_heads_loss(lm_logits, mc_logits, lm_labels, mc_labels,
                           mc_labels[..., None], ignore_index)
     mc_loss = jnp.mean(mc_nll[..., 0])
     return lm_coef * lm_loss + mc_coef * mc_loss, lm_loss, mc_loss
+
+
+def convert_gpt2_to_hf(params, cfg: GPT2Config):
+    """Inverse of ``convert_torch_gpt2``: emit an HF-`transformers`
+    GPT2DoubleHeadsModel state dict (numpy values) + HF config dict
+    from this module's params pytree — so a model fine-tuned here can
+    be handed back to the torch/HF ecosystem, matching the reference's
+    ``save_pretrained`` contract (fed_aggregator.py:209-212,
+    gpt2_train.py:146).
+
+    Layout notes: HF GPT2 Conv1D stores (in, out) — identical to flax
+    Dense kernels, no transpose; LayerNorm ``weight`` = flax ``scale``;
+    the MC head maps to ``multiple_choice_head.summary`` (a torch
+    Linear, (out, in) — transposed); ``lm_head.weight`` is the tied
+    ``wte`` (HF re-ties on load, included for completeness)."""
+    import numpy as np
+
+    def a(x):
+        return np.asarray(x)
+
+    t = params["transformer"]
+    sd = {
+        "transformer.wte.weight": a(t["wte"]),
+        "transformer.wpe.weight": a(t["wpe"]),
+        "transformer.ln_f.weight": a(t["ln_f"]["scale"]),
+        "transformer.ln_f.bias": a(t["ln_f"]["bias"]),
+        "lm_head.weight": a(t["wte"]),
+    }
+    for i in range(cfg.n_layer):
+        b = t[f"h_{i}"]
+        pre = f"transformer.h.{i}."
+        sd[pre + "ln_1.weight"] = a(b["ln_1"]["scale"])
+        sd[pre + "ln_1.bias"] = a(b["ln_1"]["bias"])
+        sd[pre + "attn.c_attn.weight"] = a(b["attn"]["c_attn"]["kernel"])
+        sd[pre + "attn.c_attn.bias"] = a(b["attn"]["c_attn"]["bias"])
+        sd[pre + "attn.c_proj.weight"] = a(b["attn"]["c_proj"]["kernel"])
+        sd[pre + "attn.c_proj.bias"] = a(b["attn"]["c_proj"]["bias"])
+        sd[pre + "ln_2.weight"] = a(b["ln_2"]["scale"])
+        sd[pre + "ln_2.bias"] = a(b["ln_2"]["bias"])
+        sd[pre + "mlp.c_fc.weight"] = a(b["mlp"]["c_fc"]["kernel"])
+        sd[pre + "mlp.c_fc.bias"] = a(b["mlp"]["c_fc"]["bias"])
+        sd[pre + "mlp.c_proj.weight"] = a(b["mlp"]["c_proj"]["kernel"])
+        sd[pre + "mlp.c_proj.bias"] = a(b["mlp"]["c_proj"]["bias"])
+    if "mc_head" in params:
+        sd["multiple_choice_head.summary.weight"] = \
+            a(params["mc_head"]["kernel"]).T
+        sd["multiple_choice_head.summary.bias"] = \
+            a(params["mc_head"]["bias"])
+
+    # HF GPT2Config field names coincide with GPT2Config's for every
+    # architectural field; the extras below make the dir loadable by
+    # transformers.from_pretrained. num_labels=1 gives the DoubleHeads
+    # summary head its (1, n_embd) projection.
+    hf_config = {
+        "model_type": "gpt2",
+        "architectures": ["GPT2DoubleHeadsModel"],
+        "vocab_size": cfg.vocab_size,
+        "n_positions": cfg.n_positions,
+        "n_ctx": cfg.n_positions,
+        "n_embd": cfg.n_embd,
+        "n_layer": cfg.n_layer,
+        "n_head": cfg.n_head,
+        "layer_norm_epsilon": cfg.layer_norm_epsilon,
+        "initializer_range": cfg.initializer_range,
+        "activation_function": "gelu_new",
+        "summary_type": "cls_index",
+        "summary_use_proj": True,
+        "summary_proj_to_labels": True,
+        "summary_first_dropout": 0.0,
+        "num_labels": 1,
+    }
+    return sd, hf_config
 
 
 def convert_torch_gpt2(state_dict, cfg: GPT2Config):

@@ -75,14 +75,20 @@ class BatchStatNorm(nn.Module):
                           axis=axes) / denom
             if self.track_stats and not self.is_initializing():
                 ra_mean.value = mean
-                ra_var.value = var
+                # recorded (not normalizing) variance gets the Bessel
+                # n/(n-1) correction: torch BatchNorm2d normalizes with
+                # the biased estimate but feeds the UNBIASED one into
+                # running_var, and the server's blend must match that
+                ra_var.value = var * (denom / jnp.maximum(
+                    denom - 1.0, 1.0))
         else:
             axes = tuple(range(x.ndim - 1))
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
             var = jnp.var(xf, axis=axes)
             if self.track_stats and not self.is_initializing():
+                n = float(np.prod(x.shape[:-1]))
                 ra_mean.value = mean
-                ra_var.value = var
+                ra_var.value = var * (n / max(n - 1.0, 1.0))
         inv = (scale * jax.lax.rsqrt(var + self.epsilon)).astype(x.dtype)
         return x * inv + (bias - mean * inv).astype(x.dtype)

@@ -75,6 +75,18 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     return jax.process_index()
 
 
+def maybe_initialize_multihost_cli(args) -> None:
+    """Trainer-CLI wiring: join the multi-controller runtime when the
+    pod flags (--coordinator_address/--num_processes/--process_id) are
+    present. Shared by cv_train and gpt2_train."""
+    if args.coordinator_address is None and args.num_processes is None:
+        return
+    pid = initialize_multihost(args.coordinator_address,
+                               args.num_processes, args.process_id)
+    print(f"multihost: process {pid}/{jax.process_count()}, "
+          f"{jax.device_count()} devices")
+
+
 def client_sharding(mesh: Mesh) -> NamedSharding:
     """Shard leading (client) axis across the mesh."""
     return NamedSharding(mesh, P(CLIENT_AXIS))

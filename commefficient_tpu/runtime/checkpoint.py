@@ -51,16 +51,22 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         raise RuntimeError("checkpoint requested with pipelined rounds "
                            "inflight; drain with model.flush(force="
                            "True) (the trainers do this at epoch end)")
-    arrays = {"ps_weights": np.asarray(jax.device_get(model.ps_weights))}
+    # _host, not device_get: on a multi-process mesh the per-client
+    # state rows are sharded across processes and not fully addressable
+    # — process_allgather (a collective every process must reach)
+    # reassembles the global rows; replicated arrays pass through
+    from commefficient_tpu.runtime.fed_model import _host
+
+    arrays = {"ps_weights": _host(model.ps_weights)}
     cs = model.client_states
     for name, val in (("cs_velocities", cs.velocities),
                       ("cs_errors", cs.errors),
                       ("cs_weights", cs.weights)):
         if val is not None:
-            arrays[name] = np.asarray(jax.device_get(val))
+            arrays[name] = _host(val)
     ss = opt.server_state
-    arrays["ss_Vvelocity"] = np.asarray(jax.device_get(ss.Vvelocity))
-    arrays["ss_Verror"] = np.asarray(jax.device_get(ss.Verror))
+    arrays["ss_Vvelocity"] = _host(ss.Vvelocity)
+    arrays["ss_Verror"] = _host(ss.Verror)
     arrays["last_updated"] = model.last_updated
     arrays["client_last_seen"] = model.client_last_seen
     if getattr(model, "model_state", None) is not None:
@@ -69,8 +75,7 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         from jax.tree_util import keystr, tree_flatten_with_path
         leaves, _ = tree_flatten_with_path(model.model_state)
         for leaf_path, leaf in leaves:
-            arrays["bnstats:" + keystr(leaf_path)] = \
-                np.asarray(jax.device_get(leaf))
+            arrays["bnstats:" + keystr(leaf_path)] = _host(leaf)
 
     meta = {
         "format": _FMT,
@@ -114,17 +119,42 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
     if loader is not None and hasattr(loader, "_round_counter"):
         meta["loader_round_counter"] = int(loader._round_counter)
 
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
-                               suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez_compressed(f, meta=json.dumps(meta), **arrays)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    # every process gathered (the allgathers above are collectives)
+    # but exactly one writes — concurrent writers on a shared
+    # filesystem would corrupt the archive
+    err = None
+    if jax.process_index() == 0:
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(path) or ".", suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez_compressed(f, meta=json.dumps(meta),
+                                        **arrays)
+                os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+        except BaseException as e:
+            # don't raise yet: the peers are headed into the barrier
+            # below, and abandoning it would turn a local I/O error
+            # into a pod-wide hang
+            err = e
+    if jax.process_count() > 1:
+        # barrier + failure broadcast: nobody proceeds (or resumes
+        # from this path) until the writer finished, and a write
+        # failure on process 0 fails every process with the real
+        # reason instead of a heartbeat timeout
+        from jax.experimental import multihost_utils
+        ok = multihost_utils.broadcast_one_to_all(
+            np.int32(0 if err is None else 1))
+        if int(ok) and err is None:
+            raise RuntimeError(
+                f"checkpoint write failed on process 0 ({path})")
+    if err is not None:
+        raise err
     return path
 
 
@@ -204,16 +234,28 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
         if getattr(model, "model_state", None) is not None:
             from jax.tree_util import keystr, tree_flatten_with_path
             leaves, treedef = tree_flatten_with_path(model.model_state)
-            restored = []
-            for path, leaf in leaves:
-                key = "bnstats:" + keystr(path)
-                if key not in z.files:
-                    raise ValueError(
-                        f"checkpoint lacks BN running stats {key} "
-                        "but this run tracks them")
-                restored.append(jnp.asarray(z[key]))
-            model.model_state = jax.tree_util.tree_unflatten(
-                treedef, restored)
+            if not any(k.startswith("bnstats:") for k in z.files):
+                # checkpoint written by a BN-free build (or before
+                # running stats existed): keep the fresh init stats
+                # rather than refusing the whole restore — weights and
+                # optimizer state are still bit-exact, only the running
+                # statistics restart their blend
+                import warnings
+                warnings.warn(
+                    "checkpoint has no BN running stats "
+                    "(pre-batchnorm format); resuming with freshly "
+                    "initialised statistics")
+            else:
+                restored = []
+                for path, leaf in leaves:
+                    key = "bnstats:" + keystr(path)
+                    if key not in z.files:
+                        raise ValueError(
+                            f"checkpoint lacks BN running stats {key} "
+                            "but this run tracks them")
+                    restored.append(jnp.asarray(z[key]))
+                model.model_state = jax.tree_util.tree_unflatten(
+                    treedef, restored)
         model.round_index = meta["round_index"]
         model._update_round = meta["update_round"]
         model._rebuild_round_counts()

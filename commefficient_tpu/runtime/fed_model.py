@@ -216,10 +216,19 @@ class FedModel:
         lazy state_dict sync, fed_aggregator.py:374-378)."""
         return self.unravel(self.ps_weights)
 
-    def save_pretrained(self, save_dir: str):
+    def save_pretrained(self, save_dir: str, hf_format: bool = False):
         """HF-style final-model save (reference fed_aggregator.py:
         205-212 / gpt2_train.py:146): current server weights as a flax
-        msgpack blob plus the module's config as JSON."""
+        msgpack blob plus the module's config as JSON.
+
+        ``hf_format=True`` (GPT-2 modules only) additionally writes
+        ``pytorch_model.bin`` + an HF-`transformers` ``config.json`` so
+        the directory loads with ``GPT2DoubleHeadsModel/GPT2LMHeadModel
+        .from_pretrained`` — the model goes back to the torch/HF
+        ecosystem the reference lives in. The HF config's field names
+        are a superset of GPT2Config's, so this framework's own reload
+        path (gpt2_train.build_model_and_tokenizer) reads the same dir
+        too."""
         import dataclasses
         import json
         import os
@@ -230,7 +239,22 @@ class FedModel:
         # config first: a dir with weights but no config would rebuild
         # the wrong architecture on reload (gpt2_train reload path)
         cfg = getattr(self.module, "cfg", None)
-        if cfg is not None and dataclasses.is_dataclass(cfg):
+        if hf_format:
+            import torch
+
+            from commefficient_tpu.models.gpt2 import (GPT2Config,
+                                                       convert_gpt2_to_hf)
+            if not isinstance(cfg, GPT2Config):
+                raise ValueError("hf_format export is defined for "
+                                 "GPT-2 modules only")
+            sd, hf_cfg = convert_gpt2_to_hf(self.params(), cfg)
+            with open(os.path.join(save_dir, "config.json"), "w") as f:
+                json.dump(hf_cfg, f, indent=2)
+            torch.save({k: torch.from_numpy(
+                            np.array(v, copy=True))
+                        for k, v in sd.items()},
+                       os.path.join(save_dir, "pytorch_model.bin"))
+        elif cfg is not None and dataclasses.is_dataclass(cfg):
             blob = {k: v for k, v in dataclasses.asdict(cfg).items()
                     if isinstance(v, (int, float, str, bool,
                                       type(None)))}

@@ -112,11 +112,17 @@ class SeqParallelFedModel(FedModel):
             "mc_labels": jnp.asarray(batch["mc_labels"]),
             "mask": jnp.asarray(batch["mask"]),
         }
-        agg, loss = self._sp_round(self.ps_weights, sp_batch)
+        agg, per_client_loss = self._sp_round(self.ps_weights,
+                                              sp_batch)
         self.pending_aggregated = agg
         self.pending_client_ids = jnp.asarray(ids_np, jnp.int32)
         self.round_index += 1
 
-        metrics = [np.full(W, float(loss), np.float64)]
+        # per-client losses, like the 1-D engine's metrics arrays —
+        # the trainer weights them by real sample counts. _host, not
+        # device_get: the (W,) vector is client-axis sharded and not
+        # fully addressable on a multi-process mesh
+        from commefficient_tpu.runtime.fed_model import _host
+        metrics = [np.asarray(_host(per_client_loss), np.float64)]
         return metrics + list(self._account_bytes(ids_np,
                                                   batch["mask"]))

@@ -14,6 +14,7 @@ Run e.g.:
 from __future__ import annotations
 
 import math
+import re
 import sys
 import time
 
@@ -122,22 +123,30 @@ def make_bn_stats_fn(module, init_stats):
     return stats_fn
 
 
-def fixup_bias_name(name: str) -> bool:
-    """Fixup 0.1x 'bias' group membership by parameter-path name.
+# Fixup scalar leaf names, matched as the EXACT final path segment —
+# a bare substring test ('bias' in path) would silently sweep any
+# future parameter whose path merely contains the string into the
+# 0.1x group. The name sets cover every scalar the Fixup family
+# declares (fixup_resnet9.py: bias1a/1b/2a/2b, bias1/bias2, scale;
+# FixupBottleneck adds bias3a/3b; FixupResNet18: add1a/1b/2a/2b, mul)
+# plus the Dense head's 'bias', which the reference's substring match
+# also places at 0.1x (cv_train.py:366-376).
+_FIXUP_BIAS_RE = re.compile(
+    r"\['(?:bias(?:[123][ab]?)?|add[12][ab])'\]$")
+_FIXUP_SCALE_RE = re.compile(r"\['(?:scale|mul)'\]$")
 
-    The reference matches torch names like 'add1a.bias' with a plain
-    'bias' substring (cv_train.py:366-376; fixup_resnet18 wraps each
-    scalar in an AddBias module). Our flax FixupResNet18 names the
-    additive scalars add1a/add1b/add2a/add2b directly, so match those
-    too.
-    """
-    return "bias" in name or "add" in name
+
+def fixup_bias_name(name: str) -> bool:
+    """Fixup 0.1x 'bias' group membership by parameter-path name
+    (reference cv_train.py:366-376 matches torch names by substring;
+    here the final path segment must equal a known scalar name)."""
+    return _FIXUP_BIAS_RE.search(name) is not None
 
 
 def fixup_scale_name(name: str) -> bool:
     """Fixup 0.1x 'scale' group: 'mul.scale' in the reference; our
     FixupResNet18 names the multiplicative scalar 'mul'."""
-    return "scale" in name or "['mul']" in name
+    return _FIXUP_SCALE_RE.search(name) is not None
 
 
 def apply_mixup(batch, alpha, rng):
@@ -336,6 +345,9 @@ def get_data_loaders(args: Config):
     cls = get_dataset_cls(name)
     common = dict(do_iid=args.do_iid, num_clients=args.num_clients,
                   seed=args.seed)
+    if name == "Synthetic":
+        common["classes_per_client"] = args.classes_per_client
+        common["per_class"] = args.synthetic_per_class
     train_ds = cls(args.dataset_dir, name, transform=train_t,
                    train=True, **common)
     val_ds = cls(args.dataset_dir, name, transform=val_t, train=False,
@@ -429,6 +441,9 @@ DEFAULT_LR = 0.4
 
 def main(argv=None):
     args = parse_args(default_lr=DEFAULT_LR, argv=argv)
+    from commefficient_tpu.parallel.mesh import \
+        maybe_initialize_multihost_cli
+    maybe_initialize_multihost_cli(args)
     if args.seq_devices > 1:
         raise ValueError("--seq_devices is a GPT-2 trainer feature "
                          "(sequence parallelism); cv models have no "
@@ -524,7 +539,8 @@ def main(argv=None):
                     epoch_hook=epoch_hook)
     model.finalize()
 
-    if args.do_checkpoint:
+    if args.do_checkpoint and jax.process_index() == 0:
+        # params are replicated — one writer on a shared filesystem
         import os
         import pickle
         os.makedirs(args.checkpoint_path, exist_ok=True)

@@ -322,6 +322,9 @@ def get_data_loaders(args: Config, tokenizer):
 
 def main(argv=None):
     args = parse_args(default_lr=4e-2, argv=argv)
+    from commefficient_tpu.parallel.mesh import \
+        maybe_initialize_multihost_cli
+    maybe_initialize_multihost_cli(args)
     np.random.seed(args.seed)
     args.num_results_train = 1
 
@@ -389,13 +392,15 @@ def main(argv=None):
                          val_loader, args, start_epoch=start_epoch,
                          epoch_hook=epoch_hook, logdir=logdir)
     model.finalize()
-    if logdir is not None and not getattr(model, "diverged", False):
+    if logdir is not None and not getattr(model, "diverged", False) \
+            and jax.process_index() == 0:
         # reference gpt2_train.py:146, 278-283: final model + tokenizer
         # saved HF-style into the run's logdir (skipped after a NaN
         # abort — diverged weights are not a final model)
-        model.save_pretrained(logdir)
+        model.save_pretrained(logdir, hf_format=args.do_hf_export)
         tokenizer.save_pretrained(logdir)
-        print(f"saved model + tokenizer to {logdir}")
+        print(f"saved model + tokenizer to {logdir}"
+              + (" (HF torch format)" if args.do_hf_export else ""))
     return results
 
 

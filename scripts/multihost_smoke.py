@@ -30,17 +30,17 @@ def worker(args):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    from commefficient_tpu.parallel.mesh import initialize_multihost
 
-    pid = initialize_multihost(args.coordinator, args.num_processes,
-                               args.process_id)
-    assert pid == args.process_id
-    total = DEVICES_PER_PROC * args.num_processes
-    assert jax.device_count() == total, \
-        f"{jax.device_count()} != {total}"
-    assert jax.local_device_count() == DEVICES_PER_PROC
+    import numpy as np
 
     from commefficient_tpu.train import cv_train
+
+    total = DEVICES_PER_PROC * args.num_processes
+    shared = os.environ["SMOKE_SHARED_DIR"]
+
+    # (1) sketch mode; the multi-controller runtime is joined through
+    # the trainer's own CLI flags (round-2 review weak #5: a pod user
+    # must not have to call initialize_multihost by hand)
     results = cv_train.main([
         "--test", "--dataset_name", "Synthetic",
         "--mode", "sketch", "--error_type", "virtual",
@@ -48,13 +48,59 @@ def worker(args):
         "--num_clients", "10", "--num_workers", str(total),
         "--local_batch_size", "4", "--num_epochs", "2",
         "--lr_scale", "0.1", "--pivot_epoch", "1",
+        "--coordinator_address", args.coordinator,
+        "--num_processes", str(args.num_processes),
+        "--process_id", str(args.process_id),
     ])
-    import numpy as np
+    assert jax.process_index() == args.process_id
+    assert jax.device_count() == total, \
+        f"{jax.device_count()} != {total}"
+    assert jax.local_device_count() == DEVICES_PER_PROC
     assert np.isfinite(results[-1]["train_loss"])
     assert np.isfinite(results[-1]["test_acc"])
     # SPMD determinism: every process computed identical metrics
     print(f"WORKER{args.process_id}_RESULT "
           f"{results[-1]['train_loss']:.9f}", flush=True)
+
+    # (2) local_topk: per-client momentum+error rows SHARDED across
+    # the two processes (round-2 review weak #5 — a local-state mode
+    # crossing process boundaries)
+    lt_flags = [
+        "--test", "--dataset_name", "Synthetic",
+        "--mode", "local_topk", "--error_type", "local",
+        "--local_momentum", "0.9",
+        "--num_clients", "10", "--num_workers", str(total),
+        "--local_batch_size", "4",
+        "--lr_scale", "0.1", "--pivot_epoch", "1",
+        "--schedule_epochs", "2",
+    ]
+    results = cv_train.main(lt_flags + ["--num_epochs", "2"])
+    assert np.isfinite(results[-1]["train_loss"])
+    print(f"WORKER{args.process_id}_LT "
+          f"{results[-1]['train_loss']:.9f}", flush=True)
+
+    # (3) checkpoint round-trip on the multi-process mesh (round-2
+    # review weak #4: save must allgather non-addressable client rows,
+    # one process writes, resume restores the sharded placement).
+    # A: uninterrupted 2 epochs; B: 1 epoch, "killed", resumed to 2 —
+    # final metrics must match A's bit-for-bit.
+    row_a = cv_train.main(lt_flags + [
+        "--num_epochs", "2", "--checkpoint",
+        "--checkpoint_path", os.path.join(shared, "ckptA"),
+    ])[-1]
+    cv_train.main(lt_flags + [
+        "--num_epochs", "1", "--checkpoint", "--checkpoint_every", "1",
+        "--checkpoint_path", os.path.join(shared, "ckptB"),
+    ])
+    row_b = cv_train.main(lt_flags + [
+        "--num_epochs", "2", "--checkpoint", "--resume",
+        "--checkpoint_path", os.path.join(shared, "ckptB"),
+    ])[-1]
+    for key in ("train_loss", "train_acc", "test_loss", "test_acc"):
+        assert repr(row_a[key]) == repr(row_b[key]), \
+            (key, row_a[key], row_b[key])
+    print(f"WORKER{args.process_id}_RESUME "
+          f"{row_b['train_loss']:.9f}", flush=True)
 
 
 def launcher():
@@ -65,6 +111,7 @@ def launcher():
     logs = []
     repo_root = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
+    shared_dir = tempfile.mkdtemp(prefix="multihost_smoke_")
     for i in range(2):
         env = dict(
             os.environ,
@@ -73,6 +120,7 @@ def launcher():
                       f"{DEVICES_PER_PROC}",
             PYTHONPATH=repo_root + os.pathsep
             + os.environ.get("PYTHONPATH", ""),
+            SMOKE_SHARED_DIR=shared_dir,
         )
         # temp files, not PIPEs: an undrained pipe buffer would
         # deadlock a chatty worker against the poll loop below
@@ -87,7 +135,7 @@ def launcher():
     # peers too (a dead coordinator would otherwise hang its partner
     # in jax.distributed.initialize, orphaned past the test timeout)
     import time
-    deadline = time.time() + 600
+    deadline = time.time() + 1200
     pending = set(range(2))
     failed = False
     while pending and time.time() < deadline:
@@ -108,20 +156,28 @@ def launcher():
         log.seek(0)
         outs.append(log.read())
         log.close()
+    import shutil
+    shutil.rmtree(shared_dir, ignore_errors=True)
     codes = [p.returncode for p in procs]
-    results = []
+    results = {}
     for i, out in enumerate(outs):
         for line in out.splitlines():
-            if line.startswith(f"WORKER{i}_RESULT"):
-                results.append(line.split()[1])
-    if codes != [0, 0] or len(results) != 2:
+            for tag in ("RESULT", "LT", "RESUME"):
+                if line.startswith(f"WORKER{i}_{tag}"):
+                    results.setdefault(tag, []).append(line.split()[1])
+    complete = all(len(results.get(tag, [])) == 2
+                   for tag in ("RESULT", "LT", "RESUME"))
+    if codes != [0, 0] or not complete:
         for i, out in enumerate(outs):
             sys.stderr.write(f"--- worker {i} (exit {codes[i]}) ---\n")
             sys.stderr.write(out[-4000:] + "\n")
         sys.exit(1)
-    assert results[0] == results[1], \
-        f"processes disagree: {results}"
-    print(f"MULTIHOST_OK loss={results[0]}")
+    for tag, vals in results.items():
+        assert vals[0] == vals[1], \
+            f"processes disagree on {tag}: {vals}"
+    print(f"MULTIHOST_OK loss={results['RESULT'][0]} "
+          f"local_topk={results['LT'][0]} "
+          f"resume={results['RESUME'][0]}")
 
 
 if __name__ == "__main__":

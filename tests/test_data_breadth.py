@@ -114,6 +114,155 @@ class TestFedImageNet:
         assert img.dtype == np.float32
 
 
+def make_cifar10_dir(root, per_batch=8, n_test=10, seed=0):
+    """Fabricate ``cifar-10-batches-py/`` in the exact upstream layout:
+    five pickled train batches + ``test_batch``, each a dict with
+    b"data" (N, 3072) uint8 rows in channels-first order and b"labels"
+    a plain list (the layout FedCIFAR10.prepare_datasets reads;
+    reference fed_cifar.py:13-100)."""
+    import pickle
+
+    rng = np.random.RandomState(seed)
+    d = os.path.join(root, "cifar-10-batches-py")
+    os.makedirs(d, exist_ok=True)
+    for bi in range(1, 6):
+        data = rng.randint(0, 256, (per_batch, 3072), np.uint8)
+        labels = rng.randint(0, 10, per_batch).tolist()
+        with open(os.path.join(d, f"data_batch_{bi}"), "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels,
+                         b"batch_label": b"training batch"}, f)
+    data = rng.randint(0, 256, (n_test, 3072), np.uint8)
+    with open(os.path.join(d, "test_batch"), "wb") as f:
+        pickle.dump({b"data": data,
+                     b"labels": rng.randint(0, 10, n_test).tolist()}, f)
+    return d
+
+
+def make_cifar100_dir(root, n_train=40, n_test=10, seed=0):
+    """``cifar-100-python/`` upstream layout: single ``train`` pickle
+    with b"fine_labels" + ``test``."""
+    import pickle
+
+    rng = np.random.RandomState(seed)
+    d = os.path.join(root, "cifar-100-python")
+    os.makedirs(d, exist_ok=True)
+    # guarantee every fine label appears at least... not needed: the
+    # partition only needs counts per class (possibly zero)
+    with open(os.path.join(d, "train"), "wb") as f:
+        pickle.dump({b"data": rng.randint(0, 256, (n_train, 3072),
+                                          np.uint8),
+                     b"fine_labels": rng.randint(
+                         0, 100, n_train).tolist()}, f)
+    with open(os.path.join(d, "test"), "wb") as f:
+        pickle.dump({b"data": rng.randint(0, 256, (n_test, 3072),
+                                          np.uint8),
+                     b"fine_labels": rng.randint(
+                         0, 100, n_test).tolist()}, f)
+    return d
+
+
+class TestFedCIFARPrep:
+    """prepare_datasets against real-format pickle archives (round-2
+    review weak #3: this path must not first run on real data)."""
+
+    def test_cifar10_prep_items_and_partition(self, tmp_path):
+        import pickle
+
+        root = str(tmp_path)
+        src = make_cifar10_dir(root)
+        cls = get_dataset_cls("CIFAR10")
+        ds = cls(root, "CIFAR10", train=True)  # triggers prep
+
+        # counts per class match the archive contents
+        ys = []
+        for bi in range(1, 6):
+            with open(os.path.join(src, f"data_batch_{bi}"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            ys.append(np.asarray(d[b"labels"]))
+        y = np.concatenate(ys)
+        want_counts = [int((y == c).sum()) for c in range(10)]
+        assert list(ds.images_per_client) == want_counts
+        assert len(ds) == 40
+
+        # one class per natural client: label == client id everywhere
+        for i in range(len(ds)):
+            cid, img, target = ds[i]
+            assert target == cid
+            assert img.shape == (32, 32, 3) and img.dtype == np.uint8
+
+        # pixel content survives the channels-first -> NHWC reshape:
+        # first item of class y[0]'s client is the first archive row
+        # with that label
+        with open(os.path.join(src, "data_batch_1"), "rb") as f:
+            d0 = pickle.load(f, encoding="bytes")
+        row = np.asarray(d0[b"data"][0])
+        first_cls = int(d0[b"labels"][0])
+        # position of row 0 within its class = #earlier rows of cls
+        start = int(np.concatenate([[0], np.cumsum(
+            ds.images_per_client)])[first_cls])
+        pos = 0  # row 0 is the first occurrence of its class
+        _, img, _ = ds[start + pos]
+        np.testing.assert_array_equal(
+            img, row.reshape(3, 32, 32).transpose(1, 2, 0))
+
+    def test_cifar10_val_items(self, tmp_path):
+        root = str(tmp_path)
+        make_cifar10_dir(root)
+        cls = get_dataset_cls("CIFAR10")
+        ds = cls(root, "CIFAR10", train=False)
+        assert len(ds) == 10
+        cid, img, target = ds[0]
+        assert cid == -1 and img.shape == (32, 32, 3)
+
+    def test_cifar10_noniid_resplit_and_round(self, tmp_path):
+        """num_clients > 10 subdivides each class's shard
+        (fed_dataset.data_per_client); a full --test federated round
+        runs off the prepared archive through cv_train."""
+        from commefficient_tpu.train import cv_train
+
+        root = str(tmp_path)
+        make_cifar10_dir(root, per_batch=20)  # 100 imgs
+        cls = get_dataset_cls("CIFAR10")
+        ds = cls(root, "CIFAR10", train=True, num_clients=20)
+        assert ds.num_clients == 20
+        # every reported client holds exactly one class
+        by_client = {}
+        for i in range(len(ds)):
+            cid, _, target = ds[i]
+            by_client.setdefault(cid, set()).add(target)
+        assert all(len(v) == 1 for v in by_client.values())
+
+        results = cv_train.main([
+            "--test", "--dataset_name", "CIFAR10",
+            "--dataset_dir", root, "--num_clients", "20",
+            "--mode", "sketch", "--error_type", "virtual",
+            "--local_momentum", "0", "--virtual_momentum", "0.9",
+            "--num_workers", "2", "--local_batch_size", "4",
+            "--num_epochs", "1",
+        ])
+        assert len(results) == 1
+        assert np.isfinite(results[0]["train_loss"])
+
+    def test_cifar100_prep_and_items(self, tmp_path):
+        root = str(tmp_path)
+        make_cifar100_dir(root)
+        cls = get_dataset_cls("CIFAR100")
+        ds = cls(root, "CIFAR100", train=True)
+        assert len(ds.images_per_client) == 100
+        assert sum(ds.images_per_client) == 40
+        for i in range(len(ds)):
+            cid, img, target = ds[i]
+            assert target == cid
+            assert img.shape == (32, 32, 3)
+        val = cls(root, "CIFAR100", train=False)
+        assert len(val) == 10
+
+    def test_missing_archive_raises(self, tmp_path):
+        cls = get_dataset_cls("CIFAR10")
+        with pytest.raises(FileNotFoundError):
+            cls(str(tmp_path), "CIFAR10", train=True)
+
+
 class TestTransforms:
     def test_femnist_train_shapes(self):
         from commefficient_tpu.data import transforms as T

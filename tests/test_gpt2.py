@@ -182,6 +182,68 @@ class TestPersonaInputs:
         assert val[0][0] == -1
 
 
+class TestPersonaPrefetch:
+    """PersonaFedLoader's background collation must be byte-identical
+    to the synchronous path — every RNG stream in submission order
+    (round-2 review weak #7: the prefetch BENCHMARKS promised now
+    exists)."""
+
+    def _stack(self, root, depth, epochs=2):
+        from commefficient_tpu.data.fed_persona import FedPERSONA
+        from commefficient_tpu.data.fed_sampler import FedSampler
+        from commefficient_tpu.data.loader import PersonaFedLoader
+        from commefficient_tpu.data.tokenizer import (ByteTokenizer,
+                                                      SPECIAL_TOKENS)
+        tok = ByteTokenizer()
+        tok.add_special_tokens(SPECIAL_TOKENS)
+        ds = FedPERSONA(tok, 2, 2, 1, root, "PERSONA", train=True,
+                        seed=3)
+        sampler = FedSampler(ds, num_workers=2, local_batch_size=2,
+                             seed=3)
+        loader = PersonaFedLoader(ds, sampler, 2, 64, 0,
+                                  dropout_prob=0.3, dropout_seed=5,
+                                  prefetch_depth=depth)
+        out = []
+        for _ in range(epochs):  # dataset _rng persists across epochs
+            out.extend(list(loader))
+        return out
+
+    def test_identical_to_synchronous(self, tmp_path):
+        from commefficient_tpu.data.fed_persona import (
+            generate_synthetic_personachat)
+        generate_synthetic_personachat(str(tmp_path))
+        sync = self._stack(str(tmp_path), depth=1)
+        pre = self._stack(str(tmp_path), depth=3)
+        assert len(sync) == len(pre) and len(sync) > 2
+        for a, b in zip(sync, pre):
+            assert a.keys() == b.keys()
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+    def test_abandoned_iteration_is_safe(self, tmp_path):
+        """Breaking out mid-epoch (NaN abort) must retire the producer
+        without deadlock, and a later fresh iteration still yields."""
+        from commefficient_tpu.data.fed_persona import (
+            FedPERSONA, generate_synthetic_personachat)
+        from commefficient_tpu.data.fed_sampler import FedSampler
+        from commefficient_tpu.data.loader import PersonaFedLoader
+        from commefficient_tpu.data.tokenizer import (ByteTokenizer,
+                                                      SPECIAL_TOKENS)
+        generate_synthetic_personachat(str(tmp_path))
+        tok = ByteTokenizer()
+        tok.add_special_tokens(SPECIAL_TOKENS)
+        ds = FedPERSONA(tok, 2, 2, 1, str(tmp_path), "PERSONA",
+                        train=True)
+        loader = PersonaFedLoader(
+            ds, FedSampler(ds, num_workers=2, local_batch_size=2,
+                           seed=0), 2, 64, 0, prefetch_depth=2)
+        it = iter(loader)
+        next(it)
+        it.close()  # abandon
+        again = list(loader)
+        assert len(again) >= 1
+
+
 class TestGpt2TrainSmoke:
     def test_end_to_end(self, tmp_path):
         from commefficient_tpu.train import gpt2_train
@@ -601,6 +663,95 @@ class TestSavePretrained:
         tok.add_special_tokens(SPECIAL_TOKENS)
         tok.save_pretrained(str(out))
         assert (out / "special_tokens.json").exists()
+
+    def test_hf_export_roundtrip_transformers_logits(self, tmp_path):
+        """hf_format export (round-2 review missing #2): train a
+        federated round, export pytorch_model.bin + HF config, load
+        with the real `transformers` GPT2DoubleHeadsModel, and match
+        both LM and MC logits — the artifact goes back to the torch/HF
+        ecosystem like the reference's save_pretrained
+        (fed_aggregator.py:209-212)."""
+        torch = pytest.importorskip("torch")
+        from transformers import GPT2DoubleHeadsModel
+
+        import jax
+        import jax.numpy as jnp
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.models.gpt2 import (GPT2Config,
+                                                   GPT2DoubleHeads)
+        from commefficient_tpu.runtime import FedModel, FedOptimizer
+        from commefficient_tpu.train.gpt2_train import (
+            make_compute_loss_train)
+
+        cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=16,
+                         n_layer=2, n_head=2)
+        module = GPT2DoubleHeads(cfg)
+        B, N, T = 2, 2, 16
+        dummy = jnp.zeros((1, N, 8), jnp.int32)
+        params = module.init(jax.random.PRNGKey(0), dummy,
+                             jnp.zeros((1, N), jnp.int32),
+                             dummy)["params"]
+        args = Config(mode="uncompressed", error_type="none",
+                      local_momentum=0.0, virtual_momentum=0.9,
+                      num_workers=2, local_batch_size=B,
+                      num_clients=4, dataset_name="PERSONA", seed=0,
+                      num_results_train=1)
+        model = FedModel(module, params,
+                         make_compute_loss_train(module, args), args)
+        opt = FedOptimizer([{"lr": 0.01}], args)
+
+        rng = np.random.RandomState(0)
+        ids_np = rng.randint(0, 128, (2, B, N, T)).astype(np.int32)
+        batch = {
+            "input_ids": ids_np,
+            "token_type_ids": rng.randint(
+                0, 128, (2, B, N, T)).astype(np.int32),
+            "lm_labels": ids_np.copy(),
+            "mc_token_ids": np.full((2, B, N), T - 1, np.int32),
+            "mc_labels": rng.randint(0, N, (2, B)).astype(np.int32),
+            "mask": np.ones((2, B), np.float32),
+            "client_ids": np.array([0, 1], np.int32),
+        }
+        model(batch)
+        opt.step()  # weights move: the export is of a TRAINED model
+
+        out = tmp_path / "hf"
+        model.save_pretrained(str(out), hf_format=True)
+        assert (out / "pytorch_model.bin").exists()
+
+        hf = GPT2DoubleHeadsModel.from_pretrained(str(out)).eval()
+        ids2 = rng.randint(0, 128, (B, N, T)).astype(np.int32)
+        tt2 = rng.randint(0, 128, (B, N, T)).astype(np.int32)
+        mc2 = np.full((B, N), T - 1, np.int32)
+        with torch.no_grad():
+            hf_out = hf(torch.tensor(ids2.astype(np.int64)),
+                        token_type_ids=torch.tensor(
+                            tt2.astype(np.int64)),
+                        mc_token_ids=torch.tensor(
+                            mc2.astype(np.int64)))
+        lm, mc = module.apply({"params": model.params()},
+                              jnp.asarray(ids2),
+                              jnp.asarray(mc2), jnp.asarray(tt2))
+        np.testing.assert_allclose(np.asarray(lm),
+                                   hf_out.logits.numpy(),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(mc),
+                                   hf_out.mc_logits.numpy(),
+                                   rtol=2e-3, atol=2e-3)
+
+        # and the framework's own reload path reads the same dir
+        from commefficient_tpu.models.gpt2 import convert_torch_gpt2
+        sd = {k: v.numpy()
+              for k, v in torch.load(str(out / "pytorch_model.bin"),
+                                     map_location="cpu").items()}
+        p2 = convert_torch_gpt2(sd, cfg)
+        for a, b in zip(
+                jax.tree_util.tree_leaves(
+                    model.params()["transformer"]),
+                jax.tree_util.tree_leaves(p2["transformer"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
 
     def test_bpe_tokenizer_roundtrip(self, tmp_path):
         """Saved vocab/merges/special files reload into an equivalent

@@ -23,6 +23,6 @@ def test_two_process_trainer_smoke():
     # needs no devices itself
     out = subprocess.run(
         [sys.executable, os.path.abspath(script)], env=env,
-        capture_output=True, text=True, timeout=900)
+        capture_output=True, text=True, timeout=1500)
     assert out.returncode == 0, out.stderr[-4000:]
     assert "MULTIHOST_OK" in out.stdout

@@ -77,7 +77,9 @@ def test_sp_round_matches_dense_oracle():
     batch = _batch(rng, W, B, N, T, cfg.vocab_size)
 
     round_fn = jax.jit(build_sp_gpt2_round(cfg, mesh, unravel))
-    agg_sp, loss_sp = round_fn(flat, batch)
+    agg_sp, per_client_sp = round_fn(flat, batch)
+    assert per_client_sp.shape == (W,)
+    loss_sp = np.asarray(per_client_sp).sum() / W
 
     agg_ref, loss_ref = _dense_oracle(cfg, params, flat, unravel,
                                       batch, 1.0, 1.0)
@@ -85,6 +87,61 @@ def test_sp_round_matches_dense_oracle():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(agg_sp), np.asarray(agg_ref),
                                rtol=5e-4, atol=2e-5)
+
+
+def test_sp_per_client_losses_match_oracle():
+    """Each client's reported loss equals its own dense-oracle loss —
+    not a replicated round mean (round-2 review weak #6)."""
+    cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    W, B, N, T = 2, 1, 2, 32
+    mesh = make_sp_mesh(2, 4)
+    dense = GPT2DoubleHeads(cfg)
+    rng = np.random.RandomState(7)
+    ids0 = jnp.zeros((B, N, T), jnp.int32)
+    params = dense.init(jax.random.PRNGKey(0), ids0,
+                        jnp.zeros((B, N), jnp.int32), ids0)["params"]
+    flat, unravel = flatten_params(params)
+    batch = _batch(rng, W, B, N, T, cfg.vocab_size)
+
+    round_fn = jax.jit(build_sp_gpt2_round(cfg, mesh, unravel))
+    _, per_client_sp = round_fn(flat, batch)
+    for w in range(W):
+        _, loss_w = _dense_oracle(
+            cfg, params, flat, unravel,
+            {k: v[w:w + 1] for k, v in batch.items()}, 1.0, 1.0)
+        np.testing.assert_allclose(float(per_client_sp[w]),
+                                   float(loss_w), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_sp_no_full_vocab_logits_buffer():
+    """The compiled SP round must not contain the (B·N, T_local, V)
+    LM logits tensor: the chunked vocab CE caps the vocab-head buffer
+    at one token chunk (round-2 review weak #6)."""
+    import re
+
+    cfg = GPT2Config(vocab_size=512, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    W, B, N, T = 2, 1, 2, 64
+    mesh = make_sp_mesh(2, 4)
+    T_local = T // 4
+    dense = GPT2DoubleHeads(cfg)
+    rng = np.random.RandomState(5)
+    ids0 = jnp.zeros((B, N, T), jnp.int32)
+    params = dense.init(jax.random.PRNGKey(0), ids0,
+                        jnp.zeros((B, N), jnp.int32), ids0)["params"]
+    flat, unravel = flatten_params(params)
+    batch = _batch(rng, W, B, N, T, cfg.vocab_size)
+
+    # chunk = 4 tokens per example: any f32 buffer of V columns must
+    # have token dim <= 4, never the full local shard of 16
+    round_fn = jax.jit(build_sp_gpt2_round(cfg, mesh, unravel,
+                                           tokens_per_chunk=4 * B * N))
+    text = round_fn.lower(flat, batch).compile().as_text()
+    full = re.findall(rf"f32\[[0-9,]*{T_local},{cfg.vocab_size}\]",
+                      text)
+    assert not full, f"full-shard vocab logits present: {full[:3]}"
 
 
 def test_sp_round_ragged_examples():
@@ -104,7 +161,8 @@ def test_sp_round_ragged_examples():
     batch["mask"] = jnp.asarray([[1.0, 1.0], [1.0, 0.0]], jnp.float32)
 
     round_fn = jax.jit(build_sp_gpt2_round(cfg, mesh, unravel))
-    agg_sp, loss_sp = round_fn(flat, batch)
+    agg_sp, per_client_sp = round_fn(flat, batch)
+    loss_sp = np.asarray(per_client_sp).sum() / W
 
     # oracle: slice client 1 down to its single real example
     trimmed = {
@@ -149,7 +207,8 @@ def test_sp_round_client_mask():
     batch["mask"] = jnp.asarray([[1.0], [0.0]], jnp.float32)
 
     round_fn = jax.jit(build_sp_gpt2_round(cfg, mesh, unravel))
-    agg_sp, _ = round_fn(flat, batch)
+    agg_sp, per_client_sp = round_fn(flat, batch)
+    assert float(per_client_sp[1]) == 0.0  # masked client reports 0
 
     agg_ref, _ = _dense_oracle(
         cfg, params, flat, unravel,

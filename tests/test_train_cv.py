@@ -116,6 +116,61 @@ class TestFixupLrGroups:
             offset += n
         assert n_scalars > 0
 
+    def test_resnet50_bottleneck_scalars_in_01x_groups(self):
+        """FixupBottleneck declares bias3a/bias3b — every scalar leaf
+        of a (tiny) FixupResNet50 must land in a 0.1x group and every
+        kernel in the 1.0x group (the regex anchoring must not drop
+        the third-conv biases)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.tree_util import keystr, tree_flatten_with_path
+
+        from commefficient_tpu.models import get_model
+        from commefficient_tpu.ops.vec import (flatten_params,
+                                               param_group_indices)
+
+        m = get_model("FixupResNet50")(num_classes=5,
+                                       stage_sizes=(1, 1, 1, 1))
+        p = m.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 64, 64, 3)))["params"]
+        flat, _ = flatten_params(p)
+        bias, scale, other = param_group_indices(
+            p, cv_train.fixup_bias_name, cv_train.fixup_scale_name)
+        leaves, _ = tree_flatten_with_path(p)
+        tenth = set(bias.tolist()) | set(scale.tolist())
+        offset = 0
+        saw_bias3 = False
+        for path, leaf in leaves:
+            n = int(np.prod(leaf.shape)) if leaf.shape else 1
+            span = set(range(offset, offset + n))
+            name = keystr(path)
+            if leaf.size == 1 and "kernel" not in name:
+                assert span <= tenth, f"scalar {name} not 0.1x"
+                saw_bias3 = saw_bias3 or "bias3" in name
+            elif "kernel" in name:
+                assert span.isdisjoint(tenth), f"kernel {name} 0.1x"
+            offset += n
+        assert saw_bias3, "fixture lost its bias3 scalars"
+
+    def test_name_match_anchored_to_leaf_segment(self):
+        """The 0.1x groups match the EXACT final path segment, not a
+        bare substring — a hypothetical parameter whose path merely
+        contains 'bias'/'add'/'scale' must stay in the 1.0x group
+        (round-2 advisor finding)."""
+        for name in ("['FixupBlock_0']['add1a']", "['bias1a']",
+                     "['Dense_0']['bias']", "['bias2']",
+                     "['FixupBottleneck_0']['bias3a']",
+                     "['FixupBottleneck_0']['bias3b']"):
+            assert cv_train.fixup_bias_name(name), name
+        for name in ("['mul']", "['Block_0']['scale']",):
+            assert cv_train.fixup_scale_name(name), name
+        for name in ("['additive_embed']", "['addnorm']['kernel']",
+                     "['bias_corrector']", "['add1a']['kernel']"):
+            assert not cv_train.fixup_bias_name(name), name
+        for name in ("['rescale_factor']", "['scale_mlp']['kernel']",
+                     "['multiplier']", "['mul']['kernel']"):
+            assert not cv_train.fixup_scale_name(name), name
+
     def test_lr_vector_alignment(self):
         """FedOptimizer.get_lr with index groups: each coordinate gets
         its own group's LR (reference cv_train.py:366-376 semantics,
@@ -287,6 +342,82 @@ class TestBatchNormRunningStats:
             np.testing.assert_allclose(
                 np.asarray(upd_pad["batch_stats"][k]),
                 np.asarray(upd_real["batch_stats"][k]), rtol=1e-5)
+
+    def test_recorded_var_unbiased_torch_parity(self):
+        """The RECORDED batch variance carries the Bessel n/(n-1)
+        correction: torch nn.BatchNorm2d normalizes with the biased
+        estimate but updates running_var with the unbiased one, and the
+        server blend claims parity with torch BN eval (round-2 advisor
+        finding). momentum=1.0 makes torch's running_var equal the
+        batch's unbiased var directly."""
+        import jax
+        import jax.numpy as jnp
+        import torch
+
+        from commefficient_tpu.models.norms import BatchStatNorm
+
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 6, 6, 5).astype(np.float32) * 2.0 + 0.7
+
+        norm = BatchStatNorm(track_stats=True)
+        v = norm.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        _, upd = norm.apply(v, jnp.asarray(x),
+                            mutable=["batch_stats"])
+
+        tbn = torch.nn.BatchNorm2d(5, momentum=1.0)
+        tbn.train()
+        with torch.no_grad():
+            tbn(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+        np.testing.assert_allclose(
+            np.asarray(upd["batch_stats"]["var"]),
+            tbn.running_var.numpy(), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(upd["batch_stats"]["mean"]),
+            tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+        # masked path agrees with the unmasked one on an all-real batch
+        _, upd_m = norm.apply(v, jnp.asarray(x),
+                              jnp.ones(4, jnp.float32),
+                              mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(upd_m["batch_stats"]["var"]),
+            tbn.running_var.numpy(), rtol=1e-4)
+
+    def test_resume_from_pre_batchnorm_checkpoint(self, tmp_path):
+        """A checkpoint written without BN running stats (pre-
+        batchnorm format) still restores weights/optimizer state; the
+        stats fall back to fresh init with a warning instead of a
+        hard failure (round-2 advisor finding)."""
+        import json
+        import warnings
+
+        import jax
+
+        from commefficient_tpu.runtime.checkpoint import (
+            load_checkpoint, save_checkpoint)
+
+        model, opt, init_stats = self._setup()
+        self._train_round(model, opt)
+        path = str(tmp_path / "c.npz")
+        save_checkpoint(path, model, opt)
+        # strip the bnstats entries, simulating the older format
+        with np.load(path, allow_pickle=False) as z:
+            kept = {k: z[k] for k in z.files
+                    if not k.startswith("bnstats:")}
+        stripped = str(tmp_path / "old.npz")
+        np.savez(stripped, **kept)
+
+        model2, opt2, _ = self._setup()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            load_checkpoint(stripped, model2, opt2)
+        assert any("running stats" in str(x.message) for x in w)
+        np.testing.assert_array_equal(
+            np.asarray(model2.ps_weights),
+            np.asarray(model.ps_weights))
+        for a, b in zip(jax.tree_util.tree_leaves(model2.model_state),
+                        jax.tree_util.tree_leaves(init_stats)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
 
     def test_checkpoint_roundtrip_carries_stats(self, tmp_path):
         import jax
