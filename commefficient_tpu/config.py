@@ -153,11 +153,15 @@ class Config:
     mesh_shape: Optional[Sequence[int]] = None  # default: all local devices
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # set bfloat16 for MXU throughput
-    # lax.approx_max_k (recall approx_recall) for every top-k
-    # selection: unsketch recovery AND the local_topk/true_topk/
-    # topk_down selections (exact top_k at k=50k over millions of
-    # coords lowers to a full sort on TPU). Missed coordinates stay
-    # in the error accumulators and resurface next round.
+    # lax.approx_max_k (recall approx_recall) for the index-producing
+    # top-k selections: unsketch recovery and the true_topk server
+    # select (exact top_k at k=50k over millions of coords lowers to
+    # a full sort on TPU). Missed coordinates stay in the error
+    # accumulators and resurface next round. The DENSE selections
+    # (local_topk client masking, topk_down) at large d always use
+    # the exact threshold-select path, which is faster than the
+    # approximate sort (ops/topk.py) — this flag no longer affects
+    # them there.
     approx_topk: bool = False
     approx_recall: float = 0.95  # recall target for --approx_topk
     # rounds the host may run ahead of the device before materialising

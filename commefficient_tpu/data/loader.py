@@ -248,13 +248,12 @@ class PersonaFedLoader(_RoundLoaderBase):
 
         def produce():
             try:
-                for round_spec in self.sampler:
+                # the synchronous path's own iterator: skip-guard,
+                # collate and dropout stay defined in ONE place
+                for batch in _RoundLoaderBase.__iter__(self):
                     if stop.is_set():
                         return
-                    if len(round_spec) < self.W:
-                        continue
-                    item = ("batch", self._apply_dropout(
-                        self.collate(round_spec)))
+                    item = ("batch", batch)
                     while not stop.is_set():
                         try:
                             q.put(item, timeout=0.1)

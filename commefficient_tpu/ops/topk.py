@@ -12,6 +12,66 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# Row size at/above which the exact DENSE selection switches from
+# lax.top_k (a full sort at large d on TPU) to the threshold select
+# below: ~3x faster at d = 6.6M, k = 50k on v5e (BENCHMARKS.md).
+# Index-producing selections (topk_values_indices / _with_support)
+# keep lax.top_k: compacting the k set-bit positions out of a (d,)
+# mask is a d-sized scatter that costs more than the sort saves.
+_THRESHOLD_SELECT_MIN_D = 1 << 20
+
+
+def _threshold_topk_mask(sq: jax.Array, k: int) -> jax.Array:
+    """Exact top-k selection MASK of non-negative ``sq`` along the
+    last axis without sorting: binary-search the k-th largest value
+    one bit at a time (non-negative f32 order == unsigned-int order on
+    the bit pattern; 32 masked count-reductions stream the row instead
+    of sorting it), then tie-break equal values by lowest index — the
+    same selected set as ``lax.top_k`` (which also prefers lower
+    indices on ties). Batched over leading axes; returns a boolean
+    mask with exactly k True per row."""
+    shape = sq.shape
+    d = shape[-1]
+    rows = 1
+    for s in shape[:-1]:
+        rows *= s
+    keys = jax.lax.bitcast_convert_type(
+        sq.astype(jnp.float32), jnp.uint32).reshape(rows, d)
+
+    def body(i, thresh):
+        bit = jnp.uint32(31) - i.astype(jnp.uint32)
+        cand = thresh | (jnp.uint32(1) << bit)  # (rows,)
+        cnt = jnp.sum((keys >= cand[:, None]).astype(jnp.int32),
+                      axis=-1)
+        return jnp.where(cnt >= k, cand, thresh)
+
+    # T = k-th largest key per row: count(keys >= T) >= k, and
+    # count(keys >= T + 1ulp) < k
+    t = jax.lax.fori_loop(0, 32, body,
+                          jnp.zeros((rows,), jnp.uint32))
+    gt = keys > t[:, None]
+    eq = keys == t[:, None]
+    need = k - jnp.sum(gt.astype(jnp.int32), -1, keepdims=True)
+    take = gt | (eq & (jnp.cumsum(eq.astype(jnp.int32), -1)
+                       <= need))
+    return take.reshape(shape)
+
+
+def _threshold_topk_idx(sq: jax.Array, k: int) -> jax.Array:
+    """Indices (ascending) of the threshold-select mask — used by
+    tests to check set equivalence with lax.top_k; the hot paths use
+    the mask directly (index compaction is a d-sized scatter)."""
+    take = _threshold_topk_mask(sq, k)
+
+    def row_nonzero(m):
+        return jnp.nonzero(m, size=k, fill_value=0)[0]
+
+    if take.ndim == 1:
+        return row_nonzero(take)
+    flat = take.reshape(-1, take.shape[-1])
+    return jax.vmap(row_nonzero)(flat).reshape(
+        take.shape[:-1] + (k,))
+
 
 def _select_idx(vec: jax.Array, k: int, approx: bool,
                 recall: float) -> jax.Array:
@@ -34,21 +94,33 @@ def topk(vec: jax.Array, k: int, approx: bool = False,
     1-D: global top-k. 2-D: row-wise top-k along the last axis
     (matching torch.topk's dim=-1 default used by the reference).
 
-    ``approx``: use ``lax.approx_max_k`` at the given recall — exact
-    ``top_k`` at k=50k over millions of coords lowers to a full sort
-    on TPU (~88 ms at d=6.6M, the dominant cost of a local_topk
-    round); the approximate selection is the same --approx_topk
-    tradeoff as unsketch recovery (missed coordinates stay in the
-    error accumulator and resurface next round)."""
+    ``approx``: use ``lax.approx_max_k`` at the given recall — the
+    same --approx_topk tradeoff as unsketch recovery (missed
+    coordinates stay in the error accumulator and resurface next
+    round).
+
+    At large rows (>= _THRESHOLD_SELECT_MIN_D) the DENSE selection
+    always uses the exact threshold path — the mask (32 streaming
+    count passes) feeds a ``where``, no sort and no gather/scatter —
+    which measures faster than even ``approx_max_k`` + scatter while
+    being exact (127 → 20 ms for the full local_topk round at ResNet9
+    scale, BENCHMARKS.md). ``approx`` therefore only affects dense
+    selections below the threshold size; the index-producing
+    selections (unsketch recovery) still honor it everywhere."""
     k = min(k, vec.shape[-1])
+    if vec.ndim not in (1, 2):
+        raise ValueError(
+            f"topk supports 1-D/2-D inputs, got ndim={vec.ndim}")
+    if k < vec.shape[-1] \
+            and vec.shape[-1] >= _THRESHOLD_SELECT_MIN_D:
+        take = _threshold_topk_mask(jax.lax.square(vec), k)
+        return jnp.where(take, vec, jnp.zeros_like(vec))
     idx = _select_idx(vec, k, approx, recall)
     if vec.ndim == 1:
         return jnp.zeros_like(vec).at[idx].set(vec[idx], mode="promise_in_bounds")
-    elif vec.ndim == 2:
-        rows = jnp.arange(vec.shape[0])[:, None]
-        return jnp.zeros_like(vec).at[rows, idx].set(
-            vec[rows, idx], mode="promise_in_bounds")
-    raise ValueError(f"topk supports 1-D/2-D inputs, got ndim={vec.ndim}")
+    rows = jnp.arange(vec.shape[0])[:, None]
+    return jnp.zeros_like(vec).at[rows, idx].set(
+        vec[rows, idx], mode="promise_in_bounds")
 
 
 def topk_values_indices(vec: jax.Array, k: int, approx: bool = False,

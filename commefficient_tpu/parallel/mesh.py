@@ -79,7 +79,11 @@ def maybe_initialize_multihost_cli(args) -> None:
     """Trainer-CLI wiring: join the multi-controller runtime when the
     pod flags (--coordinator_address/--num_processes/--process_id) are
     present. Shared by cv_train and gpt2_train."""
-    if args.coordinator_address is None and args.num_processes is None:
+    if args.coordinator_address is None and args.num_processes is None \
+            and args.process_id is None:
+        # --process_id alone still initializes (and surfaces
+        # initialize_multihost's error if the rest can't be detected)
+        # rather than silently training alone
         return
     pid = initialize_multihost(args.coordinator_address,
                                args.num_processes, args.process_id)

@@ -47,6 +47,59 @@ class TestTopk:
         np.testing.assert_allclose(f(v), topk(v, 3))
 
 
+class TestThresholdSelect:
+    """The exact large-d selection path (_threshold_topk_idx, engaged
+    above _THRESHOLD_SELECT_MIN_D): 32 masked count-reductions instead
+    of a full sort, same selected SET as lax.top_k including the
+    lowest-index tie-break."""
+
+    def test_matches_lax_top_k_set(self):
+        from commefficient_tpu.ops.topk import _threshold_topk_idx
+        rng = np.random.RandomState(1)
+        for d, k in ((4096, 1), (4096, 64), (4096, 4095),
+                     (50000, 2000)):
+            x = rng.randn(d).astype(np.float32)
+            x[rng.randint(0, d, 32)] = 2.5  # magnitude ties
+            x[rng.randint(0, d, 32)] = 0.0
+            sq = jnp.square(jnp.asarray(x))
+            want = set(np.asarray(jax.lax.top_k(sq, k)[1]).tolist())
+            got = np.asarray(_threshold_topk_idx(sq, k))
+            assert len(set(got.tolist())) == k
+            assert set(got.tolist()) == want, (d, k)
+
+    def test_batched_and_vmapped(self):
+        from commefficient_tpu.ops.topk import _threshold_topk_idx
+        rng = np.random.RandomState(2)
+        sq = jnp.square(jnp.asarray(
+            rng.randn(3, 8192).astype(np.float32)))
+        want = np.asarray(jax.lax.top_k(sq, 100)[1])
+        for got in (np.asarray(_threshold_topk_idx(sq, 100)),
+                    np.asarray(jax.vmap(
+                        lambda s: _threshold_topk_idx(s, 100))(sq))):
+            for r in range(3):
+                assert set(got[r]) == set(want[r]), r
+
+    def test_all_equal_ties_pick_lowest_indices(self):
+        from commefficient_tpu.ops.topk import _threshold_topk_idx
+        idx = np.asarray(_threshold_topk_idx(
+            jnp.ones(5000, jnp.float32), 7))
+        assert idx.tolist() == list(range(7))
+
+    def test_engaged_above_threshold_d(self):
+        """topk at d >= _THRESHOLD_SELECT_MIN_D goes through the
+        threshold path and still keeps exactly the k largest."""
+        from commefficient_tpu.ops.topk import _THRESHOLD_SELECT_MIN_D
+        d = _THRESHOLD_SELECT_MIN_D
+        rng = np.random.RandomState(3)
+        v = rng.randn(d).astype(np.float32)
+        out = np.asarray(topk(jnp.asarray(v), 500))
+        nz = np.nonzero(out)[0]
+        assert len(nz) == 500
+        np.testing.assert_array_equal(out[nz], v[nz])
+        thresh = np.partition(np.abs(v), -500)[-500]
+        assert np.all(np.abs(out[nz]) >= thresh)
+
+
 class TestClip:
     def test_noop_below_clip(self):
         v = jnp.array([0.3, 0.4])  # norm 0.5
