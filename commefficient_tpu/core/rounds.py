@@ -442,11 +442,13 @@ def build_server_round(cfg: Config) -> Callable:
     aggregated, lr, client_velocities, client_ids, noise_rng) ->
     (new_ps_weights, new_server_state, new_client_velocities,
     weight_update, support)``. ``support`` is ((k,) indices, (k,)
-    values) of the update for k-sparse modes, None for dense modes —
-    it lets the host-side download accounting avoid ever transferring
-    the dense update. ``weight_update`` is None on the large-d sparse
-    sketch path (prefer_sparse_resketch): the update was applied as a
-    k-sized scatter and only ``support`` carries its values.
+    values) of the update on the index path, ``{"bitmap": packed
+    uint8}`` on the exact threshold-select path (see ServerUpdate),
+    None for dense modes — it lets the host-side download accounting
+    avoid ever transferring the dense update. ``weight_update`` is
+    None on the large-d sparse sketch path (prefer_sparse_resketch):
+    the update was applied as a k-sized scatter and only ``support``
+    (tuple form there) carries its values.
 
     Covers FedOptimizer.step (fed_aggregator.py:431-460) including
     true_topk's masking of participating clients' local velocities at

@@ -15,7 +15,7 @@ client-transmit sum divided by the round's total datapoint count
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -48,11 +48,25 @@ class ServerUpdate(NamedTuple):
     # true_topk's momentum factor masking of *client* velocities
     # (fed_aggregator.py:530-535); None for other modes
     client_velocity_keep: Optional[jax.Array]
-    # sparse support of the update for k-sparse modes: ((k,) indices,
-    # (k,) values). None means dense (every coordinate may have
-    # changed) — the host-side download accounting then never needs
-    # the dense update shipped off device
-    support: Optional[Tuple[jax.Array, jax.Array]] = None
+    # support of the update for download accounting, in one of two
+    # forms: ((k,) indices, (k,) lr-scaled values) on the index path
+    # (also consumed for the sparse k-sized weight scatter when
+    # weight_update is None), or {"bitmap": packed uint8} of the
+    # lr-scaled update's nonzeros on the threshold-select path. None
+    # means dense (every coordinate may have changed). Either way the
+    # host never needs the dense update shipped off device.
+    support: Optional[Union[Tuple[jax.Array, jax.Array],
+                            dict]] = None
+
+
+def _use_threshold_select(cfg: Config) -> bool:
+    """Exact dense-mode selections (true_topk) at large d go through
+    the threshold-select mask instead of the lax.top_k sort — same
+    selected set, no sort, no index scatter. Gating is the shared
+    predicate in ops/topk.py."""
+    from commefficient_tpu.ops.topk import use_threshold_select
+    return use_threshold_select(min(cfg.k, cfg.grad_size),
+                                cfg.grad_size, cfg.approx_topk)
 
 
 def _lr_scaled_support(idx, vals, lr):
@@ -114,10 +128,21 @@ def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
     Vvel = gradient + cfg.virtual_momentum * state.Vvelocity
     Verr = state.Verror + Vvel
 
-    update, idx, vals = topk_with_support(Verr,
-                                          min(cfg.k, cfg.grad_size),
-                                          approx=cfg.approx_topk,
-                                          recall=cfg.approx_recall)
+    k = min(cfg.k, cfg.grad_size)
+    if _use_threshold_select(cfg):
+        # exact selection without the large-d sort (ops/topk.py):
+        # the update stays dense end-to-end and accounting takes the
+        # bit-packed support of the LR-SCALED update — same value-
+        # compare semantics as _lr_scaled_support (lr==0 coordinates
+        # read as unchanged)
+        from commefficient_tpu.ops.topk import _threshold_topk_mask
+        mask = _threshold_topk_mask(jax.lax.square(Verr), k)
+        update = jnp.where(mask, Verr, 0.0)
+        support = {"bitmap": jnp.packbits((update * lr) != 0)}
+    else:
+        update, idx, vals = topk_with_support(
+            Verr, k, approx=cfg.approx_topk, recall=cfg.approx_recall)
+        support = _lr_scaled_support(idx, vals, lr)
     keep = update == 0
     # error feedback + momentum factor masking at transmitted coords
     Verr = jnp.where(keep, Verr, 0.0)
@@ -127,7 +152,7 @@ def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
     # optimizer via globals; here the mask travels in the result —
     # avoiding the reference's latent unset-global bug, SURVEY.md §2.1)
     return ServerUpdate(update * lr, ServerState(Vvel, Verr), keep,
-                        _lr_scaled_support(idx, vals, lr))
+                        support)
 
 
 def _local_topk(cfg, local_topk_grad, state, lr, sketch, noise_rng):
@@ -163,11 +188,21 @@ def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng):
     # At large d the k-sparse form wins everywhere: re-sketching the
     # recovered update costs O(r*k) scatter-adds instead of the O(d)
     # dense kernel (~8 ms -> ~1.5 ms at GPT-2 124M), and the dense
-    # (d,) update itself is never materialised (with_dense=False)
+    # (d,) update itself is never materialised (with_dense=False).
+    # In the dense regime, exact recovery uses the threshold-select
+    # mask instead of the top-k sort (22.3 -> ~11 ms full round at
+    # ResNet9 scale, BENCHMARKS.md).
     sparse = sketch.prefer_sparse_resketch(cfg.k)
-    update, idx, vals = sketch.unsketch(Verr, k=cfg.k,
-                                        with_support=True,
-                                        with_dense=not sparse)
+    if sketch.prefer_threshold_unsketch(cfg.k):  # implies not sparse
+        update, _ = sketch.unsketch_dense_mask(Verr, k=cfg.k)
+        # bit-packed support of the LR-scaled update: same value-
+        # compare semantics as _lr_scaled_support
+        support = {"bitmap": jnp.packbits((update * lr) != 0)}
+    else:
+        update, idx, vals = sketch.unsketch(Verr, k=cfg.k,
+                                            with_support=True,
+                                            with_dense=not sparse)
+        support = _lr_scaled_support(idx, vals, lr)
 
     # re-sketch the recovered update to find which table buckets it
     # occupies (fed_aggregator.py:595-597)
@@ -191,6 +226,6 @@ def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng):
         # k-sized scatter of the (already lr-scaled) support instead
         # of materialising the dense (d,) vector
         return ServerUpdate(None, ServerState(Vvel, Verr), None,
-                            _lr_scaled_support(idx, vals, lr))
+                            support)
     return ServerUpdate(update * lr, ServerState(Vvel, Verr), None,
-                        _lr_scaled_support(idx, vals, lr))
+                        support)

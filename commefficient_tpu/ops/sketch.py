@@ -327,6 +327,32 @@ class CountSketch:
             return dense, idx, vals
         return dense
 
+    def unsketch_dense_mask(self, table: jax.Array, k: int):
+        """Exact dense unsketch without the top-k sort: the
+        threshold-select mask (ops/topk.py, 32 streaming count passes)
+        keeps the k largest-magnitude estimates via a ``where`` — no
+        sort, no index gather/scatter. Returns ``(dense, mask)``;
+        use where the consumer never needs the (k,) index form (the
+        dense-regime server step; download accounting takes the
+        bit-packed mask). Selection set is identical to ``unsketch``'s
+        exact path (lowest-index tie-break, tested)."""
+        from commefficient_tpu.ops.topk import _threshold_topk_mask
+        k = min(k, self.d)
+        est = self.estimates(table)
+        mask = _threshold_topk_mask(jax.lax.square(est), k)
+        return jnp.where(mask, est, 0.0), mask
+
+    def prefer_threshold_unsketch(self, k: int) -> bool:
+        """Dense-regime exact recovery via the threshold mask: wins
+        once d is large enough that lax.top_k lowers to an expensive
+        full sort (~13 ms extra per round at ResNet9's d=6.6M,
+        BENCHMARKS.md). Approximate recovery (approx_topk) stays on
+        the index path — approx_max_k is cheaper than the 32 count
+        passes; and the sparse-resketch regime needs indices anyway."""
+        from commefficient_tpu.ops.topk import use_threshold_select
+        return (use_threshold_select(k, self.d, self.approx_topk)
+                and not self.prefer_sparse_resketch(k))
+
     def sketch_sparse(self, idx: jax.Array,
                       vals: jax.Array) -> jax.Array:
         """(n,) int32 indices + (n,) values -> (r, c) table, identical

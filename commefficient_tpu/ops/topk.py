@@ -21,6 +21,15 @@ import jax.numpy as jnp
 _THRESHOLD_SELECT_MIN_D = 1 << 20
 
 
+def use_threshold_select(k: int, d: int, approx: bool) -> bool:
+    """The ONE gating predicate for the exact threshold-select path
+    (shared by the dense ``topk`` here, the server helpers and
+    ``CountSketch.prefer_threshold_unsketch`` — keep them from
+    drifting): exact selection, genuine selection (k < d), and a row
+    large enough that lax.top_k's sort lowering loses."""
+    return not approx and k < d and d >= _THRESHOLD_SELECT_MIN_D
+
+
 def _threshold_topk_mask(sq: jax.Array, k: int) -> jax.Array:
     """Exact top-k selection MASK of non-negative ``sq`` along the
     last axis without sorting: binary-search the k-th largest value

@@ -449,6 +449,120 @@ class TestSparseServerUpdate:
                                    rtol=1e-5, atol=1e-6)
 
 
+class TestThresholdServerSelect:
+    """The exact large-d server selections (sketch dense-regime
+    unsketch, true_topk) via the threshold mask: same weights, state
+    and CHANGED-COORDS support as the lax.top_k index path they
+    replace (the support switches form, (idx, vals) -> bitmap)."""
+
+    def _support_set(self, support, d):
+        if isinstance(support, dict):
+            bits = np.unpackbits(np.asarray(support["bitmap"]))[:d]
+            return set(np.nonzero(bits)[0].tolist())
+        idx = np.asarray(support[0])
+        vals = np.asarray(support[1])
+        return set(idx[vals != 0].tolist())
+
+    def test_sketched_threshold_equals_topk_path(self, monkeypatch):
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.core.rounds import build_server_round
+        from commefficient_tpu.core.server import ServerState
+        import importlib
+        topk_mod = importlib.import_module(
+            "commefficient_tpu.ops.topk")
+
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     num_workers=2, local_batch_size=2, num_clients=4,
+                     dataset_name="CIFAR10", seed=0, k=16,
+                     num_rows=3, num_cols=256, num_blocks=1,
+                     grad_size=4096)
+        rng = np.random.RandomState(3)
+        ps = jnp.asarray(rng.randn(cfg.grad_size).astype(np.float32))
+        table = jnp.asarray(
+            rng.randn(cfg.num_rows, cfg.num_cols).astype(np.float32))
+        ss = ServerState.init(cfg)
+
+        def run(min_d):
+            monkeypatch.setattr(topk_mod,
+                                "_THRESHOLD_SELECT_MIN_D", min_d)
+            fn = build_server_round(cfg)
+            new_ps, new_ss, _, upd, support = fn(
+                ps, ss, table, jnp.float32(0.05))
+            return (np.asarray(new_ps), np.asarray(new_ss.Vvelocity),
+                    np.asarray(new_ss.Verror), support)
+
+        ps_t, vv_t, ve_t, sup_t = run(1)        # threshold engaged
+        ps_s, vv_s, ve_s, sup_s = run(1 << 60)  # top_k path
+        assert isinstance(sup_t, dict) and not isinstance(sup_s, dict)
+        np.testing.assert_allclose(ps_t, ps_s, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(vv_t, vv_s, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(ve_t, ve_s, rtol=1e-6, atol=1e-7)
+        assert self._support_set(sup_t, cfg.grad_size) \
+            == self._support_set(sup_s, cfg.grad_size)
+
+    def test_true_topk_threshold_equals_topk_path(self, monkeypatch):
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.core.rounds import build_server_round
+        from commefficient_tpu.core.server import ServerState
+        import importlib
+        topk_mod = importlib.import_module(
+            "commefficient_tpu.ops.topk")
+
+        cfg = Config(mode="true_topk", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     num_workers=2, local_batch_size=2, num_clients=4,
+                     dataset_name="CIFAR10", seed=0, k=16,
+                     grad_size=4096)
+        rng = np.random.RandomState(4)
+        ps = jnp.asarray(rng.randn(cfg.grad_size).astype(np.float32))
+        grad = jnp.asarray(rng.randn(cfg.grad_size).astype(np.float32))
+        ss = ServerState.init(cfg)
+
+        def run(min_d):
+            monkeypatch.setattr(topk_mod,
+                                "_THRESHOLD_SELECT_MIN_D", min_d)
+            fn = build_server_round(cfg)
+            new_ps, new_ss, _, upd, support = fn(
+                ps, ss, grad, jnp.float32(0.05))
+            return (np.asarray(new_ps), np.asarray(new_ss.Vvelocity),
+                    np.asarray(new_ss.Verror), support)
+
+        ps_t, vv_t, ve_t, sup_t = run(1)
+        ps_s, vv_s, ve_s, sup_s = run(1 << 60)
+        assert isinstance(sup_t, dict) and not isinstance(sup_s, dict)
+        np.testing.assert_allclose(ps_t, ps_s, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(vv_t, vv_s, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(ve_t, ve_s, rtol=1e-6, atol=1e-7)
+        assert self._support_set(sup_t, cfg.grad_size) \
+            == self._support_set(sup_s, cfg.grad_size)
+
+    def test_zero_lr_bitmap_marks_nothing(self, monkeypatch):
+        """lr == 0: the bit-packed support must read all-unchanged,
+        matching the value-compare on update * lr."""
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.core.rounds import build_server_round
+        from commefficient_tpu.core.server import ServerState
+        import importlib
+        topk_mod = importlib.import_module(
+            "commefficient_tpu.ops.topk")
+
+        monkeypatch.setattr(topk_mod, "_THRESHOLD_SELECT_MIN_D", 1)
+        cfg = Config(mode="true_topk", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.0,
+                     num_workers=2, local_batch_size=2, num_clients=4,
+                     dataset_name="CIFAR10", seed=0, k=16,
+                     grad_size=1024)
+        rng = np.random.RandomState(5)
+        fn = build_server_round(cfg)
+        *_, support = fn(
+            jnp.asarray(rng.randn(1024).astype(np.float32)),
+            ServerState.init(cfg),
+            jnp.asarray(rng.randn(1024).astype(np.float32)),
+            jnp.float32(0.0))
+        assert self._support_set(support, 1024) == set()
+
+
 class TestFedavgInitialLr:
     def test_round_before_first_step_transmits_nothing(self):
         """The fedavg local-SGD LR must start at ZERO like the
