@@ -313,7 +313,16 @@ class CountSketch:
                 jax.lax.square(est), k,
                 recall_target=self.approx_recall)
         else:
-            _, idx = jax.lax.top_k(jax.lax.square(est), k)
+            from commefficient_tpu.ops.topk import (
+                threshold_topk_indices, use_threshold_select)
+            if use_threshold_select(k, self.d, False):
+                # exact selection without the full sort: at GPT-2's
+                # d=124M lax.top_k costs 461.9 ms vs 103.2 ms for the
+                # threshold + hierarchical extraction (BENCHMARKS.md)
+                idx = threshold_topk_indices(
+                    jax.lax.square(est), k)
+            else:
+                _, idx = jax.lax.top_k(jax.lax.square(est), k)
         vals = est[idx]
         if not with_dense:
             # support-only form: at large d the dense (d,) scatter is

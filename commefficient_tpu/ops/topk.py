@@ -12,12 +12,15 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-# Row size at/above which the exact DENSE selection switches from
-# lax.top_k (a full sort at large d on TPU) to the threshold select
-# below: ~3x faster at d = 6.6M, k = 50k on v5e (BENCHMARKS.md).
-# Index-producing selections (topk_values_indices / _with_support)
-# keep lax.top_k: compacting the k set-bit positions out of a (d,)
-# mask is a d-sized scatter that costs more than the sort saves.
+# Row size at/above which exact selection leaves lax.top_k (a full
+# sort at large d on TPU). Current routing: DENSE selections use the
+# threshold MASK + where (~3x at d = 6.6M, k = 50k on v5e); 1-D exact
+# INDEX selection (unsketch recovery) uses the mask + hierarchical
+# extraction (461.9 -> 103.2 ms at d = 124M — a naive jnp.nonzero
+# compaction would be a d-sized scatter and lose to the sort, the
+# blocked-cumsum extraction does not). Only batched index selections
+# and approx_max_k requests remain on the XLA primitives. Numbers:
+# BENCHMARKS.md, runs/exact_select.log.
 _THRESHOLD_SELECT_MIN_D = 1 << 20
 
 
@@ -28,6 +31,25 @@ def use_threshold_select(k: int, d: int, approx: bool) -> bool:
     drifting): exact selection, genuine selection (k < d), and a row
     large enough that lax.top_k's sort lowering loses."""
     return not approx and k < d and d >= _THRESHOLD_SELECT_MIN_D
+
+
+def _blocked_cumsum(x: jax.Array, block: int = 1024) -> jax.Array:
+    """Inclusive cumsum along the last axis via intra-block scans plus
+    block-offset scans. XLA's flat cumsum over tens of millions of
+    elements lowers to a multi-pass scan (~60 ms at d = 124M on v5e);
+    the blocked form runs one short vectorized scan over (B, block)
+    plus a tiny scan over B (~6 ms). Exact same values."""
+    *lead, d = x.shape
+    pad = (-d) % block
+    xp = jnp.pad(x, [(0, 0)] * len(lead) + [(0, pad)])
+    xb = xp.reshape(tuple(lead) + (-1, block))
+    intra = jnp.cumsum(xb, axis=-1)
+    offs = jnp.cumsum(intra[..., -1], axis=-1)
+    offs = jnp.concatenate(
+        [jnp.zeros_like(offs[..., :1]), offs[..., :-1]], axis=-1)
+    out = (intra + offs[..., None]).reshape(
+        tuple(lead) + (d + pad,))
+    return out[..., :d]
 
 
 def _threshold_topk_mask(sq: jax.Array, k: int) -> jax.Array:
@@ -61,7 +83,7 @@ def _threshold_topk_mask(sq: jax.Array, k: int) -> jax.Array:
     gt = keys > t[:, None]
     eq = keys == t[:, None]
     need = k - jnp.sum(gt.astype(jnp.int32), -1, keepdims=True)
-    take = gt | (eq & (jnp.cumsum(eq.astype(jnp.int32), -1)
+    take = gt | (eq & (_blocked_cumsum(eq.astype(jnp.int32))
                        <= need))
     return take.reshape(shape)
 
@@ -69,7 +91,8 @@ def _threshold_topk_mask(sq: jax.Array, k: int) -> jax.Array:
 def _threshold_topk_idx(sq: jax.Array, k: int) -> jax.Array:
     """Indices (ascending) of the threshold-select mask — used by
     tests to check set equivalence with lax.top_k; the hot paths use
-    the mask directly (index compaction is a d-sized scatter)."""
+    the mask directly (``jnp.nonzero`` compaction is a d-sized
+    scatter) or the hierarchical extraction below."""
     take = _threshold_topk_mask(sq, k)
 
     def row_nonzero(m):
@@ -80,6 +103,34 @@ def _threshold_topk_idx(sq: jax.Array, k: int) -> jax.Array:
     flat = take.reshape(-1, take.shape[-1])
     return jax.vmap(row_nonzero)(flat).reshape(
         take.shape[:-1] + (k,))
+
+
+def threshold_topk_indices(sq: jax.Array, k: int,
+                           block: int = 1024) -> jax.Array:
+    """Exact top-k INDICES (ascending) of non-negative 1-D ``sq``
+    without sorting and without a d-sized scatter: the threshold mask
+    (32 streaming count passes) followed by hierarchical compaction —
+    blockwise cumsums locate each output slot's block (searchsorted
+    over block totals) and its column (argmax over the gathered block
+    cumsum row). O(d) streaming + O(k·block) gather work, vs
+    lax.top_k's full sort: 461.9 -> 103.2 ms at d = 124M, k = 50k on
+    v5e — the selection behind exact unsketch recovery at GPT-2
+    scale (BENCHMARKS.md, runs/exact_select.log). Same selected set
+    as lax.top_k, including the lowest-index tie-break."""
+    assert sq.ndim == 1, "hierarchical extraction is 1-D"
+    d = sq.shape[0]
+    take = _threshold_topk_mask(sq, k)  # exactly k set bits
+    pad = (-d) % block
+    bits = jnp.pad(take, (0, pad)).reshape(-1, block)
+    intra = jnp.cumsum(bits.astype(jnp.int32), axis=-1)  # (B, block)
+    cum = jnp.cumsum(intra[:, -1])  # inclusive block totals (B,)
+    slots = jnp.arange(k, dtype=jnp.int32)
+    b = jnp.searchsorted(cum, slots, side="right").astype(jnp.int32)
+    offs = cum[b] - intra[b, -1]  # exclusive offset of block b
+    j = slots - offs  # rank within block, 0-based
+    rows = intra[b]  # (k, block) gather
+    col = jnp.argmax(rows > j[:, None], axis=1).astype(jnp.int32)
+    return b * block + col
 
 
 def _select_idx(vec: jax.Array, k: int, approx: bool,

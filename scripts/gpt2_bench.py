@@ -41,7 +41,8 @@ def build(args):
                  weight_decay=0.0, num_workers=args.clients,
                  local_batch_size=args.examples, k=50000, num_rows=5,
                  num_cols=524288, num_blocks=20,
-                 dataset_name="PERSONA", seed=21, approx_topk=True,
+                 dataset_name="PERSONA", seed=21,
+                 approx_topk=not args.exact,
                  approx_recall=0.95, num_candidates=args.candidates,
                  lm_coef=1.0, mc_coef=1.0)
 
@@ -205,6 +206,9 @@ def main():
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--exact", action="store_true",
+                    help="exact top-k selection (the trainer default) "
+                         "instead of approx_max_k 0.95")
     ap.add_argument("--mode", default="sketch")
     ap.add_argument("--profile", type=str, default=None)
     args = ap.parse_args()

@@ -85,6 +85,65 @@ class TestThresholdSelect:
             jnp.ones(5000, jnp.float32), 7))
         assert idx.tolist() == list(range(7))
 
+    def test_hierarchical_indices_match_lax_top_k(self):
+        """threshold_topk_indices (blocked-cumsum compaction, the
+        sortless exact selection behind large-d unsketch recovery):
+        same selected set as lax.top_k, ascending order, exact k."""
+        from commefficient_tpu.ops.topk import threshold_topk_indices
+        rng = np.random.RandomState(6)
+        for d, k in ((5000, 17), (5000, 1), (100000, 5000),
+                     (3000, 2999)):
+            x = rng.randn(d).astype(np.float32)
+            x[rng.randint(0, d, 60)] = 1.5  # ties
+            x[rng.randint(0, d, 60)] = 0.0
+            sq = jnp.square(jnp.asarray(x))
+            got = np.asarray(threshold_topk_indices(sq, k))
+            want = set(np.asarray(jax.lax.top_k(sq, k)[1]).tolist())
+            assert len(set(got.tolist())) == k
+            assert set(got.tolist()) == want, (d, k)
+            assert (np.diff(got) > 0).all()
+        # all-equal ties: lowest k indices
+        gi = np.asarray(threshold_topk_indices(
+            jnp.ones(5000, jnp.float32), 7))
+        assert gi.tolist() == list(range(7))
+
+    def test_blocked_cumsum_exact_on_ints(self):
+        from commefficient_tpu.ops.topk import _blocked_cumsum
+        rng = np.random.RandomState(7)
+        x = rng.randint(0, 3, (3, 5000)).astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(_blocked_cumsum(jnp.asarray(x))),
+            np.cumsum(x, -1))
+
+    def test_unsketch_exact_uses_threshold_path(self, monkeypatch):
+        """CountSketch.unsketch's exact selection at large d (here
+        forced via the threshold override) recovers the same support
+        as lax.top_k of the estimates — compared directly against
+        lax.top_k, not against a second unsketch call (jit would
+        serve the first trace from cache and make that vacuous)."""
+        import importlib
+
+        from commefficient_tpu.ops.sketch import CountSketch
+        topk_mod = importlib.import_module(
+            "commefficient_tpu.ops.topk")
+
+        cs = CountSketch(d=4096, c=256, r=3)
+        rng = np.random.RandomState(8)
+        table = jnp.asarray(rng.randn(3, 256).astype(np.float32))
+
+        monkeypatch.setattr(topk_mod, "_THRESHOLD_SELECT_MIN_D", 1)
+        dense_t, idx_t, vals_t = cs.unsketch(table, 16,
+                                             with_support=True)
+        est = cs.estimates(table)
+        _, idx_want = jax.lax.top_k(jnp.square(est), 16)
+        assert set(np.asarray(idx_t).tolist()) \
+            == set(np.asarray(idx_want).tolist())
+        np.testing.assert_allclose(
+            np.asarray(vals_t),
+            np.asarray(est)[np.asarray(idx_t)], rtol=1e-6)
+        nz = np.nonzero(np.asarray(dense_t))[0]
+        assert set(nz.tolist()) <= set(np.asarray(idx_t).tolist())
+
     def test_engaged_above_threshold_d(self):
         """topk at d >= _THRESHOLD_SELECT_MIN_D goes through the
         threshold path and still keeps exactly the k largest."""
