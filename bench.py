@@ -42,8 +42,13 @@ def main():
                  virtual_momentum=0.9, weight_decay=5e-4,
                  num_workers=W, local_batch_size=B,
                  k=50000, num_rows=5, num_cols=524288, num_blocks=20,
-                 dataset_name="CIFAR10", seed=21, approx_topk=True,
-                 approx_recall=0.95)
+                 dataset_name="CIFAR10", seed=21,
+                 # EXACT selection: since round 3 the threshold-select
+                 # path (nibble search + fused Pallas take-mask,
+                 # ops/topk.py) makes exact recovery FASTER than
+                 # approx_max_k at this scale (6.5 vs 9.4 ms/round) —
+                 # the headline runs the reference-parity default
+                 approx_topk=False)
 
     module = get_model("ResNet9")(num_classes=10, dtype=jnp.bfloat16)
     params = module.init(jax.random.PRNGKey(0),

@@ -135,8 +135,8 @@ def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
         # bit-packed support of the LR-SCALED update — same value-
         # compare semantics as _lr_scaled_support (lr==0 coordinates
         # read as unchanged)
-        from commefficient_tpu.ops.topk import _threshold_topk_mask
-        mask = _threshold_topk_mask(jax.lax.square(Verr), k)
+        from commefficient_tpu.ops.topk import threshold_topk_mask_1d
+        mask = threshold_topk_mask_1d(jax.lax.square(Verr), k)
         update = jnp.where(mask, Verr, 0.0)
         support = {"bitmap": jnp.packbits((update * lr) != 0)}
     else:

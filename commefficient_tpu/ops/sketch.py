@@ -345,10 +345,10 @@ class CountSketch:
         dense-regime server step; download accounting takes the
         bit-packed mask). Selection set is identical to ``unsketch``'s
         exact path (lowest-index tie-break, tested)."""
-        from commefficient_tpu.ops.topk import _threshold_topk_mask
+        from commefficient_tpu.ops.topk import threshold_topk_mask_1d
         k = min(k, self.d)
         est = self.estimates(table)
-        mask = _threshold_topk_mask(jax.lax.square(est), k)
+        mask = threshold_topk_mask_1d(jax.lax.square(est), k)
         return jnp.where(mask, est, 0.0), mask
 
     def prefer_threshold_unsketch(self, k: int) -> bool:

@@ -88,6 +88,75 @@ def _threshold_topk_mask(sq: jax.Array, k: int) -> jax.Array:
     return take.reshape(shape)
 
 
+def _nibble_threshold_key(keys: jax.Array, k: int) -> jax.Array:
+    """k-th largest uint32 key of 1-D ``keys`` by an 8-pass 4-bit
+    radix search (vs 32 single-bit passes): each pass histograms the
+    current nibble among prefix-matching elements in one streamed
+    read — same T as the bit search (tested), ~40% less search
+    traffic at d = 124M."""
+
+    def body(i, carry):
+        t, remaining = carry
+        shift = jnp.uint32(28) - 4 * i.astype(jnp.uint32)
+        # prefix compare as two shifts of <= 28 and 4 bits — a single
+        # shift by (shift + 4) would be a shift-by-32 on pass 0,
+        # implementation-defined; this form is well-defined and yields
+        # the correct all-match on the empty pass-0 prefix
+        match = (((keys ^ t) >> shift) >> 4) == 0
+        nib = (keys >> shift) & 15
+        counts = jnp.stack([
+            jnp.sum((match & (nib == b)).astype(jnp.int32))
+            for b in range(16)])
+        suffix = jnp.cumsum(counts[::-1])[::-1]  # count(nib >= b)
+        ge = suffix >= remaining
+        b = jnp.max(jnp.where(ge, jnp.arange(16), 0)).astype(jnp.uint32)
+        above = jnp.where(b < 15, suffix[jnp.minimum(b + 1, 15)], 0)
+        return (t | (b << shift), remaining - above)
+
+    t, _ = jax.lax.fori_loop(0, 8, body,
+                             (jnp.uint32(0), jnp.int32(k)))
+    return t
+
+
+def _take_from_threshold_1d(keys: jax.Array, t: jax.Array,
+                            need) -> jax.Array:
+    """take = (> t) ∪ (first ``need`` == t in index order) — the ONE
+    XLA construction of the tie-broken mask (the Pallas kernel and
+    the batched mask implement the same rule; equivalence-tested)."""
+    gt = keys > t
+    eq = keys == t
+    return gt | (eq & (_blocked_cumsum(eq.astype(jnp.int32))
+                       <= need))
+
+
+def threshold_topk_mask_1d(sq: jax.Array, k: int, *,
+                           interpret: bool = False,
+                           force_xla: bool = False) -> jax.Array:
+    """Fast 1-D exact threshold mask for the server-side selections
+    (never vmapped): nibble radix search for the k-th largest key,
+    then — on TPU — the fused Pallas take-mask kernel (one streamed
+    read + int8 write instead of the XLA path's several (d,)-sized
+    intermediates; ops/topk_pallas.py). Falls back to the generic
+    XLA mask elsewhere. Same exactly-k, lowest-index-tie-break
+    semantics (equivalence-tested; ``interpret``/``force_xla`` are
+    test hooks selecting the branch explicitly)."""
+    assert sq.ndim == 1
+    d = sq.shape[0]
+    keys = jax.lax.bitcast_convert_type(
+        sq.astype(jnp.float32), jnp.uint32)
+    t = _nibble_threshold_key(keys, k)
+    from commefficient_tpu.ops import topk_pallas
+    platform = jax.devices()[0].platform
+    use_pallas = (interpret or platform in ("tpu", "axon")) \
+        and topk_pallas.supported(d) and not force_xla
+    need = k - jnp.sum((keys > t).astype(jnp.int32))
+    if use_pallas:
+        return topk_pallas.take_mask_pallas(
+            sq.astype(jnp.float32), t.reshape(1), need.reshape(1),
+            interpret=interpret)
+    return _take_from_threshold_1d(keys, t, need)
+
+
 def _threshold_topk_idx(sq: jax.Array, k: int) -> jax.Array:
     """Indices (ascending) of the threshold-select mask — used by
     tests to check set equivalence with lax.top_k; the hot paths use
@@ -119,7 +188,7 @@ def threshold_topk_indices(sq: jax.Array, k: int,
     as lax.top_k, including the lowest-index tie-break."""
     assert sq.ndim == 1, "hierarchical extraction is 1-D"
     d = sq.shape[0]
-    take = _threshold_topk_mask(sq, k)  # exactly k set bits
+    take = threshold_topk_mask_1d(sq, k)  # exactly k set bits
     pad = (-d) % block
     bits = jnp.pad(take, (0, pad)).reshape(-1, block)
     intra = jnp.cumsum(bits.astype(jnp.int32), axis=-1)  # (B, block)
