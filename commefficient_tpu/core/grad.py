@@ -98,8 +98,13 @@ def make_forward_grad(cfg: Config,
             n_metrics = len(loss_fn(params_flat,
                                     jax.tree_util.tree_map(
                                         lambda v: v[:1], batch))[1]) + 1
-            init = (jnp.zeros(cfg.grad_size, jnp.float32),
-                    tuple(jnp.zeros(()) for _ in range(n_metrics)))
+            # zero init tied to the batch (x*0 of a batch-derived
+            # scalar): under shard_map a plain-zeros carry lacks the
+            # body output's varying mesh axes (the gradient depends on
+            # the client-sharded batch) and trips the scan carry check
+            z = 0.0 * _masked_count(batch)
+            init = (jnp.zeros(cfg.grad_size, jnp.float32) + z,
+                    tuple(jnp.zeros(()) + z for _ in range(n_metrics)))
             (g, weighted), _ = jax.lax.scan(body, init, chunked)
 
         batch_size = _masked_count(batch)

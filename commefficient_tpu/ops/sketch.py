@@ -249,7 +249,11 @@ class CountSketch:
                 chunk, o = inp
                 return acc + jnp.roll(chunk, o), None
 
-            out, _ = jax.lax.scan(body, jnp.zeros(c, jnp.float32),
+            # zero init derived from the input (x*0), not jnp.zeros:
+            # under shard_map (a per-client sketch inside a spanning
+            # mesh) a plain-zeros carry lacks the body output's
+            # varying mesh axes and trips the scan carry-type check
+            out, _ = jax.lax.scan(body, signed[0] * 0.0,
                                   (signed, rots))
             return out
 

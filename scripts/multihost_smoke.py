@@ -102,6 +102,26 @@ def worker(args):
     print(f"WORKER{args.process_id}_RESUME "
           f"{row_b['train_loss']:.9f}", flush=True)
 
+    # (4) the GPT-2 trainer over the same spanning mesh (round-2
+    # review weak #5: the smoke only covered cv_train) — sketch round
+    # + sharded validation, per-process synthetic archive dirs (the
+    # generator is seed-deterministic, so the data is identical and
+    # the SPMD metrics must agree across processes)
+    from commefficient_tpu.train import gpt2_train
+    results = gpt2_train.main([
+        "--test", "--dataset_name", "PERSONA",
+        "--dataset_dir",
+        os.path.join(shared, f"persona{args.process_id}"),
+        "--mode", "sketch", "--error_type", "virtual",
+        "--local_momentum", "0", "--virtual_momentum", "0.9",
+        "--num_workers", str(total), "--local_batch_size", "2",
+        "--num_epochs", "1", "--lr_scale", "0.01",
+    ])
+    assert np.isfinite(results[-1]["train_loss"])
+    assert np.isfinite(results[-1]["val_ppl"])
+    print(f"WORKER{args.process_id}_GPT2 "
+          f"{results[-1]['train_loss']:.9f}", flush=True)
+
 
 def launcher():
     with socket.socket() as s:
@@ -162,11 +182,11 @@ def launcher():
     results = {}
     for i, out in enumerate(outs):
         for line in out.splitlines():
-            for tag in ("RESULT", "LT", "RESUME"):
+            for tag in ("RESULT", "LT", "RESUME", "GPT2"):
                 if line.startswith(f"WORKER{i}_{tag}"):
                     results.setdefault(tag, []).append(line.split()[1])
     complete = all(len(results.get(tag, [])) == 2
-                   for tag in ("RESULT", "LT", "RESUME"))
+                   for tag in ("RESULT", "LT", "RESUME", "GPT2"))
     if codes != [0, 0] or not complete:
         for i, out in enumerate(outs):
             sys.stderr.write(f"--- worker {i} (exit {codes[i]}) ---\n")
@@ -177,7 +197,8 @@ def launcher():
             f"processes disagree on {tag}: {vals}"
     print(f"MULTIHOST_OK loss={results['RESULT'][0]} "
           f"local_topk={results['LT'][0]} "
-          f"resume={results['RESUME'][0]}")
+          f"resume={results['RESUME'][0]} "
+          f"gpt2={results['GPT2'][0]}")
 
 
 if __name__ == "__main__":
