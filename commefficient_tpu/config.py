@@ -193,6 +193,13 @@ class Config:
     # GPT-2: rematerialise transformer blocks in backward (activation
     # memory ~ 1/n_layer, ~1/3 extra FLOPs) — the long-context lever
     do_remat: bool = False
+    # GPT-2: tokens per logits chunk in the chunked tied-head
+    # cross-entropy (models/gpt2.py lm_nll_sums_chunked) — the
+    # vocab-head temp memory scales with this chunk, not the sequence.
+    # 0 = auto: 256 on the sequence-parallel path (the measured memory
+    # knee, BENCHMARKS.md SP table), 1024 on the single-device path
+    # (throughput-flat across 512-4096 at the 8x geometry).
+    tokens_per_chunk: int = 0
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -212,6 +219,8 @@ class Config:
             "--approx_recall must be in (0, 1]"
         assert self.pipeline_depth >= 1, \
             "--pipeline_depth must be >= 1"
+        assert self.tokens_per_chunk >= 0, \
+            "--tokens_per_chunk must be >= 0 (0 = auto)"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -412,6 +421,9 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--process_id", type=int, default=None)
     parser.add_argument("--remat", action="store_true",
                         dest="do_remat")
+    parser.add_argument("--tokens_per_chunk", type=int, default=0,
+                        help="tokens per logits chunk in the chunked "
+                        "vocab cross-entropy (0 = auto)")
 
     return parser
 

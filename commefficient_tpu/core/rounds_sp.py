@@ -66,7 +66,7 @@ def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
                         unravel: Callable, lm_coef: float = 1.0,
                         mc_coef: float = 1.0,
                         ignore_index: int = -1,
-                        tokens_per_chunk: int = 1024):
+                        tokens_per_chunk: int = 0):
     """Returns jit-able ``round(flat_params, batch) -> (agg_grad,
     per_client_losses)`` — losses are per participating client (W,),
     zero for clients with no real examples, so the trainer reports
@@ -83,6 +83,12 @@ def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
     sp_cfg = dataclasses.replace(cfg, seq_axis=SEQ_AXIS)
     model = GPT2DoubleHeads(sp_cfg)
     ignore = ignore_index
+    # 0 = auto: 256 tokens/chunk — the measured knee of the SP
+    # temp-memory table (BENCHMARKS.md / scripts/sp_mem_bench.py:
+    # 0.89 GB vs 1.20 GB at the old 1024 default and 1.91 GB for the
+    # dense-equivalent full-shard chunk at T_local=1024; within noise
+    # of 128) and throughput-flat. --tokens_per_chunk overrides.
+    tokens_per_chunk = tokens_per_chunk or 256
 
     def client_loss(flat, ids, tt, labels, mc_ids, mc_labels,
                     ex_mask):

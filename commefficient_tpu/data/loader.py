@@ -246,26 +246,31 @@ class PersonaFedLoader(_RoundLoaderBase):
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
         stop = threading.Event()
 
+        def put_or_stop(item) -> bool:
+            # every producer put is stop-aware and bounded: an
+            # abandoning consumer (finally-drain racing a concurrent
+            # put) can never leave this thread blocked past the 5s
+            # join holding dataset/sampler references
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def produce():
             try:
                 # the synchronous path's own iterator: skip-guard,
                 # collate and dropout stay defined in ONE place
                 for batch in _RoundLoaderBase.__iter__(self):
-                    if stop.is_set():
-                        return
-                    item = ("batch", batch)
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    else:
+                    if stop.is_set() or not put_or_stop(("batch",
+                                                         batch)):
                         return
             except BaseException as e:  # surface in the consumer
-                q.put(("error", e))
+                put_or_stop(("error", e))
                 return
-            q.put(("done", None))
+            put_or_stop(("done", None))
 
         t = threading.Thread(target=produce, daemon=True,
                              name="persona-prefetch")

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 # and approx_max_k requests remain on the XLA primitives. Numbers:
 # BENCHMARKS.md, runs/exact_select.log.
 _THRESHOLD_SELECT_MIN_D = 1 << 20
+_approx_override_logged = False
 
 
 def use_threshold_select(k: int, d: int, approx: bool) -> bool:
@@ -152,7 +153,12 @@ def threshold_topk_mask_1d(sq: jax.Array, k: int, *,
         sq.astype(jnp.float32), jnp.uint32)
     t = _nibble_threshold_key(keys, k)
     from commefficient_tpu.ops import topk_pallas
-    platform = jax.devices()[0].platform
+    # branch chosen from the DEFAULT backend at trace time: this
+    # function assumes it executes there (true for every caller in
+    # this package). An explicit non-default backend (e.g.
+    # jit(..., backend="cpu") on a TPU host) would trace the wrong
+    # branch — pass force_xla/interpret to pick one explicitly.
+    platform = jax.default_backend()
     use_pallas = (interpret or platform in ("tpu", "axon")) \
         and topk_pallas.supported(d) and not force_xla
     need = k - jnp.sum((keys > t).astype(jnp.int32))
@@ -248,6 +254,20 @@ def topk(vec: jax.Array, k: int, approx: bool = False,
             f"topk supports 1-D/2-D inputs, got ndim={vec.ndim}")
     if k < vec.shape[-1] \
             and vec.shape[-1] >= _THRESHOLD_SELECT_MIN_D:
+        if approx:
+            # once per process: --approx_topk runs at this size now
+            # select a (different, exact) set than pre-round-3 builds
+            # did — surface why comparisons against older runs moved
+            global _approx_override_logged
+            if not _approx_override_logged:
+                _approx_override_logged = True
+                import logging
+                logging.getLogger(__name__).info(
+                    "approx=True ignored for dense selection at d=%d "
+                    ">= %d: the exact threshold-select path is faster "
+                    "than the approximate sort (BENCHMARKS.md); "
+                    "selected sets differ from pre-threshold-select "
+                    "builds", vec.shape[-1], _THRESHOLD_SELECT_MIN_D)
         take = _threshold_topk_mask(jax.lax.square(vec), k)
         return jnp.where(take, vec, jnp.zeros_like(vec))
     idx = _select_idx(vec, k, approx, recall)

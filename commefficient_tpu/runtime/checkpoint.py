@@ -146,7 +146,13 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         # barrier + failure broadcast: nobody proceeds (or resumes
         # from this path) until the writer finished, and a write
         # failure on process 0 fails every process with the real
-        # reason instead of a heartbeat timeout
+        # reason instead of a heartbeat timeout. The broadcast is
+        # one-sided: if a NON-zero process dies before reaching it
+        # (e.g. in its local gather/serialization above), process 0
+        # blocks here until the distributed runtime's collective
+        # timeout fires — the general failure mode of any collective,
+        # bounded and attributed by that timeout rather than by this
+        # layer
         from jax.experimental import multihost_utils
         ok = multihost_utils.broadcast_one_to_all(
             np.int32(0 if err is None else 1))

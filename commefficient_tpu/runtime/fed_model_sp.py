@@ -80,7 +80,7 @@ class SeqParallelFedModel(FedModel):
         sp_round = build_sp_gpt2_round(
             sp_cfg, self._sp_mesh, self.unravel,
             lm_coef=args.lm_coef, mc_coef=args.mc_coef,
-            ignore_index=-1)
+            ignore_index=-1, tokens_per_chunk=args.tokens_per_chunk)
         sketch = args2sketch(args)
         wd = args.weight_decay / max(args.num_workers, 1)
 

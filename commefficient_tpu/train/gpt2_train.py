@@ -39,12 +39,14 @@ from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
 MAX_SEQ_LEN = 256  # static pad length (persona sequences are short)
 
 
-def _lm_nll_sums(module, params, batch):
+def _lm_nll_sums(module, params, batch, tokens_per_chunk=0):
     """Shared forward for the train and val losses: hidden states +
     MC logits from the module, then the chunked tied-head
     cross-entropy (models/gpt2.py lm_nll_sums_chunked — the
     (tokens, vocab) logits tensor never materialises). Returns
-    per-example ((B*N,) Σnll, (B*N,) Σvalid), mc_logits, B, N."""
+    per-example ((B*N,) Σnll, (B*N,) Σvalid), mc_logits, B, N.
+    ``tokens_per_chunk`` 0 = auto (1024 — throughput-flat 512-4096
+    at the 8x geometry, BENCHMARKS.md)."""
     from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
 
     ids = batch["input_ids"]
@@ -54,7 +56,9 @@ def _lm_nll_sums(module, params, batch):
         batch["token_type_ids"], return_hidden=True)
     labels = batch["lm_labels"].reshape(B * N, T)
     sn, sv = lm_nll_sums_chunked(h[:, :-1], wte, labels[:, 1:],
-                                 module.cfg.dtype, ignore_index=-1)
+                                 module.cfg.dtype, ignore_index=-1,
+                                 tokens_per_chunk=tokens_per_chunk
+                                 or 1024)
     return sn, sv, mc_logits, B, N
 
 
@@ -77,7 +81,9 @@ def make_compute_loss_train(module, args):
     def compute_loss(params, batch, cfg):
         # shift handled in _lm_nll_sums: position t predicts t+1;
         # per example i: token-mean over its valid positions
-        sn, sv, mc_logits, B, N = _lm_nll_sums(module, params, batch)
+        sn, sv, mc_logits, B, N = _lm_nll_sums(
+            module, params, batch,
+            getattr(args, "tokens_per_chunk", 0))
         lm_i = sn.reshape(B, N).sum(1) \
             / jnp.maximum(sv.reshape(B, N).sum(1), 1.0)
 
@@ -100,7 +106,9 @@ def make_compute_loss_val(module, args):
     (B, N, T, V) logits tensor would be ~8 GB per val shard at the
     natural PersonaChat candidate count."""
     def compute_loss(params, batch, cfg):
-        sn, sv, mc_logits, B, N = _lm_nll_sums(module, params, batch)
+        sn, sv, mc_logits, B, N = _lm_nll_sums(
+            module, params, batch,
+            getattr(args, "tokens_per_chunk", 0))
         m = batch["mask"]
         w = jnp.broadcast_to(m[:, None], (B, N)).reshape(B * N)
         nll = jnp.sum(sn * w) / jnp.maximum(jnp.sum(sv * w), 1.0)

@@ -122,6 +122,28 @@ def worker(args):
     print(f"WORKER{args.process_id}_GPT2 "
           f"{results[-1]['train_loss']:.9f}", flush=True)
 
+    # (5) sequence parallelism ACROSS the process boundary (round-3
+    # review next #8): --seq_devices = the full 4-device mesh, so the
+    # seq axis spans both processes and ring attention's ppermute
+    # rides the inter-process transport (the pod user's DCN seq
+    # sharding; moral equivalent of the reference's cross-rank NCCL
+    # topology, fed_aggregator.py:161-165). Identical metrics on both
+    # processes prove the spanning SPMD program agrees end to end.
+    results = gpt2_train.main([
+        "--test", "--dataset_name", "PERSONA",
+        "--dataset_dir",
+        os.path.join(shared, f"persona{args.process_id}"),
+        "--mode", "sketch", "--error_type", "virtual",
+        "--local_momentum", "0", "--virtual_momentum", "0.9",
+        "--seq_devices", str(total), "--seq_impl", "ring",
+        "--num_workers", "2", "--local_batch_size", "2",
+        "--num_epochs", "1", "--lr_scale", "0.01",
+    ])
+    assert np.isfinite(results[-1]["train_loss"])
+    assert np.isfinite(results[-1]["val_ppl"])
+    print(f"WORKER{args.process_id}_SP "
+          f"{results[-1]['train_loss']:.9f}", flush=True)
+
 
 def launcher():
     with socket.socket() as s:
@@ -182,11 +204,12 @@ def launcher():
     results = {}
     for i, out in enumerate(outs):
         for line in out.splitlines():
-            for tag in ("RESULT", "LT", "RESUME", "GPT2"):
-                if line.startswith(f"WORKER{i}_{tag}"):
+            for tag in ("RESULT", "LT", "RESUME", "GPT2", "SP"):
+                if line.startswith(f"WORKER{i}_{tag} "):
                     results.setdefault(tag, []).append(line.split()[1])
     complete = all(len(results.get(tag, [])) == 2
-                   for tag in ("RESULT", "LT", "RESUME", "GPT2"))
+                   for tag in ("RESULT", "LT", "RESUME", "GPT2",
+                               "SP"))
     if codes != [0, 0] or not complete:
         for i, out in enumerate(outs):
             sys.stderr.write(f"--- worker {i} (exit {codes[i]}) ---\n")
@@ -198,7 +221,8 @@ def launcher():
     print(f"MULTIHOST_OK loss={results['RESULT'][0]} "
           f"local_topk={results['LT'][0]} "
           f"resume={results['RESUME'][0]} "
-          f"gpt2={results['GPT2'][0]}")
+          f"gpt2={results['GPT2'][0]} "
+          f"sp={results['SP'][0]}")
 
 
 if __name__ == "__main__":

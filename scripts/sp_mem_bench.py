@@ -1,0 +1,91 @@
+"""Compiled temp-memory table for the SP round's LM-loss formulations
+(BENCHMARKS.md "Sequence-parallel long-context memory").
+
+Runs on the 8-device virtual CPU mesh; reports
+``compile().memory_analysis().temp_size_in_bytes`` per device for the
+chunked vocab CE at several ``tokens_per_chunk`` settings, including
+the dense-equivalent upper bound (chunk = full local shard, which
+materialises the whole (B·N, T_local, V) logits block in one chunk).
+
+Usage:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/sp_mem_bench.py [--seq 4096] [--clients 2] \
+      [--seq_shards 4] [--vocab 50262] [--chunks 0,256,1024,full]
+"""
+
+import argparse
+
+import jax
+
+
+def main():
+    jax.config.update("jax_platforms", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--seq_shards", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=50262)
+    ap.add_argument("--examples", type=int, default=1)
+    ap.add_argument("--candidates", type=int, default=2)
+    ap.add_argument("--chunks", default="0,128,256,1024,full",
+                    help="comma list of tokens_per_chunk values; "
+                    "0 = auto default, 'full' = whole local shard "
+                    "(dense-equivalent)")
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.core.rounds_sp import (build_sp_gpt2_round,
+                                                  make_sp_mesh,
+                                                  shift_lm_labels)
+    from commefficient_tpu.models.gpt2 import (GPT2Config,
+                                               GPT2DoubleHeads)
+    from commefficient_tpu.ops.vec import flatten_params
+
+    W, B, N, T = (args.clients, args.examples, args.candidates,
+                  args.seq)
+    T_local = T // args.seq_shards
+    # narrow 2-layer config isolates the vocab head (round-3 setup)
+    cfg = GPT2Config(vocab_size=args.vocab, n_positions=T, n_embd=256,
+                     n_layer=2, n_head=4, dtype=jnp.bfloat16)
+    mesh = make_sp_mesh(args.clients, args.seq_shards)
+
+    dense = GPT2DoubleHeads(cfg)
+    ids0 = jnp.zeros((1, N, 8), jnp.int32)
+    params = dense.init(jax.random.PRNGKey(0), ids0,
+                        jnp.zeros((1, N), jnp.int32), ids0)["params"]
+    flat, unravel = flatten_params(params)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.randint(0, args.vocab, (W, B, N, T)), jnp.int32),
+        "token_type_ids": jnp.asarray(
+            rng.randint(0, 2, (W, B, N, T)), jnp.int32),
+        "shifted_labels": shift_lm_labels(jnp.asarray(
+            rng.randint(0, args.vocab, (W, B, N, T)), jnp.int32)),
+        "mc_token_ids": jnp.full((W, B, N), T - 1, jnp.int32),
+        "mc_labels": jnp.full((W, B), N - 1, jnp.int32),
+        "mask": jnp.ones((W, B), jnp.float32),
+    }
+
+    full = B * N * T_local
+    print(f"geometry: {W} clients x {args.seq_shards} seq shards, "
+          f"T={T} (T_local={T_local}), vocab={args.vocab}, "
+          f"E={B * N}/shard")
+    for spec in args.chunks.split(","):
+        tpc = full if spec == "full" else int(spec)
+        fn = jax.jit(build_sp_gpt2_round(cfg, mesh, unravel,
+                                         tokens_per_chunk=tpc))
+        compiled = fn.lower(flat, batch).compile()
+        ma = compiled.memory_analysis()
+        temp = ma.temp_size_in_bytes  # per-device executable stats
+        label = {0: "auto(256)", full: f"full-shard({full})"}.get(
+            tpc, str(tpc))
+        print(f"  tokens_per_chunk {label:>18}: "
+              f"{temp / 2**30:.2f} GB temp/device")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,14 +1,17 @@
 """Two-process multi-controller smoke (see scripts/multihost_smoke.py).
 
-Four scenarios over a mesh spanning two localhost CPU processes, the
+Five scenarios over a mesh spanning two localhost CPU processes, the
 multi-controller runtime joined through the trainers' own pod CLI
 flags: (1) cv_train sketch with the per-round psum crossing the
 process boundary, (2) local_topk with per-client state rows sharded
 ACROSS processes, (3) a save→kill→resume checkpoint round-trip
 asserting bit-equal metrics against the uninterrupted run, (4) the
-GPT-2 trainer (sketch round + sharded validation). Cross-process
-metric identity is asserted for every scenario — the moral equivalent
-of the reference's localhost NCCL topology (fed_aggregator.py:161-165).
+GPT-2 trainer (sketch round + sharded validation), (5) the GPT-2
+trainer with --seq_devices spanning BOTH processes — ring attention's
+ppermute crosses the process boundary (the pod user's DCN sequence
+sharding). Cross-process metric identity is asserted for every
+scenario — the moral equivalent of the reference's localhost NCCL
+topology (fed_aggregator.py:161-165).
 """
 
 import os

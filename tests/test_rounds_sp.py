@@ -144,6 +144,51 @@ def test_sp_no_full_vocab_logits_buffer():
     assert not full, f"full-shard vocab logits present: {full[:3]}"
 
 
+def test_sp_tokens_per_chunk_threading(monkeypatch):
+    """--tokens_per_chunk reaches the chunked vocab CE: 0 resolves to
+    the auto default (256 — the measured memory knee, BENCHMARKS.md SP
+    table), an explicit value passes through unchanged (round-3 review
+    weak #3: the knee was hard-coded out of reach)."""
+    from commefficient_tpu.core import rounds_sp
+    from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
+
+    seen = []
+
+    def capture(h, wte, labels, dtype, ignore_index=-100,
+                tokens_per_chunk=1024):
+        seen.append(tokens_per_chunk)
+        return lm_nll_sums_chunked(h, wte, labels, dtype,
+                                   ignore_index=ignore_index,
+                                   tokens_per_chunk=tokens_per_chunk)
+
+    monkeypatch.setattr(rounds_sp, "lm_nll_sums_chunked", capture)
+
+    cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    W, B, N, T = 2, 1, 2, 32
+    mesh = make_sp_mesh(2, 4)
+    dense = GPT2DoubleHeads(cfg)
+    rng = np.random.RandomState(0)
+    ids0 = jnp.zeros((B, N, T), jnp.int32)
+    params = dense.init(jax.random.PRNGKey(0), ids0,
+                        jnp.zeros((B, N), jnp.int32), ids0)["params"]
+    flat, unravel = flatten_params(params)
+    batch = _batch(rng, W, B, N, T, cfg.vocab_size)
+
+    ref, _ = jax.jit(build_sp_gpt2_round(cfg, mesh, unravel))(
+        flat, batch)
+    assert seen and all(c == 256 for c in seen)  # 0 -> auto 256
+
+    seen.clear()
+    out, _ = jax.jit(build_sp_gpt2_round(cfg, mesh, unravel,
+                                         tokens_per_chunk=8))(
+        flat, batch)
+    assert seen and all(c == 8 for c in seen)
+    # chunking is an evaluation order, not a different objective
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-4, atol=2e-5)
+
+
 def test_sp_round_ragged_examples():
     """Padded example rows are excluded from loss and gradient."""
     cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=32,
