@@ -216,7 +216,8 @@ class FedModel:
         lazy state_dict sync, fed_aggregator.py:374-378)."""
         return self.unravel(self.ps_weights)
 
-    def save_pretrained(self, save_dir: str, hf_format: bool = False):
+    def save_pretrained(self, save_dir: str, hf_format: bool = False,
+                        torch_format: bool = False):
         """HF-style final-model save (reference fed_aggregator.py:
         205-212 / gpt2_train.py:146): current server weights as a flax
         msgpack blob plus the module's config as JSON.
@@ -228,7 +229,15 @@ class FedModel:
         ecosystem the reference lives in. The HF config's field names
         are a superset of GPT2Config's, so this framework's own reload
         path (gpt2_train.build_model_and_tokenizer) reads the same dir
-        too."""
+        too.
+
+        ``torch_format=True`` (CV families) additionally writes
+        ``state_dict.pt``: a torch ``state_dict`` with the reference
+        torch modules' own key names and layouts
+        (models/torch_export.py) — the reference's final CV artifact
+        is exactly ``torch.save(model.state_dict(), ...)``
+        (cv_train.py:420-423), including running BN stats when the
+        model tracks them."""
         import dataclasses
         import json
         import os
@@ -239,6 +248,13 @@ class FedModel:
         # config first: a dir with weights but no config would rebuild
         # the wrong architecture on reload (gpt2_train reload path)
         cfg = getattr(self.module, "cfg", None)
+        if torch_format:
+            from commefficient_tpu.models.torch_export import \
+                save_torch_state_dict
+            save_torch_state_dict(
+                self.module, self.params(),
+                getattr(self, "model_state", None),
+                os.path.join(save_dir, "state_dict.pt"))
         if hf_format:
             import torch
 

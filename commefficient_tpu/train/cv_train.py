@@ -545,9 +545,21 @@ def main(argv=None):
         import pickle
         os.makedirs(args.checkpoint_path, exist_ok=True)
         path = os.path.join(args.checkpoint_path, args.model + ".pkl")
+        params = jax.device_get(model.params())
         with open(path, "wb") as f:
-            pickle.dump(jax.device_get(model.params()), f)
+            pickle.dump(params, f)
         print(f"saved checkpoint to {path}")
+        # the reference's exact artifact: torch.save(state_dict) named
+        # <model>.pt (cv_train.py:420-423), reference torch key names
+        from commefficient_tpu.models.torch_export import (
+            save_torch_state_dict, supports_torch_export)
+        if supports_torch_export(model.module):
+            tpath = os.path.join(args.checkpoint_path,
+                                 args.model + ".pt")
+            save_torch_state_dict(model.module, params,
+                                  getattr(model, "model_state", None),
+                                  tpath)
+            print(f"saved torch state_dict to {tpath}")
     return results
 
 
