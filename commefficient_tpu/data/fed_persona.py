@@ -35,7 +35,8 @@ from commefficient_tpu.data.fed_dataset import FedDataset
 from commefficient_tpu.data.tokenizer import SPECIAL_TOKENS
 
 __all__ = ["FedPERSONA", "persona_collate",
-           "generate_synthetic_personachat"]
+           "generate_synthetic_personachat",
+           "generate_learnable_personachat"]
 
 MODEL_INPUTS = ["input_ids", "mc_token_ids", "lm_labels", "mc_labels",
                 "token_type_ids"]
@@ -350,6 +351,75 @@ def persona_collate(records, num_candidates, max_seq_len, pad_id=0):
             out["mc_token_ids"][b, j] = min(mc_tok[j], L - 1)
             out["cand_mask"][b, j] = 1.0
     return client_ids, out
+
+
+def generate_learnable_personachat(path, word_list,
+                                   num_personalities=1000,
+                                   dialogs_per_personality=4,
+                                   utterances_per_dialog=5,
+                                   num_candidates=5,
+                                   signature_size=24,
+                                   num_val_dialogs=100,
+                                   seed=0):
+    """Write a personachat-format archive with *learnable* structure,
+    for convergence evidence where the real archive is unavailable
+    (zero egress; reference fed_persona.py:23 downloads it from S3).
+
+    Each personality draws a signature set of ``signature_size`` words
+    from ``word_list``; its persona sentences, dialog turns, and gold
+    replies all use only signature words, while distractor candidates
+    are sentences from a *different* personality's signature. So:
+
+    - the LM can cut NLL from ~ln(|word_list|) to ~ln(signature_size)
+      by conditioning on the persona/history prefix;
+    - the MC head is above chance iff it learns "the gold reply shares
+      the prefix's vocabulary" — a relation, not a memorized string:
+      validation dialogs use personalities (signature sets) never seen
+      in training, so val PPL/accuracy measure the learned rule.
+
+    Gold candidate is last (reference convention, fed_persona.py:305).
+    """
+    rng = random.Random(seed)
+
+    def make_persona():
+        return rng.sample(word_list, signature_size)
+
+    def sentence(sig):
+        return " ".join(rng.choice(sig)
+                        for _ in range(rng.randint(4, 8)))
+
+    def dialog(sig, all_sigs):
+        utterances = []
+        history = [sentence(sig)]
+        for _ in range(utterances_per_dialog):
+            cands = [sentence(rng.choice(all_sigs))
+                     for _ in range(num_candidates - 1)]
+            cands.append(sentence(sig))  # gold last
+            utterances.append({"history": list(history),
+                               "candidates": cands})
+            history.append(sentence(sig))
+            history.append(sentence(sig))
+        return utterances
+
+    data = {"train": [], "valid": []}
+    train_sigs = [make_persona() for _ in range(num_personalities)]
+    for sig in train_sigs:
+        personality = [sentence(sig) for _ in range(3)]
+        others = [s for s in train_sigs if s is not sig] or [sig]
+        for _ in range(dialogs_per_personality):
+            data["train"].append({"personality": personality,
+                                  "utterances": dialog(sig, others)})
+    val_sigs = [make_persona()
+                for _ in range(max(1, num_val_dialogs // 4))]
+    for i in range(num_val_dialogs):
+        sig = val_sigs[i % len(val_sigs)]
+        others = [s for s in val_sigs if s is not sig] or [sig]
+        data["valid"].append({
+            "personality": [sentence(sig) for _ in range(3)],
+            "utterances": dialog(sig, others)})
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, RAW_NAME), "w") as f:
+        json.dump(data, f)
 
 
 def generate_synthetic_personachat(path, num_personalities=8,

@@ -190,6 +190,64 @@ class GPT2BPETokenizer:
         _write_special(save_dir, self.special)
 
 
+def fabricate_bpe_vocab(save_dir: str, vocab_size: int = 50257,
+                        num_words: int = 8000, seed: int = 0):
+    """Write a full-size GPT-2-layout ``vocab.json``/``merges.txt``
+    whose *geometry* matches the real GPT-2 vocabulary (default
+    50257 entries — the reference fine-tunes this exact shape,
+    gpt2_train.py:262-285) without needing the real files (zero-egress
+    environment). Returns the list of ``num_words`` synthetic words,
+    each of which encodes to exactly ONE token through
+    :class:`GPT2BPETokenizer`, both bare and with a leading space.
+
+    Construction: words are two consonant-vowel syllables
+    ("bade", "kilu", ...). Merges are layered so greedy BPE resolves
+    deterministically: char-pair -> syllable, syllable-pair -> word,
+    "Ġ"+word -> spaced word. Ids are shuffled so the reachable tokens
+    spread across the whole [0, vocab_size) range (embedding/softmax
+    rows are exercised across the full table, not a dense prefix).
+    Remaining ids are filler entries, unreachable by the merge rules —
+    the real vocabulary likewise has ids rare text never produces.
+    """
+    rng = __import__("random").Random(seed)
+    consonants = "bcdfghjklmnprstvwz"
+    vowels = "aeiou"
+    syllables = [c + v for c in consonants for v in vowels]  # 90
+    if num_words > len(syllables) ** 2:
+        raise ValueError("num_words exceeds 2-syllable combinations")
+    pairs = [(a, b) for a in syllables for b in syllables]
+    rng.shuffle(pairs)
+    words = [a + b for a, b in pairs[:num_words]]
+
+    byte_tokens = list(_bytes_to_unicode().values())  # 256
+    tokens = list(byte_tokens) + list(syllables)
+    merges = [(s[0], s[1]) for s in syllables]
+    for a, b in pairs[:num_words]:
+        merges.append((a, b))
+        tokens.append(a + b)
+    for w in words:
+        merges.append(("Ġ", w))
+        tokens.append("Ġ" + w)
+    n_filler = vocab_size - len(tokens)
+    if n_filler < 0:
+        raise ValueError(f"vocab_size {vocab_size} < {len(tokens)} "
+                         "constructed tokens")
+    tokens.extend(f"<unused{i}>" for i in range(n_filler))
+
+    ids = list(range(vocab_size))
+    rng.shuffle(ids)
+    encoder = {t: i for t, i in zip(tokens, ids)}
+
+    os.makedirs(save_dir, exist_ok=True)
+    with open(os.path.join(save_dir, "vocab.json"), "w") as f:
+        json.dump(encoder, f)
+    with open(os.path.join(save_dir, "merges.txt"), "w",
+              encoding="utf-8") as f:
+        f.write("#version: 0.2\n")
+        f.write("\n".join(" ".join(m) for m in merges) + "\n")
+    return words
+
+
 class ByteTokenizer:
     """Offline fallback with the same interface: ids = byte values."""
 

@@ -1,0 +1,83 @@
+"""Fabricate full-size GPT-2 learning-run assets (zero-egress stand-ins
+for the reference's downloads):
+
+- a 50257-entry byte-level BPE vocab (``data/tokenizer.py
+  fabricate_bpe_vocab``) — the real vocabulary's *geometry* without the
+  real files;
+- a random-init HF-layout GPT-2 124M checkpoint (``pytorch_model.bin``
+  via ``transformers.GPT2LMHeadModel``) so training starts through the
+  same disk path the reference uses for the pretrained model
+  (reference gpt2_train.py:262-285);
+- a learnable persona-correlated PersonaChat-format corpus
+  (``data/fed_persona.py generate_learnable_personachat``).
+
+Usage:
+  python scripts/make_gpt2_assets.py --out runs/gpt2_learn \
+      [--personalities 1000] [--dialogs 4] [--utterances 5] [--seed 0]
+
+Writes ``<out>/ckpt`` (vocab + weights) and ``<out>/data`` (corpus).
+"""
+
+import argparse
+import os
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", required=True)
+    p.add_argument("--personalities", type=int, default=1000)
+    p.add_argument("--dialogs", type=int, default=4)
+    p.add_argument("--utterances", type=int, default=5)
+    p.add_argument("--candidates", type=int, default=5)
+    p.add_argument("--signature", type=int, default=24)
+    p.add_argument("--val_dialogs", type=int, default=100)
+    p.add_argument("--words", type=int, default=8000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--skip_ckpt", action="store_true",
+                   help="only (re)generate vocab + corpus")
+    args = p.parse_args()
+
+    ckpt_dir = os.path.join(args.out, "ckpt")
+    data_dir = os.path.join(args.out, "data")
+
+    from commefficient_tpu.data.fed_persona import \
+        generate_learnable_personachat
+    from commefficient_tpu.data.tokenizer import fabricate_bpe_vocab
+
+    words = fabricate_bpe_vocab(ckpt_dir, vocab_size=50257,
+                                num_words=args.words, seed=args.seed)
+    print(f"vocab: 50257 entries, {len(words)} single-token words "
+          f"-> {ckpt_dir}")
+
+    generate_learnable_personachat(
+        data_dir, words, num_personalities=args.personalities,
+        dialogs_per_personality=args.dialogs,
+        utterances_per_dialog=args.utterances,
+        num_candidates=args.candidates, signature_size=args.signature,
+        num_val_dialogs=args.val_dialogs, seed=args.seed)
+    n_train = args.personalities * args.dialogs * args.utterances
+    print(f"corpus: {n_train} train utterances, "
+          f"{args.val_dialogs * args.utterances} val -> {data_dir}")
+
+    if args.skip_ckpt:
+        return
+    bin_path = os.path.join(ckpt_dir, "pytorch_model.bin")
+    if os.path.exists(bin_path):
+        print(f"{bin_path} exists; keeping")
+        return
+    import torch
+    from transformers import GPT2Config as HFConfig
+    from transformers import GPT2LMHeadModel
+
+    torch.manual_seed(args.seed)
+    hf_cfg = HFConfig(vocab_size=50257, n_positions=1024, n_embd=768,
+                      n_layer=12, n_head=12)
+    model = GPT2LMHeadModel(hf_cfg)
+    torch.save(model.state_dict(), bin_path)
+    n = sum(p.numel() for p in model.parameters())
+    print(f"checkpoint: {n / 1e6:.1f}M params (random init, "
+          f"seed {args.seed}) -> {bin_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -617,6 +617,145 @@ class TestBatchedTrainLoss:
                                        rtol=2e-4, atol=1e-6)
 
 
+class TestFabricatedAssets:
+    """The full-size learning-run stand-ins (zero-egress environment):
+    the fabricated 50257-entry BPE vocab and the learnable
+    persona-correlated corpus. Their invariants are load-bearing for
+    the convergence evidence — the NLL floor math assumes every
+    synthetic word is ONE token, and MC learnability assumes the gold
+    candidate is last and shares the persona's signature."""
+
+    def test_fabricated_vocab_single_token_words(self, tmp_path):
+        import random
+
+        from commefficient_tpu.data.tokenizer import (GPT2BPETokenizer,
+                                                      SPECIAL_TOKENS,
+                                                      fabricate_bpe_vocab)
+        words = fabricate_bpe_vocab(str(tmp_path), vocab_size=50257,
+                                    num_words=500, seed=3)
+        tok = GPT2BPETokenizer(str(tmp_path))
+        assert len(tok) == 50257
+        assert tok.add_special_tokens(SPECIAL_TOKENS) == 5
+        assert len(tok) == 50262  # the reference fine-tune vocab size
+        rng = random.Random(0)
+        sample = rng.sample(words, 40)
+        ids = set()
+        for w in sample:
+            bare, spaced = tok.encode(w), tok.encode(" " + w)
+            assert len(bare) == 1 and len(spaced) == 1, w
+            ids.update(bare + spaced)
+        assert len(ids) == 80  # distinct tokens, bare != spaced
+        # ids spread across the table, not a dense prefix
+        assert max(ids) - min(ids) > 25000
+        # decode round-trips through the byte table
+        s = " ".join(sample[:5])
+        assert tok.decode(tok.encode(s)) == s
+
+    def test_learnable_corpus_structure(self, tmp_path):
+        import json
+
+        from commefficient_tpu.data.fed_persona import (
+            RAW_NAME, generate_learnable_personachat)
+        words = [a + b for a in ("ba", "ke", "lu", "mi", "po", "su")
+                 for b in ("da", "fe", "go", "ni", "ra", "tu")]
+        generate_learnable_personachat(
+            str(tmp_path), words, num_personalities=6,
+            dialogs_per_personality=2, utterances_per_dialog=3,
+            num_candidates=4, signature_size=5, num_val_dialogs=4,
+            seed=0)
+        with open(tmp_path / RAW_NAME) as f:
+            data = json.load(f)
+        assert len(data["train"]) == 12 and len(data["valid"]) == 4
+
+        def sig_of(dialog):
+            # reconstruct the signature: persona, history and gold
+            # replies all draw from the SAME signature_size-word set
+            words = {w for s in dialog["personality"]
+                     for w in s.split()}
+            for u in dialog["utterances"]:
+                words |= set(u["candidates"][-1].split())
+                for h in u["history"]:
+                    words |= set(h.split())
+            return frozenset(words)
+
+        train_sigs, val_sigs = [], []
+        for split, sigs in (("train", train_sigs),
+                            ("valid", val_sigs)):
+            for d in data[split]:
+                sig = sig_of(d)
+                # everything the persona says fits one signature set
+                assert len(sig) <= 5, sorted(sig)
+                sigs.append(sig)
+                for u in d["utterances"]:
+                    cands = u["candidates"]
+                    assert len(cands) == 4
+                    # gold last, drawn from the persona signature
+                    assert set(cands[-1].split()) <= sig
+        # val personalities are UNSEEN in training (the rule, not the
+        # strings, is what validation measures)
+        assert not set(train_sigs) & set(val_sigs)
+
+
+def test_trainer_losses_thread_tokens_per_chunk(monkeypatch):
+    """--tokens_per_chunk reaches the chunked vocab CE from BOTH
+    trainer loss closures (0 = the 1024 auto default)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.models import gpt2 as gpt2_mod
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.train.gpt2_train import (
+        make_compute_loss_train, make_compute_loss_val)
+
+    seen = []
+    orig = gpt2_mod.lm_nll_sums_chunked
+
+    def capture(h, wte, labels, dtype, ignore_index=-100,
+                tokens_per_chunk=1024):
+        seen.append(tokens_per_chunk)
+        return orig(h, wte, labels, dtype, ignore_index=ignore_index,
+                    tokens_per_chunk=tokens_per_chunk)
+
+    monkeypatch.setattr(gpt2_mod, "lm_nll_sums_chunked", capture)
+
+    gcfg = GPT2Config.tiny()
+    module = GPT2DoubleHeads(gcfg)
+    rng = np.random.RandomState(0)
+    B, N, T = 2, 2, 12
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.randint(0, gcfg.vocab_size, (B, N, T)), jnp.int32),
+        "token_type_ids": jnp.zeros((B, N, T), jnp.int32),
+        "lm_labels": jnp.asarray(
+            rng.randint(0, gcfg.vocab_size, (B, N, T)), jnp.int32),
+        "mc_token_ids": jnp.full((B, N), T - 1, jnp.int32),
+        "mc_labels": jnp.full((B,), N - 1, jnp.int32),
+        "mask": jnp.ones((B,), jnp.float32),
+        "cand_mask": jnp.ones((B, N), jnp.float32),
+    }
+    params = module.init(jax.random.PRNGKey(0), batch["input_ids"],
+                         batch["mc_token_ids"],
+                         batch["token_type_ids"])["params"]
+
+    base = Config(mode="uncompressed", error_type="none",
+                  local_momentum=0.0, num_workers=1,
+                  local_batch_size=2, dataset_name="PERSONA")
+    ref, _ = make_compute_loss_train(module, base)(params, batch, base)
+    assert seen and all(c == 1024 for c in seen)  # 0 -> auto 1024
+
+    import dataclasses
+    args = dataclasses.replace(base, tokens_per_chunk=6)
+    seen.clear()
+    got, _ = make_compute_loss_train(module, args)(params, batch, args)
+    assert seen and all(c == 6 for c in seen)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+    seen.clear()
+    make_compute_loss_val(module, args)(params, batch, args)
+    assert seen and all(c == 6 for c in seen)
+
+
 class TestSavePretrained:
     def test_model_and_tokenizer_roundtrip(self, tmp_path):
         """reference fed_aggregator.py:205-212 / gpt2_train.py:278-283:
