@@ -190,6 +190,15 @@ class Config:
     # --num_clients 10000 reproduces the FetchSGD paper's CIFAR10
     # federation shape (10 000 clients x 5 one-class images).
     synthetic_per_class: int = 64
+    # Synthetic-dataset class-overlap dial: scales class means against
+    # the fixed noise std. 1.0 = trivially separable; 0.025 gives a
+    # Bayes ceiling near 0.86, making long-horizon convergence anchors
+    # accuracy-discriminating (FedSynthetic.bayes_accuracy reports the
+    # exact ceiling for the generated split).
+    synthetic_separation: float = 1.0
+    # Synthetic val-set size: 128 (default) is fine for smoke runs;
+    # discriminating anchors need ~2000 for sub-percent granularity
+    synthetic_num_val: int = 128
     # GPT-2: rematerialise transformer blocks in backward (activation
     # memory ~ 1/n_layer, ~1/3 extra FLOPs) — the long-context lever
     do_remat: bool = False
@@ -413,6 +422,9 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--pipeline_depth", type=int, default=1)
     parser.add_argument("--classes_per_client", type=int, default=1)
     parser.add_argument("--synthetic_per_class", type=int, default=64)
+    parser.add_argument("--synthetic_separation", type=float,
+                        default=1.0)
+    parser.add_argument("--synthetic_num_val", type=int, default=128)
     parser.add_argument("--hf_export", action="store_true",
                         dest="do_hf_export")
     parser.add_argument("--coordinator_address", type=str,

@@ -22,13 +22,20 @@ class FedSynthetic(FedDataset):
 
     def __init__(self, *args, num_classes=10, image_shape=(32, 32, 3),
                  per_class=64, num_val=128, gen_seed=0,
-                 classes_per_client=1, **kw):
+                 classes_per_client=1, separation=1.0, **kw):
         self.num_classes = num_classes
         self.image_shape = image_shape
         self.per_class = per_class
         self.num_val = num_val
         self.gen_seed = gen_seed
         self.classes_per_client = classes_per_client
+        # class-overlap dial: scales the class means against the fixed
+        # 0.5 noise std. 1.0 (default) is trivially separable (the
+        # saturating regime); small values give a computable sub-1.0
+        # Bayes ceiling (bayes_accuracy), making long-horizon anchors
+        # accuracy-DISCRIMINATING instead of stability-only (round-3
+        # review weak #1).
+        self.separation = separation
         super().__init__(*args, **kw)
 
     # entirely in-memory: no disk prep
@@ -40,9 +47,10 @@ class FedSynthetic(FedDataset):
 
     def _gen(self):
         rng = np.random.RandomState(self.gen_seed)
-        # one separable mean per class
-        self._means = rng.randn(self.num_classes,
-                                *self.image_shape).astype(np.float32)
+        # one mean per class, scaled by the overlap dial
+        self._means = (self.separation
+                       * rng.randn(self.num_classes,
+                                   *self.image_shape)).astype(np.float32)
 
         vx, vy = [], []
         for c in range(self.num_classes):
@@ -74,3 +82,13 @@ class FedSynthetic(FedDataset):
 
     def _get_val_item(self, idx):
         return self._val_x[idx], int(self._val_y[idx])
+
+    def bayes_accuracy(self):
+        """Empirical Bayes-optimal (true-means nearest-class under the
+        isotropic noise) accuracy on THIS val split — the anchor's
+        ceiling. Equal covariances: the Bayes rule is the max class
+        log-likelihood = nearest mean."""
+        x = self._val_x.reshape(len(self._val_y), -1)
+        mu = self._means.reshape(self.num_classes, -1)
+        d2 = ((x[:, None, :] - mu[None, :, :]) ** 2).sum(-1)
+        return float((np.argmin(d2, 1) == self._val_y).mean())

@@ -348,6 +348,8 @@ def get_data_loaders(args: Config):
     if name == "Synthetic":
         common["classes_per_client"] = args.classes_per_client
         common["per_class"] = args.synthetic_per_class
+        common["separation"] = args.synthetic_separation
+        common["num_val"] = args.synthetic_num_val
     train_ds = cls(args.dataset_dir, name, transform=train_t,
                    train=True, **common)
     val_ds = cls(args.dataset_dir, name, transform=val_t, train=False,

@@ -282,6 +282,41 @@ class TestTransforms:
         assert out.shape[:2] == (40, 40)
 
 
+class TestSyntheticSeparation:
+    """--synthetic_separation: the class-overlap dial behind the
+    discriminating convergence anchor (scripts/anchor24.py)."""
+
+    def _ds(self, sep, **kw):
+        from commefficient_tpu.data.synthetic import FedSynthetic
+        return FedSynthetic("", "Synthetic", train=False, do_iid=False,
+                            num_clients=None, per_class=8,
+                            num_val=400, separation=sep, seed=0,
+                            **kw)
+
+    def test_default_separable_small_overlapping(self):
+        assert self._ds(1.0).bayes_accuracy() == 1.0
+        acc = self._ds(0.025).bayes_accuracy()
+        assert 0.5 < acc < 0.95  # genuinely sub-1.0 ceiling
+
+    def test_means_scale_with_separation(self):
+        import numpy as np
+        a, b = self._ds(1.0), self._ds(0.5)
+        np.testing.assert_allclose(b._means, 0.5 * a._means,
+                                   rtol=1e-6)
+
+    def test_flags_reach_dataset(self):
+        """--synthetic_separation/--synthetic_num_val thread from the
+        CLI through cv_train's dataset construction."""
+        from commefficient_tpu.config import parse_args
+        a = parse_args(default_lr=0.1, argv=[
+            "--dataset_name", "Synthetic", "--mode", "uncompressed",
+            "--error_type", "none", "--local_momentum", "0",
+            "--synthetic_separation", "0.025",
+            "--synthetic_num_val", "2000"])
+        assert a.synthetic_separation == 0.025
+        assert a.synthetic_num_val == 2000
+
+
 class TestClientDropout:
     """--dropout_prob fault injection: dropped clients' mask rows are
     zeroed so the engine excludes them; fully-dropped rounds are
