@@ -360,7 +360,8 @@ def generate_learnable_personachat(path, word_list,
                                    num_candidates=5,
                                    signature_size=24,
                                    num_val_dialogs=100,
-                                   seed=0):
+                                   seed=0,
+                                   val_from_train_sigs=False):
     """Write a personachat-format archive with *learnable* structure,
     for convergence evidence where the real archive is unavailable
     (zero egress; reference fed_persona.py:23 downloads it from S3).
@@ -376,6 +377,13 @@ def generate_learnable_personachat(path, word_list,
       the prefix's vocabulary" — a relation, not a memorized string:
       validation dialogs use personalities (signature sets) never seen
       in training, so val PPL/accuracy measure the learned rule.
+
+    ``val_from_train_sigs=True`` instead draws validation dialogs
+    (fresh sentences) from the TRAINING personalities — the easier
+    seen-persona tier: persona-vocabulary associations absorbed during
+    training suffice, no cross-persona rule needed. Useful as a
+    second evaluation split for a model trained on the default corpus
+    (same word list + seed ⇒ identical train signatures).
 
     Gold candidate is last (reference convention, fed_persona.py:305).
     """
@@ -409,8 +417,12 @@ def generate_learnable_personachat(path, word_list,
         for _ in range(dialogs_per_personality):
             data["train"].append({"personality": personality,
                                   "utterances": dialog(sig, others)})
-    val_sigs = [make_persona()
-                for _ in range(max(1, num_val_dialogs // 4))]
+    n_val_sigs = max(1, num_val_dialogs // 4)
+    if val_from_train_sigs:
+        val_sigs = [train_sigs[rng.randrange(len(train_sigs))]
+                    for _ in range(n_val_sigs)]
+    else:
+        val_sigs = [make_persona() for _ in range(n_val_sigs)]
     for i in range(num_val_dialogs):
         sig = val_sigs[i % len(val_sigs)]
         others = [s for s in val_sigs if s is not sig] or [sig]

@@ -35,6 +35,11 @@ def main():
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--skip_ckpt", action="store_true",
                    help="only (re)generate vocab + corpus")
+    p.add_argument("--val_from_train_sigs", action="store_true",
+                   help="validation dialogs reuse TRAIN personalities "
+                   "(fresh sentences) — the easier seen-persona "
+                   "evaluation tier; train split stays byte-identical "
+                   "for a given seed/word budget")
     args = p.parse_args()
 
     ckpt_dir = os.path.join(args.out, "ckpt")
@@ -54,7 +59,8 @@ def main():
         dialogs_per_personality=args.dialogs,
         utterances_per_dialog=args.utterances,
         num_candidates=args.candidates, signature_size=args.signature,
-        num_val_dialogs=args.val_dialogs, seed=args.seed)
+        num_val_dialogs=args.val_dialogs, seed=args.seed,
+        val_from_train_sigs=args.val_from_train_sigs)
     n_train = args.personalities * args.dialogs * args.utterances
     print(f"corpus: {n_train} train utterances, "
           f"{args.val_dialogs * args.utterances} val -> {data_dir}")

@@ -625,6 +625,17 @@ class TestFabricatedAssets:
     synthetic word is ONE token, and MC learnability assumes the gold
     candidate is last and shares the persona's signature."""
 
+    @staticmethod
+    def _dialog_signature(dialog):
+        # reconstruct the signature: persona, history and gold replies
+        # all draw from the SAME signature_size-word set
+        words = {w for s in dialog["personality"] for w in s.split()}
+        for u in dialog["utterances"]:
+            words |= set(u["candidates"][-1].split())
+            for h in u["history"]:
+                words |= set(h.split())
+        return frozenset(words)
+
     def test_fabricated_vocab_single_token_words(self, tmp_path):
         import random
 
@@ -667,17 +678,7 @@ class TestFabricatedAssets:
             data = json.load(f)
         assert len(data["train"]) == 12 and len(data["valid"]) == 4
 
-        def sig_of(dialog):
-            # reconstruct the signature: persona, history and gold
-            # replies all draw from the SAME signature_size-word set
-            words = {w for s in dialog["personality"]
-                     for w in s.split()}
-            for u in dialog["utterances"]:
-                words |= set(u["candidates"][-1].split())
-                for h in u["history"]:
-                    words |= set(h.split())
-            return frozenset(words)
-
+        sig_of = self._dialog_signature
         train_sigs, val_sigs = [], []
         for split, sigs in (("train", train_sigs),
                             ("valid", val_sigs)):
@@ -694,6 +695,32 @@ class TestFabricatedAssets:
         # val personalities are UNSEEN in training (the rule, not the
         # strings, is what validation measures)
         assert not set(train_sigs) & set(val_sigs)
+
+    def test_seen_persona_val_tier(self, tmp_path):
+        """val_from_train_sigs=True: train split byte-identical to the
+        default corpus (same seed), val dialogs reuse TRAIN
+        signatures — the easier seen-persona evaluation tier."""
+        import json
+
+        from commefficient_tpu.data.fed_persona import (
+            RAW_NAME, generate_learnable_personachat)
+        words = [a + b for a in ("ba", "ke", "lu", "mi")
+                 for b in ("da", "fe", "go", "ni")]
+        kw = dict(num_personalities=4, dialogs_per_personality=2,
+                  utterances_per_dialog=2, num_candidates=3,
+                  signature_size=4, num_val_dialogs=4, seed=5)
+        generate_learnable_personachat(str(tmp_path / "a"), words,
+                                       **kw)
+        generate_learnable_personachat(str(tmp_path / "b"), words,
+                                       val_from_train_sigs=True, **kw)
+        a = json.load(open(tmp_path / "a" / RAW_NAME))
+        b = json.load(open(tmp_path / "b" / RAW_NAME))
+        assert a["train"] == b["train"]
+
+        train_sigs = [self._dialog_signature(d) for d in b["train"]]
+        for d in b["valid"]:
+            v = self._dialog_signature(d)
+            assert any(v <= t for t in train_sigs), sorted(v)
 
 
 def test_trainer_losses_thread_tokens_per_chunk(monkeypatch):
