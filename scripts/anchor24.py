@@ -22,6 +22,9 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 MODE_FLAGS = {
     "sketch": ["--mode", "sketch", "--error_type", "virtual",
                "--local_momentum", "0", "--virtual_momentum", "0.9",
@@ -90,7 +93,9 @@ def main():
         print(f"== {mode} -> {log_path}", flush=True)
         # stream to the file as the run goes: a mid-run kill keeps
         # the epochs so far instead of discarding a buffered log
-        with open(log_path, "w") as f:
+        # line-buffered: the epoch rows land as they print (a
+        # block-buffered redirect holds ~60 epochs back)
+        with open(log_path, "w", buffering=1) as f:
             f.write(" ".join(flags) + "\n")
             f.flush()
             try:

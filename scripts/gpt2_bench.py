@@ -61,8 +61,12 @@ def build(args):
     def loss_flat(p, batch):
         return compute_loss(unravel(p), batch, cfg)
 
-    client_round = jax.jit(build_client_round(cfg, loss_flat,
-                                              args.examples))
+    def loss_tree(p, batch):
+        return compute_loss(p, batch, cfg)
+
+    client_round = jax.jit(build_client_round(
+        cfg, loss_flat, args.examples,
+        tree_loss=loss_tree, unravel=unravel))
     server_round = jax.jit(build_server_round(cfg))
 
     rng = np.random.RandomState(0)

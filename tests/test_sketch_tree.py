@@ -1,0 +1,207 @@
+"""Tree-space sketching: the fused sketch round without the flat
+gradient (VERDICT round-3 task #3 — attack the d-bound flat-vector
+constant).
+
+Contract under test: ``CountSketch.sketch_from_leaves(leaves)`` is
+bit-identical to ``sketch(ravel-concat(leaves))``, and the tree-primal
+fused client round (build_client_round with tree_loss/unravel) produces
+the same aggregated table, metrics, and server trajectory as the
+flat-primal path it replaces.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.ops.sketch import CountSketch
+from commefficient_tpu.ops.vec import flatten_params
+
+
+def _leaf_tree(seed, shapes):
+    rng = np.random.RandomState(seed)
+    return {f"l{i}": jnp.asarray(rng.randn(*s), jnp.float32)
+            for i, s in enumerate(shapes)}
+
+
+SHAPES = [(7, 13), (64,), (3, 5, 11), (257,), (2, 2)]
+
+
+class TestSketchFromLeaves:
+    def test_matches_flat_sketch_exactly(self):
+        tree = _leaf_tree(0, SHAPES)
+        flat, _ = flatten_params(tree)
+        cs = CountSketch(d=int(flat.size), c=128, r=3, backend="xla")
+        t_flat = cs.sketch(flat)
+        t_tree = cs.sketch_from_leaves(jax.tree_util.tree_leaves(tree))
+        np.testing.assert_array_equal(np.asarray(t_flat),
+                                      np.asarray(t_tree))
+
+    def test_matches_under_pallas_interpret(self):
+        tree = _leaf_tree(1, SHAPES)
+        flat, _ = flatten_params(tree)
+        cs = CountSketch(d=int(flat.size), c=256, r=3,
+                         backend="pallas_interpret")
+        t_flat = cs.sketch(flat)
+        t_tree = cs.sketch_from_leaves(jax.tree_util.tree_leaves(tree))
+        np.testing.assert_array_equal(np.asarray(t_flat),
+                                      np.asarray(t_tree))
+
+    def test_wrong_total_size_raises(self):
+        tree = _leaf_tree(2, [(4, 4)])
+        cs = CountSketch(d=99, c=64, r=2, backend="xla")
+        with pytest.raises(AssertionError):
+            cs.sketch_from_leaves(jax.tree_util.tree_leaves(tree))
+
+
+class TestPaddedEstimates:
+    @pytest.mark.parametrize("backend", ["xla", "pallas_interpret"])
+    def test_padded_estimates_zero_tail_same_head(self, backend):
+        d, c, r = 1000, 128, 3  # padded_d = 1024 > d
+        rng = np.random.RandomState(3)
+        v = jnp.asarray(rng.randn(d), jnp.float32)
+        cs = CountSketch(d=d, c=c, r=r, backend=backend)
+        table = cs.sketch(v)
+        est = cs.estimates(table)
+        est_p = cs.estimates(table, padded=True)
+        assert est_p.shape == (cs._padded_d,)
+        np.testing.assert_array_equal(np.asarray(est_p[:d]),
+                                      np.asarray(est))
+        np.testing.assert_array_equal(np.asarray(est_p[d:]),
+                                      np.zeros(cs._padded_d - d))
+
+    def test_unsketch_selection_unchanged_by_padding(self):
+        # big_d gate is 1<<20 — too big for a unit test, so check the
+        # invariant directly: selection over the tail-zeroed padded
+        # estimates equals selection over the sliced estimates
+        from commefficient_tpu.ops.topk import threshold_topk_indices
+        d, c, r, k = 1000, 128, 3, 25
+        rng = np.random.RandomState(4)
+        v = np.zeros(d, np.float32)
+        hot = rng.choice(d, 40, replace=False)
+        v[hot] = rng.randn(40) * 10
+        cs = CountSketch(d=d, c=c, r=r, backend="xla")
+        table = cs.sketch(jnp.asarray(v))
+        est = cs.estimates(table)
+        est_p = cs.estimates(table, padded=True)
+        idx = threshold_topk_indices(jax.lax.square(est), k)
+        idx_p = threshold_topk_indices(jax.lax.square(est_p), k)
+        np.testing.assert_array_equal(np.sort(np.asarray(idx)),
+                                      np.sort(np.asarray(idx_p)))
+
+
+def _round_pair(cfg, W=4, B=3, D=40):
+    """Build flat-primal and tree-primal fused client rounds over the
+    same tiny linear model and batch."""
+    rng = np.random.RandomState(7)
+    tree = {"w": jnp.asarray(rng.randn(D, 4), jnp.float32),
+            "b": jnp.asarray(rng.randn(4), jnp.float32)}
+    flat, unravel = flatten_params(tree)
+    cfg.grad_size = int(flat.size)
+
+    def tree_loss(p, batch):
+        logits = batch["x"] @ p["w"] + p["b"]
+        per = jnp.sum((logits - batch["y"]) ** 2, axis=-1)
+        loss = jnp.sum(per * batch["mask"]) / jnp.maximum(
+            jnp.sum(batch["mask"]), 1.0)
+        return loss, (loss * 0.5,)
+
+    def flat_loss(p, batch):
+        return tree_loss(unravel(p), batch)
+
+    batch = {
+        "x": jnp.asarray(rng.randn(W, B, D), jnp.float32),
+        "y": jnp.asarray(rng.randn(W, B, 4), jnp.float32),
+        "mask": jnp.ones((W, B), jnp.float32),
+    }
+    return flat, unravel, flat_loss, tree_loss, batch
+
+
+class TestTreePrimalFusedRound:
+    def test_same_table_and_metrics(self):
+        from commefficient_tpu.core.rounds import (ClientStates,
+                                                   build_client_round)
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     weight_decay=5e-4, num_workers=4,
+                     local_batch_size=3, k=10, num_cols=64, num_rows=3,
+                     dataset_name="CIFAR10", seed=0)
+        flat, unravel, flat_loss, tree_loss, batch = _round_pair(cfg)
+        cs = ClientStates(None, None, None)
+        ids = jnp.arange(4, dtype=jnp.int32)
+        key = jax.random.PRNGKey(0)
+
+        r_flat = build_client_round(cfg, flat_loss, 3)(
+            flat, cs, batch, ids, key)
+        r_tree = build_client_round(cfg, flat_loss, 3,
+                                    tree_loss=tree_loss,
+                                    unravel=unravel)(
+            flat, cs, batch, ids, key)
+        np.testing.assert_allclose(np.asarray(r_flat.aggregated),
+                                   np.asarray(r_tree.aggregated),
+                                   rtol=1e-6, atol=1e-7)
+        for mf, mt in zip(r_flat.metrics, r_tree.metrics):
+            np.testing.assert_allclose(np.asarray(mf), np.asarray(mt),
+                                       rtol=1e-6)
+
+    def test_same_trajectory_through_server(self):
+        from commefficient_tpu.core.rounds import (ClientStates,
+                                                   build_client_round,
+                                                   build_server_round)
+        from commefficient_tpu.core.server import ServerState
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     weight_decay=0.0, num_workers=4,
+                     local_batch_size=3, k=10, num_cols=64, num_rows=3,
+                     dataset_name="CIFAR10", seed=0)
+        flat, unravel, flat_loss, tree_loss, batch = _round_pair(cfg)
+        cs = ClientStates(None, None, None)
+        ids = jnp.arange(4, dtype=jnp.int32)
+
+        def run(client_round):
+            ps = flat
+            ss = ServerState.init(cfg)
+            server = build_server_round(cfg)
+            for r in range(3):
+                res = client_round(ps, cs, batch, ids,
+                                   jax.random.PRNGKey(r))
+                ps, ss, _, _, _ = server(ps, ss, res.aggregated,
+                                         jnp.float32(0.05))
+            return np.asarray(ps)
+
+        ps_flat = run(build_client_round(cfg, flat_loss, 3))
+        ps_tree = run(build_client_round(cfg, flat_loss, 3,
+                                         tree_loss=tree_loss,
+                                         unravel=unravel))
+        np.testing.assert_allclose(ps_flat, ps_tree,
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_mesh_tree_path_matches_single_device(self, devices):
+        from jax.sharding import Mesh
+        from commefficient_tpu.core.rounds import (ClientStates,
+                                                   build_client_round)
+        from commefficient_tpu.parallel.mesh import CLIENT_AXIS
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     weight_decay=5e-4, num_workers=8,
+                     local_batch_size=3, k=10, num_cols=64, num_rows=3,
+                     dataset_name="CIFAR10", seed=0)
+        flat, unravel, flat_loss, tree_loss, batch = _round_pair(
+            cfg, W=8)
+        cs = ClientStates(None, None, None)
+        ids = jnp.arange(8, dtype=jnp.int32)
+        key = jax.random.PRNGKey(0)
+        mesh = Mesh(np.asarray(devices), (CLIENT_AXIS,))
+
+        r_one = build_client_round(cfg, flat_loss, 3,
+                                   tree_loss=tree_loss,
+                                   unravel=unravel)(
+            flat, cs, batch, ids, key)
+        r_mesh = build_client_round(cfg, flat_loss, 3, mesh=mesh,
+                                    tree_loss=tree_loss,
+                                    unravel=unravel)(
+            flat, cs, batch, ids, key)
+        np.testing.assert_allclose(np.asarray(r_one.aggregated),
+                                   np.asarray(r_mesh.aggregated),
+                                   rtol=1e-5, atol=1e-6)
