@@ -58,10 +58,11 @@ def main():
 
     compute_loss = make_compute_loss(module)
 
-    def loss_flat(p, batch):
-        return compute_loss(unravel(p), batch, cfg)
+    def loss_tree(p, batch):
+        return compute_loss(p, batch, cfg)
 
-    client_round = jax.jit(build_client_round(cfg, loss_flat, B))
+    client_round = jax.jit(build_client_round(
+        cfg, None, B, tree_loss=loss_tree, unravel=unravel))
     server_round = jax.jit(build_server_round(cfg))
 
     rng = np.random.RandomState(0)

@@ -202,6 +202,10 @@ class Config:
     # GPT-2: rematerialise transformer blocks in backward (activation
     # memory ~ 1/n_layer, ~1/3 extra FLOPs) — the long-context lever
     do_remat: bool = False
+    # GPT-2 attention lowering: "xla" (jax.nn.dot_product_attention)
+    # or "flash" (Pallas TPU flash-attention kernel) — see
+    # models/gpt2.py GPT2Config.attn_impl
+    attn_impl: str = "xla"
     # GPT-2: tokens per logits chunk in the chunked tied-head
     # cross-entropy (models/gpt2.py lm_nll_sums_chunked) — the
     # vocab-head temp memory scales with this chunk, not the sequence.
@@ -436,6 +440,10 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--tokens_per_chunk", type=int, default=0,
                         help="tokens per logits chunk in the chunked "
                         "vocab cross-entropy (0 = auto)")
+    parser.add_argument("--attn_impl", type=str, default="xla",
+                        choices=["xla", "flash"],
+                        help="GPT-2 attention lowering: XLA fusion or "
+                        "the Pallas TPU flash-attention kernel")
 
     return parser
 

@@ -53,6 +53,12 @@ class GPT2Config:
     # for a distributed loss.
     seq_axis: Optional[str] = None
     seq_impl: str = "ring"
+    # single-chip attention lowering: "xla" = jax.nn.dot_product_
+    # attention (XLA fusion), "flash" = the Pallas TPU flash-attention
+    # kernel (jax.experimental.pallas.ops.tpu.flash_attention) — the
+    # model-side kernel experiment; measured head-to-head in
+    # BENCHMARKS.md (scripts/gpt2_bench.py --attn_impl)
+    attn_impl: str = "xla"
     # rematerialise each transformer block's activations in the
     # backward pass (jax.checkpoint): peak activation memory drops
     # from O(n_layer * B * T * n_embd) to O(B * T * n_embd) + one
@@ -105,6 +111,30 @@ class CausalSelfAttention(nn.Module):
             attn = (ring_attention if self.cfg.seq_impl == "ring"
                     else ulysses_attention)
             out = attn(q, k, v, self.cfg.seq_axis, causal=True)
+        elif self.cfg.attn_impl == "flash" and T % 128 == 0:
+            # T % 128 != 0 (shape-probe inits, odd batch tails) falls
+            # through to the XLA path: the flash BACKWARD kernel tiles
+            # by block // 128 and traces to a broadcasting error at
+            # unaligned T (reproduced at T=8/64/200 on jax 0.9.0) —
+            # and at short T the XLA lowering wins anyway
+            # (BENCHMARKS.md flash table)
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                BlockSizes, flash_attention)
+            # kernel layout is (B, H, T, hd); scale explicitly — the
+            # kernel's default sm_scale is 1.0, XLA's is hd^-0.5.
+            # Block sizes clamp to the sequence
+            b = min(512, T)
+            blocks = BlockSizes(
+                block_q=b, block_k_major=b, block_k=b, block_b=1,
+                block_q_major_dkv=b, block_k_major_dkv=b,
+                block_k_dkv=b, block_q_dkv=b,
+                block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True,
+                sm_scale=float((C // H) ** -0.5),
+                block_sizes=blocks)
+            out = out.transpose(0, 2, 1, 3)
         else:
             out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape(B, T, C)

@@ -173,6 +173,11 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
         def _():
             out_ref[:] = jnp.zeros_like(out_ref)
 
+        # NOTE: a 1-D (c,) input block with an in-kernel reshape was
+        # measured WORSE (sketch 8.3 -> 13.4 ms at d=124M): Mosaic
+        # relayouts every chunk inside the kernel, serialized with
+        # compute, while the XLA-side 2-D relayout copy costs ~1.5 ms
+        # once and overlaps. Keep the 2-D operand.
         chunk = v_ref[:]  # (S, L) chunk t, streamed
         if one_mix:
             h = _sign_hash_chunk(t, seed, c, S, L, r)
@@ -240,7 +245,10 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
             l_idx = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
             g = t * c + s_idx * L + l_idx
             med = jnp.where(g < valid, med, 0.0)
-        out_ref[:] = med
+        # 1-D output block: the (padded_d,) estimates leave in their
+        # consumers' native linear layout (the 2-D (m*S, L) out_shape
+        # cost a d-sized relayout on the way to selection)
+        out_ref[:] = med.reshape(c)
 
     out = pl.pallas_call(
         kernel,
@@ -251,10 +259,10 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
             pl.BlockSpec((r * S, L), lambda t: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((S, L), lambda t: (t, 0),
+        out_specs=pl.BlockSpec((c,), lambda t: (t,),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((m * S, L), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((m * c,), jnp.float32),
         compiler_params=_compiler_params(4 * r * c),
         interpret=interpret,
     )(rot.astype(jnp.int32), table.astype(jnp.float32).reshape(r * S, L))
-    return out.reshape(m * c)
+    return out

@@ -132,9 +132,6 @@ class FedModel:
                                  if args.local_batch_size > 0 else 1)
         self.padded_batch_size = padded_batch_size
 
-        def loss_flat(flat_params, batch, loss=compute_loss):
-            return loss(self.unravel(flat_params), batch, args)
-
         stats_fn_flat = None
         if stats_fn is not None:
             def stats_fn_flat(flat_params, batch):
@@ -156,7 +153,7 @@ class FedModel:
             return loss(params_tree, batch, args)
 
         self._client_round = jax.jit(
-            build_client_round(args, loss_flat, padded_batch_size,
+            build_client_round(args, None, padded_batch_size,
                                mesh=self.mesh, stats_fn=stats_fn_flat,
                                tree_loss=loss_tree,
                                unravel=self.unravel),

@@ -239,6 +239,10 @@ def build_model_and_tokenizer(args: Config):
         with open(cfg_json) as f:
             blob = json.load(f)
         fields = {f.name for f in dataclasses.fields(GPT2Config)}
+        # attn_impl is a runtime lowering knob, not architecture: a
+        # config saved from a flash-attention TPU run must not force
+        # the Pallas kernel on whatever platform reloads it
+        fields.discard("attn_impl")
         cfg = GPT2Config(**{k: v for k, v in blob.items()
                             if k in fields})
     elif args.do_test or tokenizer.__class__.__name__ == "ByteTokenizer":
@@ -254,6 +258,8 @@ def build_model_and_tokenizer(args: Config):
         cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
     if args.do_remat:
         cfg = dataclasses.replace(cfg, remat=True)
+    if getattr(args, "attn_impl", "xla") != "xla":
+        cfg = dataclasses.replace(cfg, attn_impl=args.attn_impl)
     module = GPT2DoubleHeads(cfg)
     dummy = jnp.zeros((1, args.num_candidates, 8), jnp.int32)
     params = module.init(jax.random.PRNGKey(args.seed), dummy,

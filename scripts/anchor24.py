@@ -107,8 +107,14 @@ def main():
                 results = None
                 f.write(f"\nABORTED: {e!r}\n")
         if results:
+            tail = [r["test_acc"] for r in results[-5:]]
             summary[mode] = {
                 "final_acc": results[-1]["test_acc"],
+                # mean of the last 5 epochs: the ordering statistic —
+                # robust to single-epoch jitter, unlike a lone final
+                # accuracy (the fp-fragility that motivated this
+                # anchor in the first place)
+                "tail_acc": sum(tail) / len(tail),
                 "best_acc": max(r["test_acc"] for r in results),
                 "final_loss": results[-1]["train_loss"],
                 "epochs": len(results),

@@ -1,0 +1,60 @@
+"""Summarise anchor24 run logs into the mode-ordering table.
+
+Reads runs/anchor24_<mode>_s<seed>.log files (written by
+scripts/anchor24.py) and prints one row per mode: final / tail-mean
+(last 5 epochs) / best test accuracy, final train loss, wall-clock.
+Pure log parsing — reruns nothing.
+
+Usage: python scripts/anchor24_report.py [--logdir runs] [--seed 21]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+
+
+def parse_log(path):
+    rows = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 11 and re.match(r"^\d+$", parts[0]):
+                rows.append([float(x) for x in parts])
+    if not rows:
+        return None
+    test_acc = [r[7] for r in rows]
+    tail = test_acc[-5:]
+    return {
+        "epochs": len(rows),
+        "final_acc": test_acc[-1],
+        "tail_acc": round(sum(tail) / len(tail), 4),
+        "best_acc": max(test_acc),
+        "final_train_loss": rows[-1][3],
+        "final_train_acc": rows[-1][4],
+        "wall_s": rows[-1][10],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--logdir", default="runs")
+    ap.add_argument("--seed", type=int, default=21)
+    args = ap.parse_args()
+
+    out = {}
+    for path in sorted(glob.glob(os.path.join(
+            args.logdir, f"anchor24_*_s{args.seed}.log"))):
+        mode = os.path.basename(path)[len("anchor24_"):-len(
+            f"_s{args.seed}.log")]
+        rec = parse_log(path)
+        if rec:
+            out[mode] = rec
+    order = sorted(out, key=lambda m: -out[m]["tail_acc"])
+    print(json.dumps({"seed": args.seed, "ordering": order,
+                      "modes": out}, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,7 +47,8 @@ def build(args):
                  lm_coef=1.0, mc_coef=1.0)
 
     gcfg = GPT2Config(vocab_size=50262, n_positions=1024,
-                      dtype=jnp.bfloat16, remat=args.remat)
+                      dtype=jnp.bfloat16, remat=args.remat,
+                      attn_impl=args.attn_impl)
     module = GPT2DoubleHeads(gcfg)
     dummy = jnp.zeros((1, args.candidates, 8), jnp.int32)
     params = module.init(jax.random.PRNGKey(0), dummy,
@@ -58,14 +59,11 @@ def build(args):
 
     compute_loss = make_compute_loss_train(module, cfg)
 
-    def loss_flat(p, batch):
-        return compute_loss(unravel(p), batch, cfg)
-
     def loss_tree(p, batch):
         return compute_loss(p, batch, cfg)
 
     client_round = jax.jit(build_client_round(
-        cfg, loss_flat, args.examples,
+        cfg, None, args.examples,
         tree_loss=loss_tree, unravel=unravel))
     server_round = jax.jit(build_server_round(cfg))
 
@@ -123,7 +121,8 @@ def build_bare(args):
                  dataset_name="PERSONA", seed=21,
                  num_candidates=args.candidates)
     gcfg = GPT2Config(vocab_size=50262, n_positions=1024,
-                      dtype=jnp.bfloat16, remat=args.remat)
+                      dtype=jnp.bfloat16, remat=args.remat,
+                      attn_impl=args.attn_impl)
     module = GPT2DoubleHeads(gcfg)
     dummy = jnp.zeros((1, args.candidates, 8), jnp.int32)
     params = module.init(jax.random.PRNGKey(0), dummy,
@@ -214,6 +213,8 @@ def main():
                     help="exact top-k selection (the trainer default) "
                          "instead of approx_max_k 0.95")
     ap.add_argument("--mode", default="sketch")
+    ap.add_argument("--attn_impl", default="xla",
+                    choices=["xla", "flash"])
     ap.add_argument("--profile", type=str, default=None)
     args = ap.parse_args()
 

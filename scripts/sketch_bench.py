@@ -1,0 +1,135 @@
+"""Sketch-pipeline micro-bench: per-stage times at a given geometry.
+
+Isolates the d-bound pieces of the federated sketch round (client
+sketch, recovery estimates, selection, sparse resketch) so kernel work
+can be attributed without a full-model xplane (VERDICT round-3 task #3
+— the ~25 ms sketch constant at GPT-2 scale). ``--tree`` times
+``sketch_from_leaves`` over a GPT-2-shaped leaf list against the flat
+``sketch`` + its pad.
+
+Usage:
+  python scripts/sketch_bench.py [--d 124439808] [--c 524288] [--r 5]
+      [--k 50000] [--reps 20] [--tree]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3, out
+
+
+def gpt2_like_shapes(d):
+    """A leaf-shape list shaped like GPT-2 124M (embeddings + 12 x
+    (attn + mlp + ln) + final ln), scaled so totals sum to d."""
+    shapes = [(50257, 768), (1024, 768)]
+    for _ in range(12):
+        shapes += [(768,), (768,), (768, 2304), (2304,), (768, 768),
+                   (768,), (768,), (768,), (768, 3072), (3072,),
+                   (3072, 768), (768,)]
+    shapes += [(768,), (768,)]
+    total = sum(int(np.prod(s)) for s in shapes)
+    if total > d:
+        # small-d smoke: keep the leaf-count/size mix (one embedding-
+        # like big leaf + interleaved matrices and vectors), scaled
+        scale = d / total
+        shapes = [(max(1, int(s[0] * scale)),) + tuple(s[1:])
+                  for s in shapes]
+        total = sum(int(np.prod(s)) for s in shapes)
+        assert total <= d, (total, d)
+    if total < d:
+        shapes.append((d - total,))
+    return shapes
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d", type=int, default=124_439_808)
+    ap.add_argument("--c", type=int, default=524288)
+    ap.add_argument("--r", type=int, default=5)
+    ap.add_argument("--k", type=int, default=50000)
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--backend", default="auto")
+    ap.add_argument("--tree", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU platform (the container's "
+                    "sitecustomize overrides JAX_PLATFORMS)")
+    args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from commefficient_tpu.ops.sketch import CountSketch
+    from commefficient_tpu.ops.topk import threshold_topk_indices
+
+    cs = CountSketch(d=args.d, c=args.c, r=args.r, seed=21,
+                     backend=args.backend)
+    rng = np.random.RandomState(0)
+    v = jnp.asarray(rng.randn(args.d).astype(np.float32))
+    res = {"geometry": {"d": args.d, "c": args.c, "r": args.r,
+                        "k": args.k,
+                        "backend": cs._resolve_backend()}}
+
+    ms, table = timed(jax.jit(cs.sketch), v, reps=args.reps)
+    res["sketch_flat_ms"] = round(ms, 2)
+
+    if args.tree:
+        shapes = gpt2_like_shapes(args.d)
+        leaves = []
+        off = 0
+        for s in shapes:
+            n = int(np.prod(s))
+            leaves.append(jax.device_put(
+                jax.lax.dynamic_slice(v, (off,), (n,)).reshape(s)))
+            off += n
+        assert off == args.d, (off, args.d)
+
+        fn = jax.jit(lambda ls: cs.sketch_from_leaves(ls))
+        ms, table_t = timed(fn, leaves, reps=args.reps)
+        res["sketch_from_leaves_ms"] = round(ms, 2)
+        res["tables_equal"] = bool(jnp.array_equal(table, table_t))
+
+    ms, est = timed(jax.jit(lambda t: cs.estimates(t, padded=True)),
+                    table, reps=args.reps)
+    res["estimates_padded_ms"] = round(ms, 2)
+    ms, _ = timed(jax.jit(lambda t: cs.estimates(t)), table,
+                  reps=args.reps)
+    res["estimates_sliced_ms"] = round(ms, 2)
+
+    ms, idx = timed(
+        jax.jit(lambda e: threshold_topk_indices(jax.lax.square(e),
+                                                 args.k)),
+        est, reps=args.reps)
+    res["threshold_select_ms"] = round(ms, 2)
+
+    vals = est[idx]
+    ms, _ = timed(jax.jit(cs.sketch_sparse), idx, vals,
+                  reps=args.reps)
+    res["sparse_resketch_ms"] = round(ms, 2)
+
+    ms, _ = timed(jax.jit(lambda t, k=args.k: cs.unsketch(
+        t, k, with_support=True, with_dense=False)), table,
+        reps=args.reps)
+    res["unsketch_sparse_total_ms"] = round(ms, 2)
+
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

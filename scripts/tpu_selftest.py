@@ -95,6 +95,44 @@ def bf16_round_trains():
     return f"update nnz {nnz}"
 
 
+def flash_attention_parity():
+    """attn_impl="flash" (Pallas flash-attention kernel) vs the XLA
+    attention lowering on the same GPT-2 block — forward and gradient
+    agreement at bf16 tolerance."""
+    import dataclasses
+
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+
+    base = GPT2Config(vocab_size=512, n_positions=512, n_embd=256,
+                      n_layer=2, n_head=4, dtype=jnp.bfloat16)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, 512, (2, 2, 256)), jnp.int32)
+    mc = jnp.full((2, 2), 255, jnp.int32)
+
+    outs = {}
+    for impl in ("xla", "flash"):
+        cfg = dataclasses.replace(base, attn_impl=impl)
+        m = GPT2DoubleHeads(cfg)
+        p = m.init(jax.random.PRNGKey(0), ids, mc, ids)["params"]
+
+        def loss(pp, m=m):
+            lm, mcl = m.apply({"params": pp}, ids, mc, ids)
+            return jnp.sum(lm.astype(jnp.float32) ** 2) * 1e-6 + \
+                jnp.sum(mcl.astype(jnp.float32) ** 2) * 1e-3
+
+        l, g = jax.jit(jax.value_and_grad(loss))(p)
+        gflat = jnp.concatenate([jnp.ravel(x) for x in
+                                 jax.tree_util.tree_leaves(g)])
+        outs[impl] = (float(l), np.asarray(gflat, np.float32))
+    lx, gx = outs["xla"]
+    lf, gf = outs["flash"]
+    assert abs(lx - lf) / max(abs(lx), 1e-6) < 2e-2, (lx, lf)
+    denom = np.maximum(np.abs(gx), 1e-3)
+    rel = np.abs(gx - gf) / denom
+    assert np.median(rel) < 2e-2, float(np.median(rel))
+    return f"loss {lx:.4f} vs {lf:.4f}, median grad rel {np.median(rel):.2e}"
+
+
 def bench_throughput():
     """Headline bench must clear the BASELINE north-star (>= 8x)."""
     import json
@@ -112,6 +150,7 @@ def main():
     print(f"devices: {jax.devices()}")
     check("pallas_vs_xla_sketch_parity", pallas_parity)
     check("bf16_flagship_round", bf16_round_trains)
+    check("flash_attention_parity", flash_attention_parity)
     check("bench_vs_baseline", bench_throughput)
     if FAILED:
         print(f"\n{len(FAILED)} check(s) failed: {FAILED}")

@@ -48,6 +48,28 @@ class TestSketchFromLeaves:
         np.testing.assert_array_equal(np.asarray(t_flat),
                                       np.asarray(t_tree))
 
+    def test_matches_on_scan_path(self):
+        # m = ceil(d/c) > _UNROLL_LIMIT takes the chunk-scan kernel;
+        # the leaf assembly must agree there too
+        from commefficient_tpu.ops.sketch import _UNROLL_LIMIT
+        c = 32
+        d = (_UNROLL_LIMIT + 5) * c + 7
+        rng = np.random.RandomState(9)
+        sizes = []
+        left = d
+        while left > 0:
+            n = min(left, int(rng.randint(1, 4000)))
+            sizes.append((n,))
+            left -= n
+        tree = _leaf_tree(9, sizes)
+        flat, _ = flatten_params(tree)
+        assert flat.size == d
+        cs = CountSketch(d=d, c=c, r=3, backend="xla")
+        np.testing.assert_array_equal(
+            np.asarray(cs.sketch(flat)),
+            np.asarray(cs.sketch_from_leaves(
+                jax.tree_util.tree_leaves(tree))))
+
     def test_wrong_total_size_raises(self):
         tree = _leaf_tree(2, [(4, 4)])
         cs = CountSketch(d=99, c=64, r=2, backend="xla")
