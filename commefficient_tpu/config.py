@@ -206,6 +206,11 @@ class Config:
     # or "flash" (Pallas TPU flash-attention kernel) — see
     # models/gpt2.py GPT2Config.attn_impl
     attn_impl: str = "xla"
+    # sketch rotation granularity (ops/sketch.py CountSketch.rot_lanes):
+    # 0 = full (default); >0 quantizes rotations to multiples of this,
+    # turning the kernels' rolls sublane-only. Quality at the flagship
+    # ratio measured indistinguishable (scripts/rot_quality.py)
+    sketch_rot_lanes: int = 0
     # GPT-2: tokens per logits chunk in the chunked tied-head
     # cross-entropy (models/gpt2.py lm_nll_sums_chunked) — the
     # vocab-head temp memory scales with this chunk, not the sequence.
@@ -444,6 +449,11 @@ def build_parser(default_lr: Optional[float] = None,
                         choices=["xla", "flash"],
                         help="GPT-2 attention lowering: XLA fusion or "
                         "the Pallas TPU flash-attention kernel")
+    parser.add_argument("--sketch_rot_lanes", type=int, default=0,
+                        help="quantize sketch rotations to multiples "
+                        "of this lane width (0 = full granularity); "
+                        "speeds the Pallas kernels' rolls, see "
+                        "BENCHMARKS.md")
 
     return parser
 

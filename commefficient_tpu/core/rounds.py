@@ -90,7 +90,8 @@ def args2sketch(cfg: Config) -> Optional[CountSketch]:
     return CountSketch(d=cfg.grad_size, c=cfg.num_cols, r=cfg.num_rows,
                        num_blocks=cfg.num_blocks, seed=cfg.seed,
                        approx_topk=cfg.approx_topk,
-                       approx_recall=cfg.approx_recall)
+                       approx_recall=cfg.approx_recall,
+                       rot_lanes=getattr(cfg, "sketch_rot_lanes", 0))
 
 
 def build_client_round(cfg: Config, loss_fn: Optional[Callable],
@@ -507,16 +508,21 @@ def build_server_round(cfg: Config) -> Callable:
             # lr-scaled update values — apply them as a k-sized
             # scatter instead of materialising + subtracting a dense
             # (d,) vector (~6 ms saved per round at GPT-2's d=124M).
-            # Selection indices are unique by construction; sorting
-            # (free for the threshold path, a k-sized sort otherwise)
-            # lets XLA take the in-place ordered-scatter lowering
-            # instead of a d-sized rewrite fusion (measured 4.4 ms in
-            # the round-4 xplane)
+            # Sorting (free for the threshold path, a k-sized sort
+            # otherwise) lets XLA take the in-place ordered-scatter
+            # lowering instead of a d-sized rewrite fusion (measured
+            # 4.4 ms in the round-4 xplane). unique_indices holds for
+            # the exact/threshold selections but NOT for the big-d
+            # approx path, whose degenerate-tie guard clamps
+            # out-of-range slots to duplicate (d-1, 0) pairs that rely
+            # on scatter-ADD semantics (ops/sketch.py unsketch)
+            unique = not (cfg.approx_topk
+                          and cfg.grad_size >= (1 << 20))
             idx, scaled = res.support
             order = jnp.argsort(idx)
             new_ps = ps_weights.at[idx[order]].add(
                 -scaled[order], mode="promise_in_bounds",
-                unique_indices=True, indices_are_sorted=True)
+                unique_indices=unique, indices_are_sorted=True)
         else:
             new_ps = ps_weights - res.weight_update
         new_vel = client_velocities

@@ -122,8 +122,10 @@ class CausalSelfAttention(nn.Module):
                 BlockSizes, flash_attention)
             # kernel layout is (B, H, T, hd); scale explicitly — the
             # kernel's default sm_scale is 1.0, XLA's is hd^-0.5.
-            # Block sizes clamp to the sequence
-            b = min(512, T)
+            # Block size must DIVIDE the sequence, not just bound it
+            # (T=768 with block 512 raises in the kernel); the T % 128
+            # guard above guarantees a divisor exists in this list
+            b = next(x for x in (512, 256, 128) if T % x == 0)
             blocks = BlockSizes(
                 block_q=b, block_k_major=b, block_k=b, block_b=1,
                 block_q_major_dkv=b, block_k_major_dkv=b,

@@ -118,6 +118,19 @@ class CountSketch:
     # tables agree to ULP-level summation-order tolerance, recovery
     # from a given table is bit-exact.
     backend: str = "auto"
+    # > 0: quantize rotations to multiples of this lane width, so the
+    # Pallas kernels' per-(row, chunk) circular shift becomes a SINGLE
+    # sublane roll instead of the 5-op arbitrary-shift decomposition
+    # (the kernels are VPU-bound on rolls at large d). Collision
+    # tradeoff: coords in chunks t != t' with equal lane offset
+    # (j ≡ j' mod rot_lanes, a 1/rot_lanes fraction of pairs) collide
+    # with probability rot_lanes/c instead of 1/c; all other cross-
+    # chunk pairs never collide. The AVERAGE per-pair collision rate
+    # stays 1/c, so expected recovery error is unchanged while the
+    # tail is heavier — quality measured in scripts/rot_quality.py
+    # and BENCHMARKS.md before any default changes. 0 = off (full-
+    # granularity rotations, the reference-quality default).
+    rot_lanes: int = 0
 
     def __post_init__(self):
         assert self.d > 0 and self.c > 0 and self.r > 0
@@ -143,7 +156,9 @@ class CountSketch:
     def _rotations(self) -> np.ndarray:
         """(r, m) rotations in [0, c) — computed host-side in numpy so
         the rolls below get *static* shifts (XLA lowers them to plain
-        slice+concat instead of dynamic-slice chains)."""
+        slice+concat instead of dynamic-slice chains). With
+        ``rot_lanes`` set, rotations are drawn uniformly from the
+        c/rot_lanes multiples of rot_lanes (see the field comment)."""
         rot_seed, _ = self._seeds()
         rows = np.arange(self.r, dtype=np.uint32)[:, None]
         chunks = np.arange(self._m, dtype=np.uint32)[None, :]
@@ -151,6 +166,16 @@ class CountSketch:
             h = _np_mix(rows * np.uint32(0x7FEB352D)
                         ^ chunks * np.uint32(0x846CA68B)
                         ^ rot_seed)
+        if self.rot_lanes > 0:
+            assert self.c % self.rot_lanes == 0, (self.c, self.rot_lanes)
+            # the rotation space must stay large: c/rot_lanes distinct
+            # rotations bound the same-lane-offset collision rate at
+            # rot_lanes/c per row. At c == rot_lanes every rotation is
+            # zero and stride-c pairs collide in EVERY row — degenerate
+            assert self.c // self.rot_lanes >= 8, \
+                f"rot_lanes {self.rot_lanes} too coarse for c={self.c}"
+            s = np.uint32(self.c // self.rot_lanes)
+            return ((h % s) * np.uint32(self.rot_lanes)).astype(np.int64)
         return (h % np.uint32(self.c)).astype(np.int64)
 
     @property
@@ -202,6 +227,24 @@ class CountSketch:
 
     # --- sketching (accumulateVec) --------------------------------------
 
+    def _check_rot_lanes_engage(self):
+        """rot_lanes only pays off when the kernels' roll collapses to
+        a sublane roll, i.e. rot_lanes is a multiple of the lane width
+        the kernel picks for this c. Otherwise the user eats the
+        heavier collision tail for zero speedup — warn once."""
+        if self.rot_lanes <= 0:
+            return
+        from commefficient_tpu.ops.sketch_pallas import _pick_lanes
+        L = _pick_lanes(self.c)
+        if L is not None and self.rot_lanes % L != 0:
+            import logging
+            logging.getLogger(__name__).warning(
+                "rot_lanes=%d is not a multiple of the kernel lane "
+                "width %d for c=%d: rotations are quantized (heavier "
+                "collision tail) but the sublane fast path does NOT "
+                "engage — use rot_lanes=%d",
+                self.rot_lanes, L, self.c, L)
+
     def _resolve_backend(self) -> str:
         if self.backend != "auto":
             return self.backend
@@ -247,11 +290,13 @@ class CountSketch:
         backend = self._resolve_backend()
         if backend in ("pallas", "pallas_interpret"):
             from commefficient_tpu.ops.sketch_pallas import sketch_pallas
+            self._check_rot_lanes_engage()
             _, sign_seed = self._seeds()
             return sketch_pallas(vp, jnp.asarray(self._rotations()),
                                  c, self.r, int(sign_seed),
                                  backend == "pallas_interpret",
-                                 one_mix=self._one_mix_signs)
+                                 one_mix=self._one_mix_signs,
+                                 rot_step=self.rot_lanes)
         rot = self._rotations()  # host constants -> static rolls
 
         if m <= _UNROLL_LIMIT:
@@ -312,7 +357,8 @@ class CountSketch:
                                    c, self.r, int(sign_seed),
                                    backend == "pallas_interpret",
                                    one_mix=self._one_mix_signs,
-                                   valid=self.d if padded else None)
+                                   valid=self.d if padded else None,
+                                   rot_step=self.rot_lanes)
             return est if padded else est[: self.d]
         rot = self._rotations()
 

@@ -151,20 +151,24 @@ def _median_network(vals):
     return 0.5 * (v[n // 2 - 1] + v[n // 2])
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
 def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
                   interpret: bool = False, lanes: int | None = None,
-                  one_mix: bool = False):
+                  one_mix: bool = False, rot_step: int = 0):
     """(padded_d,) signed-rotate-accumulate -> (r, c) table.
 
     ``vp`` is the zero-padded flat vector (padded_d = m*c); ``rot`` is
     the (r, m) int32 host-derived rotation table (static per operator,
-    passed as an array so the kernel is geometry-cached)."""
+    passed as an array so the kernel is geometry-cached). ``rot_step``
+    > 0 promises every rotation is a multiple of it; when that step is
+    lane-aligned the 5-op arbitrary-shift roll collapses to a single
+    sublane roll (CountSketch.rot_lanes)."""
     L = lanes or _pick_lanes(c)
     assert L is not None and c % L == 0
     S = c // L
     m = vp.size // c
     seed = np.uint32(sign_seed)
+    sublane = rot_step > 0 and rot_step % L == 0
 
     def kernel(rot_ref, v_ref, out_ref):
         t = pl.program_id(0)
@@ -187,7 +191,11 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
                      for row in range(r)]
         for row in range(r):
             signed = chunk * signs[row]
-            rolled = _roll1d(signed, rot_ref[row, t], S, L)
+            if sublane:
+                rolled = pltpu.roll(signed, rot_ref[row, t] // L,
+                                    axis=0)
+            else:
+                rolled = _roll1d(signed, rot_ref[row, t], S, L)
             sl = slice(row * S, (row + 1) * S)
             out_ref[sl, :] = out_ref[sl, :] + rolled
 
@@ -208,10 +216,11 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
     return out.reshape(r, c)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
 def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
                      interpret: bool = False, lanes: int | None = None,
-                     one_mix: bool = False, valid: int | None = None):
+                     one_mix: bool = False, valid: int | None = None,
+                     rot_step: int = 0):
     """(r, c) table -> (padded_d,) median-of-rows estimates, fused
     (the (r, padded_d) intermediate of the XLA path never exists).
 
@@ -223,6 +232,7 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
     S = c // L
     m = rot.shape[1]
     seed = np.uint32(sign_seed)
+    sublane = rot_step > 0 and rot_step % L == 0
 
     def kernel(rot_ref, tab_ref, out_ref):
         t = pl.program_id(0)
@@ -237,7 +247,10 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
             trow = tab_ref[row * S:(row + 1) * S, :]
             o = rot_ref[row, t]
             back = (jnp.int32(c) - o) % jnp.int32(c)
-            unrolled = _roll1d(trow, back, S, L)
+            if sublane:
+                unrolled = pltpu.roll(trow, back // L, axis=0)
+            else:
+                unrolled = _roll1d(trow, back, S, L)
             vals.append(unrolled * signs[row])
         med = _median_network(vals)
         if valid is not None and valid < m * c:

@@ -47,7 +47,8 @@ MODE_FLAGS = {
 def common_flags(args):
     flags = [
         "--dataset_name", "Synthetic",
-        "--num_clients", "10000", "--synthetic_per_class", "5000",
+        "--num_clients", str(args.num_clients),
+        "--synthetic_per_class", "5000",
         "--synthetic_separation", str(args.separation),
         "--synthetic_num_val", "2000",
         "--num_workers", "100",
@@ -67,6 +68,15 @@ def main():
     ap.add_argument("--seed", type=int, default=21)
     ap.add_argument("--epochs", type=float, default=24)
     ap.add_argument("--separation", type=float, default=0.025)
+    # local_topk's per-client dense error/momentum state is
+    # (num_clients, d) f32 — 263 GB at the 10 000-client paper
+    # geometry, infeasible for ANY single machine (the reference's
+    # host-shm design included, fed_aggregator.py:116-129). Run that
+    # mode at the largest fitting federation (e.g. 250 clients x 200
+    # images: 6.6 GB of state) and footnote the geometry change.
+    ap.add_argument("--num_clients", type=int, default=10000)
+    ap.add_argument("--suffix", default="",
+                    help="log-name suffix, e.g. _c250")
     ap.add_argument("--logdir", default="runs")
     args = ap.parse_args()
 
@@ -75,7 +85,7 @@ def main():
 
     ceiling = FedSynthetic(
         "", "Synthetic", train=False, do_iid=False,
-        num_clients=10000, per_class=5000, num_val=2000,
+        num_clients=args.num_clients, per_class=5000, num_val=2000,
         separation=args.separation, seed=args.seed).bayes_accuracy()
     print(f"Bayes ceiling at separation {args.separation}: "
           f"{ceiling:.4f}", flush=True)
@@ -89,7 +99,8 @@ def main():
         # (fedavg's -1 = local SGD over the client's full 5-image
         # shard is in its MODE_FLAGS)
         log_path = os.path.join(
-            args.logdir, f"anchor24_{mode}_s{args.seed}.log")
+            args.logdir,
+            f"anchor24_{mode}{args.suffix}_s{args.seed}.log")
         print(f"== {mode} -> {log_path}", flush=True)
         # stream to the file as the run goes: a mid-run kill keeps
         # the epochs so far instead of discarding a buffered log
@@ -121,6 +132,7 @@ def main():
             }
         else:
             summary[mode] = {"final_acc": float("nan"),
+                             "tail_acc": float("nan"),
                              "best_acc": float("nan"),
                              "final_loss": float("nan"), "epochs": 0}
         print(f"   {mode}: {summary[mode]}", flush=True)

@@ -48,10 +48,12 @@ def gpt2_like_shapes(d):
     total = sum(int(np.prod(s)) for s in shapes)
     if total > d:
         # small-d smoke: keep the leaf-count/size mix (one embedding-
-        # like big leaf + interleaved matrices and vectors), scaled
+        # like big leaf + interleaved matrices and vectors), scaled;
+        # leaves whose scaled leading dim rounds to zero are DROPPED —
+        # flooring them to one full row overshoots d at small scales
         scale = d / total
-        shapes = [(max(1, int(s[0] * scale)),) + tuple(s[1:])
-                  for s in shapes]
+        shapes = [(int(s[0] * scale),) + tuple(s[1:]) for s in shapes]
+        shapes = [s for s in shapes if s[0] > 0]
         total = sum(int(np.prod(s)) for s in shapes)
         assert total <= d, (total, d)
     if total < d:

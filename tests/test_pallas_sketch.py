@@ -82,3 +82,78 @@ def test_pallas_linearity():
     np.testing.assert_allclose(
         np.asarray(pal.sketch(a) + pal.sketch(b)),
         np.asarray(pal.sketch(a + b)), rtol=1e-5, atol=1e-5)
+
+
+class TestSublaneRotations:
+    """rot_lanes > 0: quantized rotations, single-sublane-roll kernel
+    fast path. Backend equivalence must hold exactly as for the
+    full-granularity operator."""
+
+    def test_rotations_are_quantized(self):
+        cs = CountSketch(d=5000, c=1024, r=3, seed=7, rot_lanes=128)
+        rot = cs._rotations()
+        assert (rot % 128 == 0).all()
+        assert rot.max() < 1024
+
+    def test_degenerate_granularity_rejected(self):
+        import pytest as _pytest
+        cs = CountSketch(d=5000, c=1024, r=3, seed=7, rot_lanes=1024)
+        with _pytest.raises(AssertionError):
+            cs._rotations()
+
+    def test_sketch_backends_match(self):
+        d, c, r = 5000, 1024, 3
+        xla = CountSketch(d=d, c=c, r=r, seed=7, backend="xla",
+                          rot_lanes=128)
+        pal = CountSketch(d=d, c=c, r=r, seed=7,
+                          backend="pallas_interpret", rot_lanes=128)
+        v = jnp.asarray(np.random.RandomState(3).randn(d)
+                        .astype(np.float32))
+        np.testing.assert_allclose(np.asarray(xla.sketch(v)),
+                                   np.asarray(pal.sketch(v)),
+                                   rtol=1e-6, atol=1e-5)
+
+    def test_estimates_backends_bit_exact(self):
+        d, c, r = 5000, 1024, 3
+        xla = CountSketch(d=d, c=c, r=r, seed=7, backend="xla",
+                          rot_lanes=128)
+        pal = CountSketch(d=d, c=c, r=r, seed=7,
+                          backend="pallas_interpret", rot_lanes=128)
+        table = jnp.asarray(np.random.RandomState(4).randn(r, c)
+                            .astype(np.float32))
+        np.testing.assert_array_equal(np.asarray(xla.estimates(table)),
+                                      np.asarray(pal.estimates(table)))
+
+    def test_linearity_and_recovery_still_work(self):
+        # c/rot_lanes = 512 — the flagship ratio (c=2^19, lanes 1024);
+        # coarse ratios (say 8) measurably hurt recovery and are not
+        # what the knob is for
+        d, c, r, k = 200000, 65536, 5, 30
+        cs = CountSketch(d=d, c=c, r=r, seed=9, backend="xla",
+                         rot_lanes=128)
+        rng = np.random.RandomState(5)
+        v = np.zeros(d, np.float32)
+        hh = rng.choice(d, k, replace=False)
+        v[hh] = rng.randn(k).astype(np.float32) * 100
+        a = jnp.asarray(v)
+        b = jnp.asarray(rng.randn(d).astype(np.float32) * 0.01)
+        np.testing.assert_allclose(
+            np.asarray(cs.sketch(a) + cs.sketch(b)),
+            np.asarray(cs.sketch(a + b)), rtol=2e-5, atol=2e-4)
+        dense = cs.unsketch(cs.sketch(a), k)
+        got = set(np.nonzero(np.asarray(dense))[0].tolist())
+        assert len(got & set(hh.tolist())) >= int(0.9 * k)
+
+    def test_sparse_resketch_matches_dense(self):
+        # hashes() must agree with the quantized rotation stream
+        d, c, r = 5000, 1024, 3
+        cs = CountSketch(d=d, c=c, r=r, seed=11, backend="xla",
+                         rot_lanes=128)
+        rng = np.random.RandomState(6)
+        idx = jnp.asarray(np.sort(rng.choice(d, 40, replace=False))
+                          .astype(np.int32))
+        vals = jnp.asarray(rng.randn(40).astype(np.float32))
+        dense = jnp.zeros(d, jnp.float32).at[idx].set(vals)
+        np.testing.assert_allclose(np.asarray(cs.sketch_sparse(idx, vals)),
+                                   np.asarray(cs.sketch(dense)),
+                                   rtol=1e-6, atol=1e-5)
