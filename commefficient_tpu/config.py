@@ -211,6 +211,13 @@ class Config:
     # turning the kernels' rolls sublane-only. Quality at the flagship
     # ratio measured indistinguishable (scripts/rot_quality.py)
     sketch_rot_lanes: int = 0
+    # scan the round's client fan-out in chunks of this many clients
+    # (0 = all at once): caps live per-client intermediates at
+    # chunk x d — the memory lever for large-W rounds of the local-
+    # state modes on one chip (the reference's serial per-worker client
+    # loop bounds memory the same way, fed_worker.py:59-133). Ignored
+    # on a multi-device mesh (the client axis is already divided).
+    client_chunk: int = 0
     # GPT-2: tokens per logits chunk in the chunked tied-head
     # cross-entropy (models/gpt2.py lm_nll_sums_chunked) — the
     # vocab-head temp memory scales with this chunk, not the sequence.
@@ -454,6 +461,11 @@ def build_parser(default_lr: Optional[float] = None,
                         "of this lane width (0 = full granularity); "
                         "speeds the Pallas kernels' rolls, see "
                         "BENCHMARKS.md")
+    parser.add_argument("--client_chunk", type=int, default=0,
+                        help="scan the round's client fan-out in "
+                        "chunks of this many clients (0 = all at "
+                        "once) — memory lever for large rounds of "
+                        "the local-state modes on one chip")
 
     return parser
 

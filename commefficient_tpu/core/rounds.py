@@ -257,6 +257,13 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         W = client_ids.shape[0]
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(client_ids)
 
+        chunk = getattr(cfg, "client_chunk", 0)
+        ndev = mesh.devices.size if mesh is not None else 1
+        if 0 < chunk < W and ndev == 1:
+            return _client_round_chunked(ps_weights, client_states,
+                                         batch, client_ids, rngs,
+                                         fedavg_lr, chunk)
+
         vel_rows = (client_states.velocities[client_ids]
                     if client_states.velocities is not None else None)
         err_rows = (client_states.errors[client_ids]
@@ -283,6 +290,98 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             _scatter(client_states.errors, client_ids, new_err),
             _scatter(client_states.weights, client_ids, new_wts),
         )
+        return RoundResult(aggregated, metrics, states,
+                           _round_bn_stats(stats_fn, ps_weights, batch))
+
+    def _client_round_chunked(ps_weights, client_states, batch,
+                              client_ids, rngs, fedavg_lr, chunk):
+        """--client_chunk: scan over chunks of the round's client
+        fan-out, capping live per-client intermediates at chunk x d
+        instead of W x d. The reference gets this bound for free by
+        running clients SERIALLY per worker process (fed_worker.py:
+        59-133); the full vmap is that loop unrolled onto one chip,
+        which at W=100, d=6.6M local_topk masking costs ~13 GB of HLO
+        temps (measured OOM). Same math: transmits accumulate into the
+        running sum chunk by chunk, per-client states scatter back as
+        each chunk finishes. Single-device path — on a mesh the client
+        axis is already divided across devices."""
+        W = client_ids.shape[0]
+        n_chunks = -(-W // chunk)
+        pad = n_chunks * chunk - W
+
+        def pad0(x):
+            return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) \
+                if pad else x
+
+        # padded slots carry an OUT-OF-RANGE client id: their state
+        # gathers clamp (values discarded — all-zero mask makes the
+        # step a no-op) and their state scatters are DROPPED (JAX's
+        # default out-of-bounds scatter semantics), so no real
+        # client's row is ever touched by a pad slot. Padding with a
+        # real id (e.g. 0) would both advance that client's topk_down
+        # weights (new_wts has no alive guard) and race its update
+        # when it shares the padded chunk.
+        sentinel = jnp.iinfo(jnp.int32).max
+        ids_p = (jnp.concatenate(
+            [client_ids,
+             jnp.full((pad,), sentinel, client_ids.dtype)])
+            if pad else client_ids).reshape(n_chunks, chunk)
+        rngs_p = pad0(rngs).reshape((n_chunks, chunk) +
+                                    rngs.shape[1:])
+        batch_p = {k: pad0(v).reshape((n_chunks, chunk) + v.shape[1:])
+                   for k, v in batch.items()}
+        total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+
+        def body(carry, inp):
+            acc, states = carry
+            ids_c, rngs_c, batch_c = inp
+            vel_r = (states.velocities[ids_c]
+                     if states.velocities is not None else None)
+            err_r = (states.errors[ids_c]
+                     if states.errors is not None else None)
+            wt_r = (states.weights[ids_c]
+                    if states.weights is not None else None)
+            transmit, metrics, new_vel, new_err, new_wts = jax.vmap(
+                per_client, in_axes=(None, 0, 0, 0, 0, 0, None)
+            )(ps_weights, _some(vel_r, chunk), _some(err_r, chunk),
+              _some(wt_r, chunk), batch_c, rngs_c, fedavg_lr)
+            states = ClientStates(
+                _scatter(states.velocities, ids_c, new_vel),
+                _scatter(states.errors, ids_c, new_err),
+                _scatter(states.weights, ids_c, new_wts),
+            )
+            return (acc + jnp.sum(transmit, axis=0), states), metrics
+
+        if sketch_late:
+            # chunked + sketch-late: sketch each chunk's dense sum and
+            # accumulate tables (linearity) — the (W, d) transmit
+            # stack never exists
+            def body_sketch(carry, inp):
+                table_acc, states = carry
+                ids_c, rngs_c, batch_c = inp
+                (chunk_sum, states), metrics = body(
+                    (jnp.zeros(cfg.grad_size, jnp.float32), states),
+                    inp)
+                return (table_acc + sketch.sketch(chunk_sum),
+                        states), metrics
+
+            (table, states), metrics = jax.lax.scan(
+                body_sketch,
+                (jnp.zeros((sketch.r, sketch.c), jnp.float32),
+                 client_states),
+                (ids_p, rngs_p, batch_p))
+            aggregated = table / total
+        else:
+            # transmit_shape covers both dense (d,) transmits and the
+            # (r, c) tables of the clipped (non-late) sketch path
+            (acc, states), metrics = jax.lax.scan(
+                body,
+                (jnp.zeros(cfg.transmit_shape, jnp.float32),
+                 client_states),
+                (ids_p, rngs_p, batch_p))
+            aggregated = acc / total
+
+        metrics = tuple(m.reshape(-1)[:W] for m in metrics)
         return RoundResult(aggregated, metrics, states,
                            _round_bn_stats(stats_fn, ps_weights, batch))
 

@@ -134,6 +134,7 @@ class CountSketch:
 
     def __post_init__(self):
         assert self.d > 0 and self.c > 0 and self.r > 0
+        self._check_rot_lanes_engage()
 
     # --- hashing ---------------------------------------------------------
 
@@ -290,7 +291,6 @@ class CountSketch:
         backend = self._resolve_backend()
         if backend in ("pallas", "pallas_interpret"):
             from commefficient_tpu.ops.sketch_pallas import sketch_pallas
-            self._check_rot_lanes_engage()
             _, sign_seed = self._seeds()
             return sketch_pallas(vp, jnp.asarray(self._rotations()),
                                  c, self.r, int(sign_seed),

@@ -76,9 +76,14 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
 
 
 def maybe_initialize_multihost_cli(args) -> None:
-    """Trainer-CLI wiring: join the multi-controller runtime when the
-    pod flags (--coordinator_address/--num_processes/--process_id) are
-    present. Shared by cv_train and gpt2_train."""
+    """Trainer-CLI wiring, shared by cv_train and gpt2_train: honor
+    --device cpu (even where a sitecustomize pre-registers an
+    accelerator plugin that outranks JAX_PLATFORMS; a no-op once JAX
+    has initialised its backends), then join the multi-controller
+    runtime when the pod flags (--coordinator_address/--num_processes/
+    --process_id) are present."""
+    if getattr(args, "device", None) == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     if args.coordinator_address is None and args.num_processes is None \
             and args.process_id is None:
         # --process_id alone still initializes (and surfaces

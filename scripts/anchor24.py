@@ -77,6 +77,9 @@ def main():
     ap.add_argument("--num_clients", type=int, default=10000)
     ap.add_argument("--suffix", default="",
                     help="log-name suffix, e.g. _c250")
+    ap.add_argument("--extra", default="",
+                    help="extra cv_train flags appended to every "
+                    "mode, e.g. '--client_chunk 10'")
     ap.add_argument("--logdir", default="runs")
     args = ap.parse_args()
 
@@ -96,6 +99,8 @@ def main():
         flags = common_flags(args) + MODE_FLAGS[mode]
         if mode != "fedavg":
             flags += ["--local_batch_size", "5"]
+        if args.extra:
+            flags += args.extra.split()
         # (fedavg's -1 = local SGD over the client's full 5-image
         # shard is in its MODE_FLAGS)
         log_path = os.path.join(

@@ -69,7 +69,13 @@ def main():
     ap.add_argument("--k", type=int, default=50000)
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--backend", default="auto")
+    ap.add_argument("--rot_lanes", type=int, default=0)
     ap.add_argument("--tree", action="store_true")
+    ap.add_argument("--chain", type=int, default=0,
+                    help="also time N chained sketch->estimates "
+                    "iterations inside ONE dispatch (fori_loop) — the "
+                    "only reliable timing through the remote relay, "
+                    "where per-dispatch latency swamps small ops")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU platform (the container's "
                     "sitecustomize overrides JAX_PLATFORMS)")
@@ -81,7 +87,7 @@ def main():
     from commefficient_tpu.ops.topk import threshold_topk_indices
 
     cs = CountSketch(d=args.d, c=args.c, r=args.r, seed=21,
-                     backend=args.backend)
+                     backend=args.backend, rot_lanes=args.rot_lanes)
     rng = np.random.RandomState(0)
     v = jnp.asarray(rng.randn(args.d).astype(np.float32))
     res = {"geometry": {"d": args.d, "c": args.c, "r": args.r,
@@ -129,6 +135,28 @@ def main():
         t, k, with_support=True, with_dense=False)), table,
         reps=args.reps)
     res["unsketch_sparse_total_ms"] = round(ms, 2)
+
+    if args.chain:
+        n = args.chain
+
+        @jax.jit
+        def chained(v0):
+            def body(i, carry):
+                v, acc = carry
+                t = cs.sketch(v)
+                e = cs.estimates(t, padded=True)
+                # feed the estimates back so no iteration is dead code
+                return e[: args.d] * 0.999, acc + t[0, 0]
+            v_out, acc = jax.lax.fori_loop(
+                0, n, body, (v0, jnp.float32(0)))
+            return acc + jnp.sum(v_out[:8])
+
+        chained(v).block_until_ready()
+        t0 = time.perf_counter()
+        out = chained(v)
+        float(out)
+        res["chain_sketch_plus_estimates_ms"] = round(
+            (time.perf_counter() - t0) / n * 1e3, 2)
 
     print(json.dumps(res))
 

@@ -1,0 +1,146 @@
+"""--client_chunk: chunked client fan-out equals the full vmap.
+
+The chunked scan (core/rounds.py _client_round_chunked) must be a pure
+memory transformation — same aggregated transmit, same per-client
+metrics, same updated per-client state, for every mode that carries
+local state, including W not divisible by the chunk (tail padding).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.core.rounds import ClientStates, build_client_round
+from commefficient_tpu.ops.vec import flatten_params
+
+
+def _setup(mode, error_type, local_momentum, W=6, B=3, D=50,
+           chunk=0, **extra):
+    kw = dict(local_batch_size=B)
+    kw.update(extra)
+    cfg = Config(mode=mode, error_type=error_type,
+                 local_momentum=local_momentum, virtual_momentum=0.0,
+                 weight_decay=0.0, num_workers=W,
+                 k=5, num_cols=32, num_rows=3,
+                 dataset_name="CIFAR10", seed=0,
+                 client_chunk=chunk, **kw)
+    rng = np.random.RandomState(1)
+    tree = {"w": jnp.asarray(rng.randn(D, 4), jnp.float32)}
+    flat, unravel = flatten_params(tree)
+    cfg.grad_size = int(flat.size)
+
+    def loss(p, b):
+        pred = b["x"] @ unravel(p)["w"]
+        per = jnp.sum((pred - b["y"]) ** 2, -1)
+        l = jnp.sum(per * b["mask"]) / jnp.maximum(
+            jnp.sum(b["mask"]), 1.0)
+        return l, (l * 2.0,)
+
+    batch = {
+        "x": jnp.asarray(rng.randn(W, B, D), jnp.float32),
+        "y": jnp.asarray(rng.randn(W, B, 4), jnp.float32),
+        "mask": jnp.ones((W, B), jnp.float32),
+    }
+    # one client padded out entirely: state-kept semantics must match
+    batch["mask"] = batch["mask"].at[2].set(0.0)
+    states = ClientStates.init(cfg, 10, flat)
+    # make pre-existing state nonzero so "kept" vs "zeroed" differs
+    states = ClientStates(
+        jnp.ones_like(states.velocities) * 0.1
+        if states.velocities is not None else None,
+        jnp.ones_like(states.errors) * 0.2
+        if states.errors is not None else None,
+        states.weights)
+    ids = jnp.asarray([0, 3, 5, 7, 1, 9, 2, 8], jnp.int32)[:W]
+    return cfg, loss, flat, batch, states, ids
+
+
+MODES = [
+    ("local_topk", "local", 0.9, {}),
+    ("uncompressed", "none", 0.9, {}),   # local momentum state path
+    ("sketch", "virtual", 0.0, {"max_grad_norm": 1.0}),  # non-fused
+    ("fedavg", "none", 0.0, {"local_batch_size": -1}),
+]
+
+
+@pytest.mark.parametrize("mode,etype,lmom,extra", MODES)
+@pytest.mark.parametrize("chunk", [2, 4])  # 4 does not divide W=6
+def test_chunked_equals_full(mode, etype, lmom, extra, chunk):
+    cfg_f, loss, flat, batch, states, ids = _setup(
+        mode, etype, lmom, **extra)
+    cfg_c, *_ = _setup(mode, etype, lmom, chunk=chunk, **extra)
+
+    key = jax.random.PRNGKey(0)
+    full = build_client_round(cfg_f, loss, 3)(
+        flat, states, batch, ids, key, 0.5)
+    chunked = build_client_round(cfg_c, loss, 3)(
+        flat, states, batch, ids, key, 0.5)
+
+    np.testing.assert_allclose(np.asarray(full.aggregated),
+                               np.asarray(chunked.aggregated),
+                               rtol=1e-6, atol=1e-6)
+    for mf, mc in zip(full.metrics, chunked.metrics):
+        np.testing.assert_allclose(np.asarray(mf), np.asarray(mc),
+                                   rtol=1e-6, atol=1e-7)
+    for f, c in zip(full.client_states, chunked.client_states):
+        if f is None:
+            assert c is None
+            continue
+        np.testing.assert_allclose(np.asarray(f), np.asarray(c),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_client_zero_in_padded_tail_chunk():
+    # the pad slots must NOT touch client 0's state: pad with a real
+    # id and (a) topk_down's unguarded new_wts writes advance client
+    # 0's stale-download row, (b) a real client 0 sharing the padded
+    # chunk races its own update against the pad's stale copy. The
+    # sentinel-id fix drops pad scatters entirely.
+    cfg_f, loss, flat, batch, states, ids = _setup(
+        "local_topk", "local", 0.9)
+    cfg_c, *_ = _setup("local_topk", "local", 0.9, chunk=4)
+    # client 0 goes LAST: chunk 4 over W=6 puts it in the padded chunk
+    ids = jnp.asarray([3, 5, 7, 1, 9, 0], jnp.int32)
+    key = jax.random.PRNGKey(0)
+    full = build_client_round(cfg_f, loss, 3)(
+        flat, states, batch, ids, key, 0.5)
+    chunked = build_client_round(cfg_c, loss, 3)(
+        flat, states, batch, ids, key, 0.5)
+    for f, c in zip(full.client_states, chunked.client_states):
+        if f is not None:
+            np.testing.assert_allclose(np.asarray(f), np.asarray(c),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_topk_down_chunked_state_untouched_by_pads():
+    cfg_f, loss, flat, batch, states, ids = _setup(
+        "local_topk", "local", 0.0, do_topk_down=True)
+    cfg_c, *_ = _setup("local_topk", "local", 0.0, chunk=4,
+                       do_topk_down=True)
+    states = ClientStates.init(cfg_f, 10, flat)
+    ids = jnp.asarray([3, 5, 7, 1, 9, 0], jnp.int32)
+    key = jax.random.PRNGKey(0)
+    full = build_client_round(cfg_f, loss, 3)(
+        flat, states, batch, ids, key, 0.5)
+    chunked = build_client_round(cfg_c, loss, 3)(
+        flat, states, batch, ids, key, 0.5)
+    np.testing.assert_allclose(
+        np.asarray(full.client_states.weights),
+        np.asarray(chunked.client_states.weights),
+        rtol=1e-6, atol=1e-7)
+
+
+def test_chunked_ignored_on_mesh(devices):
+    from jax.sharding import Mesh
+    from commefficient_tpu.parallel.mesh import CLIENT_AXIS
+    cfg, loss, flat, batch, states, ids = _setup(
+        "local_topk", "local", 0.9, W=6, chunk=2)
+    # W=8 for the mesh variant
+    cfg8, loss8, flat8, batch8, states8, ids8 = _setup(
+        "local_topk", "local", 0.9, W=8, chunk=2)
+    mesh = Mesh(np.asarray(devices), (CLIENT_AXIS,))
+    res = build_client_round(cfg8, loss8, 3, mesh=mesh)(
+        flat8, states8, batch8, ids8, jax.random.PRNGKey(0), 0.5)
+    assert bool(jnp.isfinite(res.aggregated).all())
