@@ -257,6 +257,14 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         W = client_ids.shape[0]
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(client_ids)
 
+        # dead slots (the loader pads ragged rounds with id 0 and an
+        # all-zero mask) must not touch client 0's state — and a real
+        # client 0 in the same round would otherwise RACE the pad's
+        # no-op row in the state scatter (duplicate indices, order
+        # unspecified). Remap them to an out-of-range id: gathers
+        # clamp (values unused), scatters drop.
+        client_ids = _state_ids(client_ids, batch)
+
         chunk = getattr(cfg, "client_chunk", 0)
         ndev = mesh.devices.size if mesh is not None else 1
         if 0 < chunk < W and ndev == 1:
@@ -426,6 +434,16 @@ def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh):
     return sketch.sketch(jnp.sum(transmit, axis=0))
 
 
+def _state_ids(client_ids, batch):
+    """Ids used for per-client STATE gathers/scatters: dead slots
+    (all-zero mask) get an out-of-range sentinel so their scatters
+    drop and they can never alias a live client's row. RNG folding
+    keeps the original ids (dead slots' streams are unused)."""
+    alive = jax.vmap(lambda b: jnp.sum(b["mask"]) > 0)(batch)
+    return jnp.where(alive, client_ids,
+                     jnp.iinfo(client_ids.dtype).max)
+
+
 def _some(rows, W):
     """vmap can't map over None: use a zero-size placeholder."""
     return rows if rows is not None else jnp.zeros((W, 0))
@@ -446,15 +464,18 @@ def _build_sgd_client_step(cfg, loss_fn, sketch, padded_batch_size):
     def step(ps_weights, velocity, error, client_weights, batch, rng,
              fedavg_lr):
         del fedavg_lr
+        batch_size = jnp.sum(batch["mask"])
         if cfg.do_topk_down:
             weights = stale_weight_download(cfg, ps_weights, client_weights)
-            new_wts = weights
+            # dead slots (dropout / loader padding) did not download:
+            # their stale-weight state must not advance (same
+            # state-untouched semantics as velocity/error below)
+            new_wts = jnp.where(batch_size > 0, weights, client_weights)
         else:
             weights = ps_weights
             new_wts = client_weights
 
         g_unit, metrics = forward_grad(weights, batch, noise_rng=rng)
-        batch_size = jnp.sum(batch["mask"])
         upd = accumulate_and_compress(
             cfg, g_unit,
             velocity if cfg.local_momentum > 0 else None,

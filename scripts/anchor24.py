@@ -72,11 +72,13 @@ def main():
     # (num_clients, d) f32 — 263 GB at the 10 000-client paper
     # geometry, infeasible for ANY single machine (the reference's
     # host-shm design included, fed_aggregator.py:116-129). Run that
-    # mode at the largest fitting federation (e.g. 250 clients x 200
-    # images: 6.6 GB of state) and footnote the geometry change.
+    # mode at the largest fitting federation — 100 clients x 500
+    # images with --extra "--client_chunk 10" (the 2 x 2.6 GB state
+    # buffers still double-buffer through the scan carry; 250 clients
+    # OOMed) — and footnote the geometry change.
     ap.add_argument("--num_clients", type=int, default=10000)
     ap.add_argument("--suffix", default="",
-                    help="log-name suffix, e.g. _c250")
+                    help="log-name suffix, e.g. _c100")
     ap.add_argument("--extra", default="",
                     help="extra cv_train flags appended to every "
                     "mode, e.g. '--client_chunk 10'")
@@ -100,7 +102,8 @@ def main():
         if mode != "fedavg":
             flags += ["--local_batch_size", "5"]
         if args.extra:
-            flags += args.extra.split()
+            import shlex
+            flags += shlex.split(args.extra)
         # (fedavg's -1 = local SGD over the client's full 5-image
         # shard is in its MODE_FLAGS)
         log_path = os.path.join(

@@ -44,7 +44,8 @@ def build(args):
                  dataset_name="PERSONA", seed=21,
                  approx_topk=not args.exact,
                  approx_recall=0.95, num_candidates=args.candidates,
-                 lm_coef=1.0, mc_coef=1.0)
+                 lm_coef=1.0, mc_coef=1.0,
+                 sketch_rot_lanes=args.rot_lanes)
 
     gcfg = GPT2Config(vocab_size=50262, n_positions=1024,
                       dtype=jnp.bfloat16, remat=args.remat,
@@ -215,6 +216,7 @@ def main():
     ap.add_argument("--mode", default="sketch")
     ap.add_argument("--attn_impl", default="xla",
                     choices=["xla", "flash"])
+    ap.add_argument("--rot_lanes", type=int, default=0)
     ap.add_argument("--profile", type=str, default=None)
     args = ap.parse_args()
 

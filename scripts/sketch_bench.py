@@ -26,13 +26,23 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _force(out):
+    """Force completion with a VALUE transfer: through the remote
+    relay, block_until_ready can return before the (lazily compiled)
+    program has even started — only materialising bytes on the host
+    guarantees execution finished."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x.ravel()[:1] if hasattr(x, "ravel")
+                             else x), out)
+
+
 def timed(fn, *args, reps=20):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _force(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _force(out)
     return (time.perf_counter() - t0) / reps * 1e3, out
 
 
@@ -151,7 +161,7 @@ def main():
                 0, n, body, (v0, jnp.float32(0)))
             return acc + jnp.sum(v_out[:8])
 
-        chained(v).block_until_ready()
+        float(chained(v))  # value transfer = real warmup (see _force)
         t0 = time.perf_counter()
         out = chained(v)
         float(out)

@@ -133,14 +133,59 @@ def test_topk_down_chunked_state_untouched_by_pads():
 
 
 def test_chunked_ignored_on_mesh(devices):
+    # the guard must SKIP chunking on a multi-device mesh (the client
+    # axis is already divided): a chunk=2 round on the mesh must equal
+    # the chunk=0 round on the same mesh exactly
     from jax.sharding import Mesh
     from commefficient_tpu.parallel.mesh import CLIENT_AXIS
-    cfg, loss, flat, batch, states, ids = _setup(
-        "local_topk", "local", 0.9, W=6, chunk=2)
-    # W=8 for the mesh variant
-    cfg8, loss8, flat8, batch8, states8, ids8 = _setup(
-        "local_topk", "local", 0.9, W=8, chunk=2)
+    cfg0, loss, flat, batch, states, ids = _setup(
+        "local_topk", "local", 0.9, W=8)
+    cfg2, *_ = _setup("local_topk", "local", 0.9, W=8, chunk=2)
     mesh = Mesh(np.asarray(devices), (CLIENT_AXIS,))
-    res = build_client_round(cfg8, loss8, 3, mesh=mesh)(
-        flat8, states8, batch8, ids8, jax.random.PRNGKey(0), 0.5)
-    assert bool(jnp.isfinite(res.aggregated).all())
+    key = jax.random.PRNGKey(0)
+    r0 = build_client_round(cfg0, loss, 3, mesh=mesh)(
+        flat, states, batch, ids, key, 0.5)
+    r2 = build_client_round(cfg2, loss, 3, mesh=mesh)(
+        flat, states, batch, ids, key, 0.5)
+    np.testing.assert_array_equal(np.asarray(r0.aggregated),
+                                  np.asarray(r2.aggregated))
+    for a, b in zip(r0.client_states, r2.client_states):
+        if a is not None:
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+
+
+def test_loader_zero_id_padding_cannot_touch_client_zero():
+    # the loader pads ragged rounds with id 0 + all-zero mask; those
+    # slots must leave client 0's state bit-identical — including
+    # topk_down's download-state, and including when REAL client 0 is
+    # in the same round (the duplicate-index scatter race) — on both
+    # the full and chunked paths
+    for chunk in (0, 2):
+        cfg, loss, flat, batch, states, ids = _setup(
+            "local_topk", "local", 0.9, chunk=chunk,
+            do_topk_down=True)
+        states = ClientStates.init(cfg, 10, flat)
+        # slots: real clients [0, 3, 5, 1] + two id-0 pads (dead mask)
+        ids = jnp.asarray([0, 3, 5, 1, 0, 0], jnp.int32)
+        batch["mask"] = batch["mask"].at[4:].set(0.0)
+        res = build_client_round(cfg, loss, 3)(
+            flat, states, batch, ids, jax.random.PRNGKey(0), 0.5)
+        # rows of clients NOT in the round are untouched
+        for row in (2, 4, 6, 7, 8, 9):
+            np.testing.assert_array_equal(
+                np.asarray(res.client_states.weights[row]),
+                np.asarray(states.weights[row]))
+        # client 0's weights row reflects its REAL (alive) download —
+        # deterministically, despite the dead duplicate id-0 slots
+        cfg1, *_ = _setup("local_topk", "local", 0.9,
+                          do_topk_down=True)
+        states1 = ClientStates.init(cfg1, 10, flat)
+        batch1 = {k: v[:4] for k, v in batch.items()}
+        res1 = build_client_round(cfg1, loss, 3)(
+            flat, states1, batch1, jnp.asarray([0, 3, 5, 1], jnp.int32),
+            jax.random.PRNGKey(0), 0.5)
+        np.testing.assert_allclose(
+            np.asarray(res.client_states.weights[0]),
+            np.asarray(res1.client_states.weights[0]),
+            rtol=1e-6, atol=1e-7)
