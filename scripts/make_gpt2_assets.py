@@ -20,6 +20,10 @@ Writes ``<out>/ckpt`` (vocab + weights) and ``<out>/data`` (corpus).
 
 import argparse
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def main():
