@@ -103,6 +103,14 @@ def main():
     res = {"geometry": {"d": args.d, "c": args.c, "r": args.r,
                         "k": args.k,
                         "backend": cs._resolve_backend()}}
+    if jax.default_backend() in ("axon", "tpu"):
+        # per-dispatch relay latency + value-transfer forcing swamp
+        # individual ops (device.platform reports "tpu" through the
+        # axon relay, so treat any TPU backend as relay-suspect);
+        # only the single-dispatch chained number is a kernel
+        # measurement here (see _force)
+        res["note"] = ("remote/accelerator dispatch: per-op *_ms are "
+                       "dispatch-dominated — trust chain_* only")
 
     ms, table = timed(jax.jit(cs.sketch), v, reps=args.reps)
     res["sketch_flat_ms"] = round(ms, 2)
