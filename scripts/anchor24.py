@@ -6,8 +6,11 @@ at the FetchSGD paper federation geometry (10 000 one-class clients ×
 (--synthetic_separation 0.025: Bayes ceiling ~0.86,
 FedSynthetic.bayes_accuracy) — sub-1.0 ceiling, so the anchor
 discriminates accuracy instead of saturating from epoch 1 (round-3
-review weak #1). Expected paper ordering at this pathological
-non-iid split: sketch ≈ uncompressed > local_topk > fedavg.
+review weak #1). Measured ordering (seed-stable, BENCHMARKS.md
+"24-epoch mode-ordering anchor"): true_topk ≈ sketch ≫ fedavg ≈
+uncompressed ≫ local_topk-at-one-class (chance) — the top-k family's
+selection + error feedback acts as a denoiser on the class-overlap
+task, unlike the paper's CIFAR setting where sketch ≈ uncompressed.
 
 Usage:
   python scripts/anchor24.py [--modes sketch,uncompressed,...]
