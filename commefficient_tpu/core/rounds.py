@@ -197,8 +197,15 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 make_local_loss(tree_loss), has_aux=True)(tree)
             if cfg.weight_decay != 0:
                 coef = (cfg.weight_decay / cfg.num_workers / n_shards)
+                # decay in f32 regardless of leaf dtype: the flat path
+                # computes g + coef*p on the f32 flat vector, and
+                # sketch_from_leaves casts leaves to f32 anyway — a
+                # sub-f32 param_dtype must not make the tree path
+                # accumulate the decay at lower precision than flat
                 g_tree = jax.tree_util.tree_map(
-                    lambda g, p: g + coef * p, g_tree, tree)
+                    lambda g, p: (g.astype(jnp.float32)
+                                  + coef * p.astype(jnp.float32)),
+                    g_tree, tree)
             return sketch.sketch_from_leaves(
                 jax.tree_util.tree_leaves(g_tree)), metrics
 
@@ -635,9 +642,12 @@ def build_server_round(cfg: Config) -> Callable:
             # the exact/threshold selections but NOT for the big-d
             # approx path, whose degenerate-tie guard clamps
             # out-of-range slots to duplicate (d-1, 0) pairs that rely
-            # on scatter-ADD semantics (ops/sketch.py unsketch)
-            unique = not (cfg.approx_topk
-                          and cfg.grad_size >= (1 << 20))
+            # on scatter-ADD semantics — one shared predicate with
+            # ops/sketch.py unsketch, so the big-d gate cannot drift
+            from commefficient_tpu.ops.topk import \
+                selection_may_duplicate
+            unique = not selection_may_duplicate(cfg.grad_size,
+                                                 cfg.approx_topk)
             idx, scaled = res.support
             order = jnp.argsort(idx)
             new_ps = ps_weights.at[idx[order]].add(

@@ -235,27 +235,58 @@ class CountSketch:
         heavier collision tail for zero speedup — warn once."""
         if self.rot_lanes <= 0:
             return
+        import logging
+        log = logging.getLogger(__name__)
+        # construction stays JAX-runtime-free: probing the backend here
+        # would call jax.devices() inside __post_init__, locking in a
+        # backend before a multi-host embedder's
+        # jax.distributed.initialize() / platform override runs. The
+        # resolved-backend warning fires lazily from _resolve_backend
+        # at first use instead; only the explicit backend="xla" case is
+        # knowable (and warned) now.
+        if self.backend == "xla":
+            self._warn_rot_lanes_no_pallas("xla")
+            return
         from commefficient_tpu.ops.sketch_pallas import _pick_lanes
         L = _pick_lanes(self.c)
         if L is not None and self.rot_lanes % L != 0:
-            import logging
-            logging.getLogger(__name__).warning(
+            log.warning(
                 "rot_lanes=%d is not a multiple of the kernel lane "
                 "width %d for c=%d: rotations are quantized (heavier "
                 "collision tail) but the sublane fast path does NOT "
                 "engage — use rot_lanes=%d",
                 self.rot_lanes, L, self.c, L)
 
+    def _warn_rot_lanes_no_pallas(self, resolved: str):
+        """Quantized rotations pay their collision tail only to buy
+        the Pallas sublane roll; any non-pallas lowering (unsupported
+        geometry, non-TPU platform, explicit backend="xla") gains
+        nothing from them — warn once per instance."""
+        if getattr(self, "_rot_lanes_warned", False):
+            return
+        object.__setattr__(self, "_rot_lanes_warned", True)
+        import logging
+        logging.getLogger(__name__).warning(
+            "sketch_rot_lanes=%d with backend %r: the sublane fast "
+            "path only exists in the Pallas TPU kernels — rotations "
+            "are quantized (heavier collision tail) for zero speedup "
+            "here; use rot_lanes=0", self.rot_lanes, resolved)
+
     def _resolve_backend(self) -> str:
-        if self.backend != "auto":
-            return self.backend
-        from commefficient_tpu.ops.sketch_pallas import supported
-        if not supported(self.d, self.c, self.r):
-            return "xla"
-        # allowlist: Mosaic kernels only lower on TPU ("axon" is the
-        # tunneled-TPU platform name under the remote relay)
-        platform = jax.devices()[0].platform
-        return "pallas" if platform in ("tpu", "axon") else "xla"
+        resolved = self.backend
+        if resolved == "auto":
+            from commefficient_tpu.ops.sketch_pallas import supported
+            if not supported(self.d, self.c, self.r):
+                resolved = "xla"
+            else:
+                # allowlist: Mosaic kernels only lower on TPU ("axon"
+                # is the tunneled-TPU platform under the remote relay)
+                platform = jax.devices()[0].platform
+                resolved = ("pallas" if platform in ("tpu", "axon")
+                            else "xla")
+        if resolved != "pallas" and self.rot_lanes > 0:
+            self._warn_rot_lanes_no_pallas(resolved)
+        return resolved
 
     def sketch(self, v: jax.Array) -> jax.Array:
         """Dense (d,) vector -> (r, c) sketch table, scatter-free."""
@@ -412,8 +443,9 @@ class CountSketch:
         # lax.top_k path keeps the slice (d == padded_d there is
         # common, and the sort dominates anyway)
         from commefficient_tpu.ops.topk import (
-            threshold_topk_indices, use_threshold_select)
-        big_d = self.d >= (1 << 20)
+            _THRESHOLD_SELECT_MIN_D, threshold_topk_indices,
+            use_threshold_select)
+        big_d = self.d >= _THRESHOLD_SELECT_MIN_D
         est = self.estimates(table, padded=big_d)
         if self.approx_topk:
             _, idx = jax.lax.approx_max_k(
@@ -451,8 +483,18 @@ class CountSketch:
             # callers on the sparse path never need it
             assert with_support
             return None, idx, vals
-        dense = jnp.zeros(self.d, jnp.float32).at[idx].set(
-            vals, mode="promise_in_bounds")
+        # scatter-ADD, not set: the big-d approx guard above can leave
+        # duplicate (d-1) slots whose vals are forced 0 — under .set a
+        # legitimate (d-1, est[d-1]) pick could lose to a forced-0
+        # duplicate (order-nondeterministic); under .add over a zero
+        # init the zeros are inert and unique-index inputs are
+        # unchanged. selection_may_duplicate (ops/topk.py) is the one
+        # shared predicate for when duplicates are possible.
+        from commefficient_tpu.ops.topk import selection_may_duplicate
+        dense = jnp.zeros(self.d, jnp.float32).at[idx].add(
+            vals, mode="promise_in_bounds",
+            unique_indices=not selection_may_duplicate(
+                self.d, self.approx_topk))
         if with_support:
             return dense, idx, vals
         return dense

@@ -34,6 +34,17 @@ def use_threshold_select(k: int, d: int, approx: bool) -> bool:
     return not approx and k < d and d >= _THRESHOLD_SELECT_MIN_D
 
 
+def selection_may_duplicate(d: int, approx: bool) -> bool:
+    """The ONE predicate for "can a k-selection's index vector carry
+    duplicates": only the big-d approx path (``CountSketch.unsketch``'s
+    degenerate-tie guard clamps approx_max_k's out-of-range zero-tie
+    picks to duplicate (d-1, 0) pairs). Consumers scattering from such
+    a selection must use ADD semantics and must NOT assert
+    unique_indices (core/rounds.py server scatter, unsketch's dense
+    form) — both derive from here so the big-d gate cannot drift."""
+    return approx and d >= _THRESHOLD_SELECT_MIN_D
+
+
 def _blocked_cumsum(x: jax.Array, block: int = 1024) -> jax.Array:
     """Inclusive cumsum along the last axis via intra-block scans plus
     block-offset scans. XLA's flat cumsum over tens of millions of
@@ -153,20 +164,32 @@ def threshold_topk_mask_1d(sq: jax.Array, k: int, *,
         sq.astype(jnp.float32), jnp.uint32)
     t = _nibble_threshold_key(keys, k)
     from commefficient_tpu.ops import topk_pallas
-    # branch chosen from the DEFAULT backend at trace time: this
-    # function assumes it executes there (true for every caller in
-    # this package). An explicit non-default backend (e.g.
-    # jit(..., backend="cpu") on a TPU host) would trace the wrong
-    # branch — pass force_xla/interpret to pick one explicitly.
-    platform = jax.default_backend()
-    use_pallas = (interpret or platform in ("tpu", "axon")) \
-        and topk_pallas.supported(d) and not force_xla
     need = k - jnp.sum((keys > t).astype(jnp.int32))
-    if use_pallas:
+    if force_xla or not topk_pallas.supported(d):
+        return _take_from_threshold_1d(keys, t, need)
+    if interpret:  # test hook: Pallas interpreter on any backend
         return topk_pallas.take_mask_pallas(
             sq.astype(jnp.float32), t.reshape(1), need.reshape(1),
-            interpret=interpret)
-    return _take_from_threshold_1d(keys, t, need)
+            interpret=True)
+
+    # branch selected at LOWERING time per platform (lax.platform_
+    # dependent), not from jax.default_backend() at trace time: a
+    # jit(..., backend="cpu") on a TPU-initialized process — or any
+    # multi-backend embedder — gets the XLA mask, while tpu/axon
+    # lowerings get the fused Pallas take-mask kernel. Both branches
+    # compute the identical exactly-k, lowest-index-tie-break mask
+    # (equivalence-tested).
+    def _pallas(sqf, t, need):
+        return topk_pallas.take_mask_pallas(
+            sqf, t.reshape(1), need.reshape(1))
+
+    def _xla(sqf, t, need):
+        return _take_from_threshold_1d(
+            jax.lax.bitcast_convert_type(sqf, jnp.uint32), t, need)
+
+    return jax.lax.platform_dependent(
+        sq.astype(jnp.float32), t, need,
+        tpu=_pallas, axon=_pallas, default=_xla)
 
 
 def _threshold_topk_idx(sq: jax.Array, k: int) -> jax.Array:

@@ -58,6 +58,30 @@ def test_kernel_zero_threshold_edge():
     np.testing.assert_array_equal(got, want)
 
 
+def test_branch_selected_at_lowering_not_trace():
+    """The Pallas-vs-XLA branch is a lax.platform_dependent, decided
+    per LOWERING platform — not frozen from jax.default_backend() at
+    trace time (round-4 advisor: a jit(..., backend=...) override or
+    multi-backend process must not silently trace the wrong branch).
+    One trace, lowered for cpu and for tpu: the cpu module must hold
+    the XLA mask (no Mosaic custom-call), the tpu module the kernel."""
+    d, k = _CHUNK, 100
+    sq = jnp.square(jnp.asarray(
+        np.random.RandomState(0).randn(d).astype(np.float32)))
+    traced = jax.jit(
+        lambda v: threshold_topk_mask_1d(v, k)).trace(sq)
+    cpu_txt = traced.lower(lowering_platforms=("cpu",)).as_text()
+    tpu_txt = traced.lower(lowering_platforms=("tpu",)).as_text()
+    assert "tpu_custom_call" not in cpu_txt
+    assert "tpu_custom_call" in tpu_txt
+    # and the cpu lowering executes correctly end to end
+    got = np.asarray(jax.jit(
+        lambda v: threshold_topk_mask_1d(v, k), backend="cpu")(sq))
+    want = np.asarray(_threshold_topk_mask(sq, k))
+    assert got.sum() == k
+    np.testing.assert_array_equal(got, want)
+
+
 def test_nibble_search_matches_bit_search():
     from commefficient_tpu.ops.topk import _blocked_cumsum  # noqa: F401
 
