@@ -300,7 +300,14 @@ class FedModel:
                                  jnp.float32(self.fedavg_lr))
         self.client_states = res.client_states
         self.pending_aggregated = res.aggregated
-        self.pending_client_ids = ids
+        # dead slots (dropout / loader padding) must carry the
+        # out-of-range sentinel into the SERVER round too: true_topk's
+        # velocity masking scatters rows back at these ids, and a dead
+        # client's momentum must stay untouched exactly like its
+        # client-side state (core/rounds.py _state_ids; regression
+        # found by tests/test_fuzz_modes.py)
+        from commefficient_tpu.core.rounds import _state_ids
+        self.pending_client_ids = _state_ids(ids, dev_batch)
         self.round_index += 1
         if res.bn_stats is not None:
             # running-stats blend (torch BN momentum 0.1); a fully

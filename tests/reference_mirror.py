@@ -41,6 +41,10 @@ class MirrorFed:
         self.Verr = np.zeros(shape)
         self.vel = np.zeros((num_clients,) + shape)
         self.err = np.zeros((num_clients,) + shape)
+        # --topk_down stale per-client weights (fed_worker.py:234-249)
+        self.client_w = (np.tile(self.w, (num_clients, 1))
+                         if getattr(cfg, "do_topk_down", False)
+                         else None)
         self.sketch = sketch
 
     # client math ---------------------------------------------------------
@@ -50,11 +54,37 @@ class MirrorFed:
         r = X @ w - y
         return (2.0 / len(y)) * (X.T @ r)
 
-    def _client_transmit(self, cid, X, y):
+    def _grad_unit(self, X, y, w, B=None):
+        """Masked-mean gradient with the reference's microbatch quirk:
+        sum over microbatches of the per-microbatch MEAN gradient
+        (fed_worker.py:267-289; core/grad.py). Microbatch boundaries
+        run over the round's PADDED batch size ``B`` — a client with
+        fewer real samples contributes empty tail chunks that the
+        engine skips, exactly as here."""
+        mb = getattr(self.cfg, "microbatch_size", -1)
+        n = len(y)
+        B = n if B is None else B
+        if mb is None or mb <= 0 or mb >= B:
+            return self._grad_mean(X, y, w)
+        g = np.zeros_like(w)
+        for s in range(0, B, mb):
+            e = min(s + mb, n)
+            if e > s:
+                g = g + self._grad_mean(X[s:e], y[s:e], w)
+        return g
+
+    def _client_transmit(self, cid, X, y, B=None):
         cfg = self.cfg
-        g = self._grad_mean(X, y, self.w)
+        w = self.w
+        if self.client_w is not None:
+            # catch up the stale local weights by the top-k of the
+            # difference only, then train (and decay) at those weights
+            w = self.client_w[cid] + np_topk(self.w - self.client_w[cid],
+                                             cfg.k)
+            self.client_w[cid] = w.copy()
+        g = self._grad_unit(X, y, w, B)
         if cfg.weight_decay:
-            g = g + cfg.weight_decay / cfg.num_workers * self.w
+            g = g + cfg.weight_decay / cfg.num_workers * w
         if cfg.do_dp:
             # clip to l2_norm_clip (fed_worker.py:306-307); worker-mode
             # noise is tested separately with noise_multiplier=0
@@ -124,10 +154,12 @@ class MirrorFed:
 
     # round ---------------------------------------------------------------
 
-    def round(self, clients, lr):
-        """clients: list of (client_id, X, y). Returns new weights."""
+    def round(self, clients, lr, B=None):
+        """clients: list of (client_id, X, y). Returns new weights.
+        ``B``: the engine round's padded batch size (microbatch
+        boundaries depend on it; None = no padding)."""
         total = sum(len(y) for _, _, y in clients)
-        transmits = [self._client_transmit(cid, X, y)
+        transmits = [self._client_transmit(cid, X, y, B)
                      for cid, X, y in clients]
         agg = np.sum(transmits, axis=0) / total
         upd = self._server(agg, lr, [cid for cid, _, _ in clients])

@@ -1,0 +1,220 @@
+"""Differential fuzz of the mode-config lattice vs the NumPy mirror.
+
+The hand-picked configs in test_modes.py cover the lattice's named
+corners; this fuzz samples ~50 random VALID configs per run (5 modes x
+error types x momenta x weight decay x microbatch x DP clip x
+topk_down x client chunking x sketch geometry x dead clients x ragged
+batches), executes 3 federated rounds through the JAX engine and
+through tests/reference_mirror.py, and asserts trajectory agreement —
+weights after every round, plus final per-client velocity/error/
+stale-weight state where the mode carries it.
+
+Seeded and deterministic by default (CI-stable); set FUZZ_SEED /
+FUZZ_N env vars to explore new corners. Any discrepancy found should
+be frozen as a named regression test in test_modes.py.
+
+Deliberately out of scope (mirror models none of these):
+- --dropout_prob's RNG-driven drops: the engine decides drops
+  internally, so the mirror can't replay them. Dead clients are
+  fuzzed DETERMINISTICALLY instead (all-padding batches — the same
+  dead-slot path dropout takes, state-untouched semantics asserted).
+- approx_topk outside sketch mode: approx_max_k's selection is
+  implementation-defined, so only sketch mode (where the mirror
+  shares the CountSketch op and therefore the selection) fuzzes it.
+"""
+
+import dataclasses
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.core.rounds import (ClientStates, _state_ids,
+                                           args2sketch,
+                                           build_client_round,
+                                           build_server_round)
+from commefficient_tpu.core.server import ServerState
+
+from reference_mirror import MirrorFed
+from test_modes import linear_loss, make_cfg
+
+FUZZ_N = int(os.environ.get("FUZZ_N", "50"))
+FUZZ_SEED = int(os.environ.get("FUZZ_SEED", "1234"))
+
+
+def sample_config(rng: random.Random):
+    """One random valid point of the mode lattice + its federation
+    geometry. Returns (cfg, geometry dict)."""
+    mode = rng.choice(["uncompressed", "sketch", "true_topk",
+                       "local_topk", "fedavg"])
+    d = rng.choice([5, 16, 33])
+    k = rng.randint(1, min(d, 8))
+    kw = dict(mode=mode, k=k, weight_decay=rng.choice([0.0, 0.01]),
+              virtual_momentum=rng.choice([0.0, 0.9]),
+              local_momentum=0.0, error_type="none",
+              client_chunk=rng.choice([0, 0, 2, 3]),
+              seed=rng.randint(0, 10000))
+    if mode == "uncompressed":
+        kw["local_momentum"] = rng.choice([0.0, 0.9])
+    elif mode == "sketch":
+        kw["error_type"] = "virtual"
+        kw["num_rows"] = rng.choice([1, 3, 5])
+        kw["num_cols"] = rng.choice([16, 32, 64])
+        kw["num_blocks"] = rng.choice([1, 2, 20])
+        kw["approx_topk"] = rng.random() < 0.3
+    elif mode == "true_topk":
+        kw["error_type"] = "virtual"
+        kw["local_momentum"] = rng.choice([0.0, 0.9])
+    elif mode == "local_topk":
+        kw["error_type"] = rng.choice(["local", "none"])
+        kw["local_momentum"] = rng.choice([0.0, 0.9])
+    else:  # fedavg
+        kw["fedavg_batch_size"] = rng.choice([-1, 2])
+        kw["num_fedavg_epochs"] = rng.choice([1, 2])
+        kw["fedavg_lr_decay"] = rng.choice([1.0, 0.9])
+        kw["local_batch_size"] = -1
+    if mode != "fedavg":
+        kw["microbatch_size"] = rng.choice([-1, 1, 2, 3])
+        if rng.random() < 0.3:
+            kw["do_dp"] = True
+            kw["l2_norm_clip"] = 0.5
+            kw["noise_multiplier"] = 0.0
+        # stale top-k weight downloads (needs exact selection: the
+        # stale-diff top-k has no shared-op mirror under approx)
+        if rng.random() < 0.3 and not kw.get("approx_topk"):
+            kw["do_topk_down"] = True
+
+    W = rng.choice([2, 3])
+    kw["num_workers"] = W
+    num_clients = rng.choice([4, 6])
+    B = 4
+    geom = {"d": d, "W": W, "num_clients": num_clients, "B": B,
+            "rounds": 3, "lr": 0.05}
+    return make_cfg(**kw), geom
+
+
+def sample_rounds(rng: random.Random, geom):
+    """Random federation: per round, W distinct clients with ragged
+    batch sizes; occasionally one is DEAD (n=0, all-padding slot —
+    the dropout/loader-padding path; the engine must leave its state
+    untouched and the mirror simply never sees it)."""
+    rs = np.random.RandomState(rng.randint(0, 2 ** 31 - 1))
+    rounds = []
+    for _ in range(geom["rounds"]):
+        ids = rs.choice(geom["num_clients"], geom["W"], replace=False)
+        dead = (rs.randint(geom["W"])
+                if geom["W"] > 1 and rs.rand() < 0.3 else -1)
+        clients = []
+        for slot, cid in enumerate(ids):
+            n = 0 if slot == dead else rs.randint(1, geom["B"] + 1)
+            X = rs.randn(n, geom["d"]).astype(np.float32)
+            y = rs.randn(n).astype(np.float32)
+            clients.append((int(cid), X, y))
+        rounds.append(clients)
+    return rounds
+
+
+def run_engine(cfg, w0, rounds, lr, num_clients, B):
+    """test_modes.run_engine + (a) static padded batch B shared by all
+    rounds (microbatch boundaries depend on it) and (b) final client
+    states returned for the state-agreement asserts."""
+    d = len(w0)
+    cfg = dataclasses.replace(cfg, grad_size=d)
+    client_round = jax.jit(build_client_round(cfg, linear_loss, B))
+    server_round = jax.jit(build_server_round(cfg))
+
+    ps = jnp.asarray(w0, jnp.float32)
+    cs = ClientStates.init(cfg, num_clients, ps)
+    ss = ServerState.init(cfg)
+    rng = jax.random.PRNGKey(cfg.seed)
+    traj = []
+    for rnd_i, clients in enumerate(rounds):
+        W = len(clients)
+        x = np.zeros((W, B, d), np.float32)
+        y = np.zeros((W, B), np.float32)
+        mask = np.zeros((W, B), np.float32)
+        ids = np.zeros((W,), np.int32)
+        for i, (cid, X, Y) in enumerate(clients):
+            n = len(Y)
+            ids[i] = cid
+            if n:
+                x[i, :n], y[i, :n], mask[i, :n] = X, Y, 1.0
+        batch = {"x": jnp.asarray(x), "y": jnp.asarray(y),
+                 "mask": jnp.asarray(mask)}
+        res = client_round(ps, cs, batch, jnp.asarray(ids),
+                           jax.random.fold_in(rng, rnd_i),
+                           jnp.float32(lr))
+        cs = res.client_states
+        # the runtime sentinels dead slots' ids for the server round
+        # too (fed_model._call_train): a dead client's velocity must
+        # not be masked by true_topk's server-side scatter
+        srv_ids = _state_ids(jnp.asarray(ids), batch)
+        ps, ss, new_vel, _, _ = server_round(
+            ps, ss, res.aggregated, jnp.float32(lr),
+            cs.velocities, srv_ids)
+        if new_vel is not None:
+            cs = cs._replace(velocities=new_vel)
+        traj.append(np.asarray(ps, np.float64))
+    return traj, cs
+
+
+def run_mirror(cfg, w0, rounds, lr, num_clients, B):
+    d = len(w0)
+    cfg = dataclasses.replace(cfg, grad_size=d)
+    m = MirrorFed(cfg, w0, num_clients, sketch=args2sketch(cfg))
+    traj = []
+    for clients in rounds:
+        alive = [c for c in clients if len(c[2]) > 0]
+        if cfg.mode == "fedavg":
+            traj.append(m.round_fedavg(alive, lr))
+        else:
+            traj.append(m.round(alive, lr, B=B))
+    return traj, m
+
+
+def describe(cfg, geom):
+    keys = ["mode", "error_type", "local_momentum", "virtual_momentum",
+            "weight_decay", "microbatch_size", "do_dp", "do_topk_down",
+            "client_chunk", "k", "approx_topk", "num_rows", "num_cols",
+            "num_blocks", "fedavg_batch_size", "num_fedavg_epochs",
+            "fedavg_lr_decay", "seed"]
+    parts = [f"{k}={getattr(cfg, k, None)}" for k in keys]
+    return " ".join(parts) + f" geom={geom}"
+
+
+@pytest.mark.parametrize("case", range(FUZZ_N))
+def test_fuzzed_config_matches_mirror(case):
+    rng = random.Random(FUZZ_SEED * 1000003 + case)
+    cfg, geom = sample_config(rng)
+    rounds = sample_rounds(rng, geom)
+    w0 = np.random.RandomState(case).randn(geom["d"]) * 0.1
+    label = describe(cfg, geom)
+
+    got, cs = run_engine(cfg, w0, rounds, geom["lr"],
+                         geom["num_clients"], geom["B"])
+    want, m = run_mirror(cfg, w0, rounds, geom["lr"],
+                         geom["num_clients"], geom["B"])
+    for r, (g, w) in enumerate(zip(got, want)):
+        np.testing.assert_allclose(
+            g, w, rtol=1e-3, atol=1e-5,
+            err_msg=f"weights diverged at round {r}: {label}")
+
+    # final per-client state agreement where the mode carries it
+    if cs.velocities is not None:
+        np.testing.assert_allclose(
+            np.asarray(cs.velocities, np.float64), m.vel,
+            rtol=1e-3, atol=1e-5,
+            err_msg=f"client velocities diverged: {label}")
+    if cs.errors is not None:
+        np.testing.assert_allclose(
+            np.asarray(cs.errors, np.float64), m.err,
+            rtol=1e-3, atol=1e-5,
+            err_msg=f"client errors diverged: {label}")
+    if cs.weights is not None and m.client_w is not None:
+        np.testing.assert_allclose(
+            np.asarray(cs.weights, np.float64), m.client_w,
+            rtol=1e-3, atol=1e-5,
+            err_msg=f"stale topk_down weights diverged: {label}")

@@ -608,3 +608,63 @@ class TestFedavgInitialLr:
         model(batch)
         np.testing.assert_array_equal(
             np.asarray(model.pending_aggregated), 0.0)
+
+
+class TestDeadSlotServerMasking:
+    def test_true_topk_dead_client_velocity_untouched(self):
+        """Regression (found by tests/test_fuzz_modes.py): true_topk's
+        SERVER-side velocity masking scatters rows back at the round's
+        client ids — a dead slot (dropout / loader padding, all-zero
+        mask) must carry the out-of-range sentinel through
+        ``FedModel.pending_client_ids`` so the dead client's momentum
+        stays untouched, same state-untouched contract as the
+        client-side states (core/rounds.py _state_ids)."""
+        import flax.linen as nn
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.runtime import FedModel, FedOptimizer
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(4, use_bias=False)(x)
+
+        module = Lin()
+        params = module.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 3)))["params"]
+        args = Config(mode="true_topk", error_type="virtual",
+                      local_momentum=0.9, virtual_momentum=0.9,
+                      k=2, num_workers=2, local_batch_size=4,
+                      num_clients=4, dataset_name="CIFAR10", seed=0)
+
+        def loss(p, batch, cfg):
+            pred = module.apply({"params": p}, batch["x"])
+            per = jnp.sum((pred - batch["y"][..., None]) ** 2, -1)
+            n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+            return jnp.sum(per * batch["mask"]) / n, ()
+
+        model = FedModel(module, params, loss, args,
+                         padded_batch_size=4)
+        opt = FedOptimizer([{"lr": 0.1}], args)
+        rng = np.random.RandomState(0)
+
+        def round_with_mask(mask):
+            batch = {"x": rng.randn(2, 4, 3).astype(np.float32),
+                     "y": rng.randn(2, 4).astype(np.float32),
+                     "mask": mask,
+                     "client_ids": np.array([0, 1], np.int32)}
+            model(batch)
+            opt.step()
+
+        # round 1: both alive — client 1 accumulates momentum
+        round_with_mask(np.ones((2, 4), np.float32))
+        vel_before = np.asarray(model.client_states.velocities[1])
+        assert np.abs(vel_before).sum() > 0
+        # round 2: client 1 is DEAD (all padding). Its velocity must
+        # be bit-identical afterwards — in particular NOT masked at
+        # the round's global top-k coordinates by the server scatter.
+        dead = np.ones((2, 4), np.float32)
+        dead[1] = 0.0
+        round_with_mask(dead)
+        np.testing.assert_array_equal(
+            np.asarray(model.client_states.velocities[1]), vel_before)
