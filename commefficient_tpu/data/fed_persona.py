@@ -361,7 +361,8 @@ def generate_learnable_personachat(path, word_list,
                                    signature_size=24,
                                    num_val_dialogs=100,
                                    seed=0,
-                                   val_from_train_sigs=False):
+                                   val_from_train_sigs=False,
+                                   distractor_disjoint=False):
     """Write a personachat-format archive with *learnable* structure,
     for convergence evidence where the real archive is unavailable
     (zero egress; reference fed_persona.py:23 downloads it from S3).
@@ -385,6 +386,15 @@ def generate_learnable_personachat(path, word_list,
     second evaluation split for a model trained on the default corpus
     (same word list + seed ⇒ identical train signatures).
 
+    ``distractor_disjoint=True`` rejection-samples each distractor's
+    source personality so its signature shares NO words with the gold
+    signature (falls back to the least-overlapping candidate after 64
+    tries). Without it, random signature collisions put gold-vocabulary
+    words inside distractors, diluting the lexical-overlap signal the
+    MC head must learn; with it the task's Bayes accuracy is 1.0 by a
+    pure "candidate vocabulary ⊆ prefix vocabulary" rule. Off by
+    default so pre-existing seeds regenerate byte-identically.
+
     Gold candidate is last (reference convention, fed_persona.py:305).
     """
     rng = random.Random(seed)
@@ -396,11 +406,25 @@ def generate_learnable_personachat(path, word_list,
         return " ".join(rng.choice(sig)
                         for _ in range(rng.randint(4, 8)))
 
+    def pick_distractor_sig(gold_set, all_sigs):
+        if not distractor_disjoint:
+            return rng.choice(all_sigs)
+        best, best_overlap = None, None
+        for _ in range(64):
+            cand = rng.choice(all_sigs)
+            overlap = len(gold_set.intersection(cand))
+            if overlap == 0:
+                return cand
+            if best_overlap is None or overlap < best_overlap:
+                best, best_overlap = cand, overlap
+        return best
+
     def dialog(sig, all_sigs):
+        gold_set = set(sig)
         utterances = []
         history = [sentence(sig)]
         for _ in range(utterances_per_dialog):
-            cands = [sentence(rng.choice(all_sigs))
+            cands = [sentence(pick_distractor_sig(gold_set, all_sigs))
                      for _ in range(num_candidates - 1)]
             cands.append(sentence(sig))  # gold last
             utterances.append({"history": list(history),

@@ -45,7 +45,8 @@ def build(args):
                  approx_topk=not args.exact,
                  approx_recall=0.95, num_candidates=args.candidates,
                  lm_coef=1.0, mc_coef=1.0,
-                 sketch_rot_lanes=args.rot_lanes)
+                 sketch_rot_lanes=args.rot_lanes,
+                 tokens_per_chunk=args.tokens_per_chunk)
 
     gcfg = GPT2Config(vocab_size=50262, n_positions=1024,
                       dtype=jnp.bfloat16, remat=args.remat,
@@ -120,7 +121,8 @@ def build_bare(args):
                  weight_decay=0.0, num_workers=args.clients,
                  local_batch_size=args.examples,
                  dataset_name="PERSONA", seed=21,
-                 num_candidates=args.candidates)
+                 num_candidates=args.candidates,
+                 tokens_per_chunk=args.tokens_per_chunk)
     gcfg = GPT2Config(vocab_size=50262, n_positions=1024,
                       dtype=jnp.bfloat16, remat=args.remat,
                       attn_impl=args.attn_impl)
@@ -217,6 +219,10 @@ def main():
     ap.add_argument("--attn_impl", default="xla",
                     choices=["xla", "flash"])
     ap.add_argument("--rot_lanes", type=int, default=0)
+    ap.add_argument("--tokens_per_chunk", type=int, default=0,
+                    help="vocab-CE chunk budget (0 = auto 1024); the "
+                    "task-5 sweep knob — larger chunks trade logits "
+                    "VMEM/HBM for fewer dWte carry accumulations")
     ap.add_argument("--profile", type=str, default=None)
     args = ap.parse_args()
 

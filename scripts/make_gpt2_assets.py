@@ -44,6 +44,10 @@ def main():
                    "(fresh sentences) — the easier seen-persona "
                    "evaluation tier; train split stays byte-identical "
                    "for a given seed/word budget")
+    p.add_argument("--distractor_disjoint", action="store_true",
+                   help="rejection-sample distractor personas so their "
+                   "signatures share no words with the gold persona "
+                   "(Bayes-1.0 lexical-overlap MC task)")
     args = p.parse_args()
 
     ckpt_dir = os.path.join(args.out, "ckpt")
@@ -64,7 +68,8 @@ def main():
         utterances_per_dialog=args.utterances,
         num_candidates=args.candidates, signature_size=args.signature,
         num_val_dialogs=args.val_dialogs, seed=args.seed,
-        val_from_train_sigs=args.val_from_train_sigs)
+        val_from_train_sigs=args.val_from_train_sigs,
+        distractor_disjoint=args.distractor_disjoint)
     n_train = args.personalities * args.dialogs * args.utterances
     print(f"corpus: {n_train} train utterances, "
           f"{args.val_dialogs * args.utterances} val -> {data_dir}")
