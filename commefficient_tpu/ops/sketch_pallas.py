@@ -12,8 +12,9 @@ and the accumulate/median into single passes:
   instead of r·|v|.
 - ``estimates_pallas``: table stays VMEM-resident across the chunk grid;
   the (r, padded_d) estimate tensor is never materialised — each chunk's
-  r rolled/sign-corrected rows are medianed in-register (odd-even
-  transposition network) and written once.
+  r rolled/sign-corrected rows are medianed in-register (min/max
+  selection network for the flagship r=5 and r=3; odd-even
+  transposition sort for other r) and written once.
 
 Hash-identity contract: identical rotation/sign streams to the XLA
 path, so Pallas and XLA replicas can mix freely under ``psum``. Tables
@@ -100,46 +101,78 @@ def _sign_hash_chunk(t, sign_seed: np.uint32, c: int, S: int, L: int,
     return _mix_u32(g ^ sign_seed)
 
 
-def _sign_from_hash(h, row: int):
-    # Mosaic has no uint32->f32 cast; the bit is 0/1 so int32 is safe
-    bit = ((h >> (16 + row)) & 1).astype(jnp.int32)
-    return 1.0 - 2.0 * bit.astype(jnp.float32)
+def _flip_from_hash(h, row: int):
+    """Sign-bit flip mask for row ``row`` from the one-mix hash: bit
+    16+row of ``h`` moved to bit 31. XORing a float32 with this mask
+    IS multiplication by the row's ±1 sign (IEEE sign-bit flip is
+    exact, bit-identical to ``x * (1 - 2*bit)`` incl. ±0), at 2 VPU
+    ops instead of the extract/convert/multiply chain (~7)."""
+    assert 0 <= row <= 15
+    return (h << (15 - row)) & jnp.uint32(0x80000000)
 
 
-def _signs_chunk(t, row: int, sign_seed: np.uint32, c: int, S: int, L: int):
+def _flip_chunk(t, row: int, sign_seed: np.uint32, c: int, S: int, L: int):
     """Per-(row, coord) mix fallback for r > 16 — replicates
     ops.sketch.CountSketch._signs_row on global indices
-    ``t*c + s*L + l``. ``row`` is a Python int; ``t`` is traced."""
+    ``t*c + s*L + l``, returned as a sign-bit flip mask (bit 16 of the
+    row-salted mix moved to bit 31). ``row`` is a Python int; ``t`` is
+    traced."""
     s_idx = jax.lax.broadcasted_iota(jnp.uint32, (S, L), 0)
     l_idx = jax.lax.broadcasted_iota(jnp.uint32, (S, L), 1)
     g = t.astype(jnp.uint32) * jnp.uint32(c) + s_idx * jnp.uint32(L) + l_idx
     row_const = (np.uint32((row * 0x9E3779B9) & 0xFFFFFFFF) ^ sign_seed)
     h = _mix_u32(g ^ jnp.uint32(row_const))
-    bit = ((h >> 16) & 1).astype(jnp.int32)
-    return 1.0 - 2.0 * bit.astype(jnp.float32)
+    return (h << 15) & jnp.uint32(0x80000000)
 
 
-def _roll1d(x, o, S: int, L: int):
+def _apply_flip(x, flip):
+    """x * sign, as a sign-bit XOR (see _flip_from_hash)."""
+    xb = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return jax.lax.bitcast_convert_type(xb ^ flip, jnp.float32)
+
+
+def _roll1d(x, o, S: int, L: int, lane=None):
     """Circular shift of the flattened (S, L) tile by traced ``o``
-    (0 <= o < S*L): sublane rolls a / a+1, lane roll b, lane select."""
+    (0 <= o < S*L). The lane roll (the expensive cross-lane permute)
+    is computed ONCE and the two candidate sublane rolls (a, a+1)
+    applied after — legal because rolls on distinct axes commute:
+    ``lane_roll(sub_roll(x, a), b) == sub_roll(lane_roll(x, b), a)``.
+    ``lane`` is the (S, L) lane iota, hoistable by the caller."""
     a = o // L
     b = o % L
-    P = pltpu.roll(x, shift=a, axis=0)
-    Q = pltpu.roll(x, shift=a + 1, axis=0)
-    R1 = pltpu.roll(P, shift=b, axis=1)
-    R2 = pltpu.roll(Q, shift=b, axis=1)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
+    y = pltpu.roll(x, shift=b, axis=1)
+    R1 = pltpu.roll(y, shift=a, axis=0)
+    R2 = pltpu.roll(y, shift=a + 1, axis=0)
+    if lane is None:
+        lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
     return jnp.where(lane < b, R2, R1)
 
 
+def _median3(x, y, z):
+    """max(min(x,y), min(max(x,y), z)) — 4 ops vs 6 for the sort."""
+    lo = jnp.minimum(x, y)
+    hi = jnp.maximum(x, y)
+    return jnp.maximum(lo, jnp.minimum(hi, z))
+
+
 def _median_network(vals):
-    """Elementwise median of a list of same-shape arrays via odd-even
-    transposition (r is small: <= ~8). Matches jnp.median: middle
-    element for odd r, mean of the two middles for even r."""
+    """Elementwise median of a list of same-shape arrays. Matches
+    jnp.median: middle element for odd r, mean of the two middles for
+    even r. min/max compositions are order-exact, so any correct
+    network returns the identical value — the flagship r=5 uses the
+    classic selection network (10 ops: median3 of the max-of-mins,
+    min-of-maxes, and the odd element) instead of a full odd-even
+    transposition sort (20 ops); other r fall back to the sort."""
     v = list(vals)
     n = len(v)
     if n == 1:
         return v[0]
+    if n == 3:
+        return _median3(v[0], v[1], v[2])
+    if n == 5:
+        f = jnp.maximum(jnp.minimum(v[0], v[1]), jnp.minimum(v[2], v[3]))
+        g = jnp.minimum(jnp.maximum(v[0], v[1]), jnp.maximum(v[2], v[3]))
+        return _median3(v[4], f, g)
     for rnd in range(n):
         start = rnd % 2
         for i in range(start, n - 1, 2):
@@ -185,17 +218,18 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
         chunk = v_ref[:]  # (S, L) chunk t, streamed
         if one_mix:
             h = _sign_hash_chunk(t, seed, c, S, L, r)
-            signs = [_sign_from_hash(h, row) for row in range(r)]
+            flips = [_flip_from_hash(h, row) for row in range(r)]
         else:
-            signs = [_signs_chunk(t, row, seed, c, S, L)
+            flips = [_flip_chunk(t, row, seed, c, S, L)
                      for row in range(r)]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
         for row in range(r):
-            signed = chunk * signs[row]
+            signed = _apply_flip(chunk, flips[row])
             if sublane:
                 rolled = pltpu.roll(signed, rot_ref[row, t] // L,
                                     axis=0)
             else:
-                rolled = _roll1d(signed, rot_ref[row, t], S, L)
+                rolled = _roll1d(signed, rot_ref[row, t], S, L, lane)
             sl = slice(row * S, (row + 1) * S)
             out_ref[sl, :] = out_ref[sl, :] + rolled
 
@@ -238,10 +272,11 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
         t = pl.program_id(0)
         if one_mix:
             h = _sign_hash_chunk(t, seed, c, S, L, r)
-            signs = [_sign_from_hash(h, row) for row in range(r)]
+            flips = [_flip_from_hash(h, row) for row in range(r)]
         else:
-            signs = [_signs_chunk(t, row, seed, c, S, L)
+            flips = [_flip_chunk(t, row, seed, c, S, L)
                      for row in range(r)]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
         vals = []
         for row in range(r):
             trow = tab_ref[row * S:(row + 1) * S, :]
@@ -250,8 +285,8 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
             if sublane:
                 unrolled = pltpu.roll(trow, back // L, axis=0)
             else:
-                unrolled = _roll1d(trow, back, S, L)
-            vals.append(unrolled * signs[row])
+                unrolled = _roll1d(trow, back, S, L, lane)
+            vals.append(_apply_flip(unrolled, flips[row]))
         med = _median_network(vals)
         if valid is not None and valid < m * c:
             s_idx = jax.lax.broadcasted_iota(jnp.int32, (S, L), 0)

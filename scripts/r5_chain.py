@@ -1,0 +1,102 @@
+"""Round-5 anchor chip queue (runs the 24-epoch anchor variants the
+round-4 review asked for, sequentially on the one chip):
+
+1. Dense-baseline LR sweep (VERDICT weak #2): uncompressed + fedavg at
+   --lr_scale 0.1 / 0.2, seed 21 (the 0.4 point is the existing
+   anchor24_{mode}_s21.log). The review's hypothesis: the shared 0.4
+   peak is over-hot for the DENSE update (uncompressed test loss rose
+   2.71 -> 3.75 over epochs 22-24), so "sketch >> uncompressed" may be
+   an LR artifact, not a compression-quality fact.
+2. rot_lanes quality runs (VERDICT task 4): sketch mode at
+   --sketch_rot_lanes 1024, seeds 21 + 22, vs the existing rot_lanes=0
+   logs — 24-epoch tail_acc parity decides the large-d default.
+3. local_topk at a regime where it learns (VERDICT weak #3): 100
+   clients x classes_per_client 3 (the proven round-3 dial), full
+   participation, seeds 21 + 22.
+4. Seed-22 confirmation of each dense mode's best LR (auto-picked by
+   tail_acc over the {0.1, 0.2, 0.4} sweep; 0.4 already has s22 logs).
+
+Each anchor24.py invocation is a subprocess (fresh JAX). Logs land in
+runs/ with the suffix scheme anchor24_<mode>_lr01_s21.log etc.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+ANCHOR = os.path.join(REPO, "scripts", "anchor24.py")
+
+
+def run(args):
+    cmd = [PY, ANCHOR] + args
+    print("==>", " ".join(cmd), flush=True)
+    subprocess.run(cmd, cwd=REPO, check=False)
+
+
+def tail_acc(log):
+    """Mean test_acc of the last 5 epoch rows of an anchor log."""
+    accs = []
+    try:
+        with open(log) as f:
+            for line in f:
+                parts = line.split()
+                # epoch rows: 11 numeric columns, col 7 = test_acc
+                if len(parts) == 11 and re.match(r"^\d+$", parts[0]):
+                    accs.append(float(parts[7]))
+    except OSError:
+        return float("nan")
+    if not accs:
+        return float("nan")
+    t = accs[-5:]
+    return sum(t) / len(t)
+
+
+def main():
+    # 1. dense LR sweep, seed 21
+    for lr, sfx in ((0.1, "_lr01"), (0.2, "_lr02")):
+        run(["--modes", "uncompressed,fedavg", "--lr_scale", str(lr),
+             "--suffix", sfx])
+
+    # 2. rot_lanes quality, seeds 21 + 22
+    for seed in (21, 22):
+        run(["--modes", "sketch", "--seed", str(seed),
+             "--suffix", "_rl1024",
+             "--extra", "--sketch_rot_lanes 1024"])
+
+    # 3. local_topk at the learnable cpc3 regime, seeds 21 + 22
+    for seed in (21, 22):
+        run(["--modes", "local_topk", "--seed", str(seed),
+             "--num_clients", "100", "--suffix", "_c100cpc3",
+             "--extra", "--client_chunk 10 --classes_per_client 3"])
+
+    # 4. seed-22 confirmation at each dense mode's best LR
+    picks = {}
+    for mode in ("uncompressed", "fedavg"):
+        cand = {
+            0.1: tail_acc(f"{REPO}/runs/anchor24_{mode}_lr01_s21.log"),
+            0.2: tail_acc(f"{REPO}/runs/anchor24_{mode}_lr02_s21.log"),
+            0.4: tail_acc(f"{REPO}/runs/anchor24_{mode}_s21.log"),
+        }
+        finite = [(a, lr) for lr, a in cand.items() if a == a]
+        if not finite:  # all sweep logs missing/aborted: skip pick
+            print(f"no usable sweep logs for {mode}; skipping "
+                  f"confirmation run", flush=True)
+            picks[mode] = {"sweep": cand, "best_lr": None}
+            continue
+        best = max(finite)[1]
+        picks[mode] = {"sweep": cand, "best_lr": best}
+        print(f"best lr for {mode}: {best} (sweep: {cand})", flush=True)
+        if best != 0.4:  # 0.4 already has seed-22 logs
+            sfx = "_lr01" if best == 0.1 else "_lr02"
+            run(["--modes", mode, "--seed", "22",
+                 "--lr_scale", str(best), "--suffix", sfx])
+
+    print("R5_CHAIN_DONE " + json.dumps(picks), flush=True)
+
+
+if __name__ == "__main__":
+    main()
