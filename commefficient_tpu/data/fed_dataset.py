@@ -69,8 +69,19 @@ class FedDataset:
                     f"non-iid needs num_clients >= "
                     f"{len(self.images_per_client)} natural partitions "
                     f"(got {self._num_clients}); pass --iid to re-split")
-            new_ipc = []
             n_natural = len(self.images_per_client)
+            if self._num_clients % n_natural:
+                # the even split below would yield
+                # n_natural * (num_clients // n_natural) clients and
+                # the sampler would crash on the length mismatch —
+                # fail with the actual constraint instead
+                raise ValueError(
+                    f"non-iid re-split divides clients evenly over "
+                    f"the {n_natural} natural partitions: "
+                    f"--num_clients must be a multiple of {n_natural} "
+                    f"(got {self._num_clients}); pass --iid for an "
+                    f"arbitrary client count")
+            new_ipc = []
             for num_images in self.images_per_client:
                 n_per_class = self._num_clients // n_natural
                 extra = num_images % n_per_class

@@ -131,6 +131,20 @@ class CountSketch:
     # and BENCHMARKS.md before any default changes. 0 = off (full-
     # granularity rotations, the reference-quality default).
     rot_lanes: int = 0
+    # stream precomputed packed sign bits ((padded_d,) uint8, bit row
+    # = hash bit 16+row) into the Pallas kernels instead of hashing
+    # in-kernel. The murmur mix is two u32 multiplies per element —
+    # emulated multi-op on the VPU and the largest r-independent ALU
+    # block in both kernels; the table costs ~1 byte/element of HBM
+    # traffic (~0.15 ms at d=124M vs ~2-3 ms of hashing per kernel
+    # call) and is computed ON-DEVICE inside the round program (a
+    # closed-over 125 MB host constant measured 11.7 s lowering +
+    # 27.6 s compile + a 250 MB HLO — never do that), where XLA CSE
+    # shares one materialisation across the clients vmap and the
+    # sketch/estimates pair. Eligible when one-mix signs apply and
+    # r <= 8 (u8 holds 8 row bits); ineligible geometries hash
+    # in-kernel as before. Sign VALUES are identical either way.
+    packed_signs: bool = True
 
     def __post_init__(self):
         assert self.d > 0 and self.c > 0 and self.r > 0
@@ -204,6 +218,24 @@ class CountSketch:
                      ^ sign_seed)
             bit = (h >> 16) & 1
         return 1.0 - 2.0 * bit.astype(jnp.float32)
+
+    @property
+    def _packed_sign_kernels(self) -> bool:
+        """Whether the Pallas kernels stream precomputed sign bits
+        (see the ``packed_signs`` field comment)."""
+        return self.packed_signs and self._one_mix_signs and self.r <= 8
+
+    def _packed_signs_traced(self) -> jax.Array:
+        """(padded_d,) uint8 packed sign bits — bit ``row`` is the
+        one-mix hash bit 16+row, i.e. exactly the bit
+        ``_signs_row(row)`` reads. Built from jnp ops INSIDE the
+        caller's trace (never a host-side constant; see the field
+        comment for why), so XLA CSEs the subgraph wherever it
+        appears more than once in a program."""
+        idx = jnp.arange(self._padded_d, dtype=jnp.uint32)
+        h = self._sign_hash(idx)
+        mask = jnp.uint32((1 << self.r) - 1)
+        return ((h >> 16) & mask).astype(jnp.uint8)
 
     def hashes(self, idx: jax.Array):
         """(buckets, signs) for int32 coordinate indices: buckets
@@ -323,11 +355,13 @@ class CountSketch:
         if backend in ("pallas", "pallas_interpret"):
             from commefficient_tpu.ops.sketch_pallas import sketch_pallas
             _, sign_seed = self._seeds()
+            sgn = (self._packed_signs_traced()
+                   if self._packed_sign_kernels else None)
             return sketch_pallas(vp, jnp.asarray(self._rotations()),
                                  c, self.r, int(sign_seed),
                                  backend == "pallas_interpret",
                                  one_mix=self._one_mix_signs,
-                                 rot_step=self.rot_lanes)
+                                 rot_step=self.rot_lanes, sgn=sgn)
         rot = self._rotations()  # host constants -> static rolls
 
         if m <= _UNROLL_LIMIT:
@@ -384,12 +418,14 @@ class CountSketch:
         if backend in ("pallas", "pallas_interpret"):
             from commefficient_tpu.ops.sketch_pallas import estimates_pallas
             _, sign_seed = self._seeds()
+            sgn = (self._packed_signs_traced()
+                   if self._packed_sign_kernels else None)
             est = estimates_pallas(table, jnp.asarray(self._rotations()),
                                    c, self.r, int(sign_seed),
                                    backend == "pallas_interpret",
                                    one_mix=self._one_mix_signs,
                                    valid=self.d if padded else None,
-                                   rot_step=self.rot_lanes)
+                                   rot_step=self.rot_lanes, sgn=sgn)
             return est if padded else est[: self.d]
         rot = self._rotations()
 

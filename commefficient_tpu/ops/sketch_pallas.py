@@ -3,9 +3,10 @@
 The XLA formulation in :mod:`commefficient_tpu.ops.sketch` materialises
 an ``(r, padded_d)`` intermediate for recovery (~140 MB at the flagship
 ResNet9 geometry) and re-reads the signed vector once per row when
-sketching. These kernels fuse sign generation (murmur mix of the
-coordinate index, computed in-register), the per-(row, chunk) rotation,
-and the accumulate/median into single passes:
+sketching. These kernels fuse sign application (streamed packed sign
+bits by default, in-register murmur mix of the coordinate index for
+r > 8), the per-(row, chunk) rotation, and the accumulate/median into
+single passes:
 
 - ``sketch_pallas``: one streamed read of the (padded) vector, table
   accumulated in VMEM across the chunk grid — HBM traffic ~= |v| + |table|
@@ -184,10 +185,25 @@ def _median_network(vals):
     return 0.5 * (v[n // 2 - 1] + v[n // 2])
 
 
+def _flips_for_chunk(t, sgn_block, one_mix: bool, seed, c, S, L, r):
+    """Per-row sign-bit flip masks for chunk ``t``, cheapest source
+    first: a streamed packed-sign block (bit ``row`` of a u8 per
+    element — 2 shift/and ops per row, no hashing), else the in-kernel
+    one-mix hash (r <= 16), else one mix per (row, coord)."""
+    if sgn_block is not None:
+        b32 = sgn_block.astype(jnp.uint32)
+        return [(b32 << (31 - row)) & jnp.uint32(0x80000000)
+                for row in range(r)]
+    if one_mix:
+        h = _sign_hash_chunk(t, seed, c, S, L, r)
+        return [_flip_from_hash(h, row) for row in range(r)]
+    return [_flip_chunk(t, row, seed, c, S, L) for row in range(r)]
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
 def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
                   interpret: bool = False, lanes: int | None = None,
-                  one_mix: bool = False, rot_step: int = 0):
+                  one_mix: bool = False, rot_step: int = 0, sgn=None):
     """(padded_d,) signed-rotate-accumulate -> (r, c) table.
 
     ``vp`` is the zero-padded flat vector (padded_d = m*c); ``rot`` is
@@ -195,15 +211,22 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
     passed as an array so the kernel is geometry-cached). ``rot_step``
     > 0 promises every rotation is a multiple of it; when that step is
     lane-aligned the 5-op arbitrary-shift roll collapses to a single
-    sublane roll (CountSketch.rot_lanes)."""
+    sublane roll (CountSketch.rot_lanes). ``sgn`` (optional,
+    (padded_d,) uint8): packed sign bits (bit row = hash bit 16+row,
+    CountSketch._packed_signs_traced) streamed alongside the vector —
+    removes the murmur mix (two emulated u32 multiplies per element,
+    the largest r-independent ALU block) from the kernel for ~1 extra
+    byte/element of HBM traffic."""
     L = lanes or _pick_lanes(c)
     assert L is not None and c % L == 0
     S = c // L
     m = vp.size // c
     seed = np.uint32(sign_seed)
     sublane = rot_step > 0 and rot_step % L == 0
+    packed = sgn is not None
 
-    def kernel(rot_ref, v_ref, out_ref):
+    def kernel(rot_ref, v_ref, *refs):
+        (sgn_ref, out_ref) = refs if packed else (None, refs[0])
         t = pl.program_id(0)
 
         @pl.when(t == 0)
@@ -216,12 +239,9 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
         # compute, while the XLA-side 2-D relayout copy costs ~1.5 ms
         # once and overlaps. Keep the 2-D operand.
         chunk = v_ref[:]  # (S, L) chunk t, streamed
-        if one_mix:
-            h = _sign_hash_chunk(t, seed, c, S, L, r)
-            flips = [_flip_from_hash(h, row) for row in range(r)]
-        else:
-            flips = [_flip_chunk(t, row, seed, c, S, L)
-                     for row in range(r)]
+        flips = _flips_for_chunk(
+            t, sgn_ref[:] if packed else None,
+            one_mix, seed, c, S, L, r)
         lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
         for row in range(r):
             signed = _apply_flip(chunk, flips[row])
@@ -233,20 +253,27 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
             sl = slice(row * S, (row + 1) * S)
             out_ref[sl, :] = out_ref[sl, :] + rolled
 
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((S, L), lambda t: (t, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [rot.astype(jnp.int32),
+                vp.astype(jnp.float32).reshape(m * S, L)]
+    if packed:
+        in_specs.append(pl.BlockSpec((S, L), lambda t: (t, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(sgn.reshape(m * S, L))
     out = pl.pallas_call(
         kernel,
         grid=(m,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((S, L), lambda t: (t, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((r * S, L), lambda t: (0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((r * S, L), jnp.float32),
         compiler_params=_compiler_params(4 * r * c),
         interpret=interpret,
-    )(rot.astype(jnp.int32), vp.astype(jnp.float32).reshape(m * S, L))
+    )(*operands)
     return out.reshape(r, c)
 
 
@@ -254,28 +281,29 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
 def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
                      interpret: bool = False, lanes: int | None = None,
                      one_mix: bool = False, valid: int | None = None,
-                     rot_step: int = 0):
+                     rot_step: int = 0, sgn=None):
     """(r, c) table -> (padded_d,) median-of-rows estimates, fused
     (the (r, padded_d) intermediate of the XLA path never exists).
 
     ``valid``: zero estimates at positions >= valid in-kernel — lets
     callers consume the padded vector directly instead of paying the
-    ``[:d]`` prefix-slice copy (CountSketch.estimates(padded=True))."""
+    ``[:d]`` prefix-slice copy (CountSketch.estimates(padded=True)).
+    ``sgn``: optional (padded_d,) packed sign bits, see
+    ``sketch_pallas``."""
     L = lanes or _pick_lanes(c)
     assert L is not None and c % L == 0
     S = c // L
     m = rot.shape[1]
     seed = np.uint32(sign_seed)
     sublane = rot_step > 0 and rot_step % L == 0
+    packed = sgn is not None
 
-    def kernel(rot_ref, tab_ref, out_ref):
+    def kernel(rot_ref, tab_ref, *refs):
+        (sgn_ref, out_ref) = refs if packed else (None, refs[0])
         t = pl.program_id(0)
-        if one_mix:
-            h = _sign_hash_chunk(t, seed, c, S, L, r)
-            flips = [_flip_from_hash(h, row) for row in range(r)]
-        else:
-            flips = [_flip_chunk(t, row, seed, c, S, L)
-                     for row in range(r)]
+        flips = _flips_for_chunk(
+            t, sgn_ref[:] if packed else None,
+            one_mix, seed, c, S, L, r)
         lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
         vals = []
         for row in range(r):
@@ -298,19 +326,26 @@ def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
         # cost a d-sized relayout on the way to selection)
         out_ref[:] = med.reshape(c)
 
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        # table resident in VMEM across all chunk steps
+        pl.BlockSpec((r * S, L), lambda t: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [rot.astype(jnp.int32),
+                table.astype(jnp.float32).reshape(r * S, L)]
+    if packed:
+        in_specs.append(pl.BlockSpec((S, L), lambda t: (t, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(sgn.reshape(m * S, L))
     out = pl.pallas_call(
         kernel,
         grid=(m,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            # table resident in VMEM across all chunk steps
-            pl.BlockSpec((r * S, L), lambda t: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((c,), lambda t: (t,),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m * c,), jnp.float32),
         compiler_params=_compiler_params(4 * r * c),
         interpret=interpret,
-    )(rot.astype(jnp.int32), table.astype(jnp.float32).reshape(r * S, L))
+    )(*operands)
     return out

@@ -37,8 +37,11 @@ def run(args):
     subprocess.run(cmd, cwd=REPO, check=False)
 
 
-def tail_acc(log):
-    """Mean test_acc of the last 5 epoch rows of an anchor log."""
+def tail_acc(log, min_epochs=20):
+    """Mean test_acc of the last 5 epoch rows of an anchor log.
+    Truncated/aborted logs (< min_epochs rows) return NaN so the
+    best-LR pick never compares early-epoch tails against completed
+    24-epoch tails."""
     accs = []
     try:
         with open(log) as f:
@@ -49,7 +52,7 @@ def tail_acc(log):
                     accs.append(float(parts[7]))
     except OSError:
         return float("nan")
-    if not accs:
+    if len(accs) < min_epochs:
         return float("nan")
     t = accs[-5:]
     return sum(t) / len(t)

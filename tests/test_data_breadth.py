@@ -456,3 +456,26 @@ class TestClientDropout:
         np.testing.assert_allclose(np.asarray(res.aggregated),
                                    np.asarray(solo.aggregated),
                                    rtol=1e-6, atol=1e-7)
+
+
+class TestNonIidResplitValidation:
+    def test_indivisible_client_count_rejected(self):
+        """The non-iid re-split divides clients evenly over natural
+        partitions; an indivisible --num_clients used to produce a
+        short images-per-client vector and crash the sampler with a
+        broadcast error mid-epoch (data_per_client is consulted
+        lazily, so trigger it directly)."""
+        from commefficient_tpu.data.synthetic import FedSynthetic
+        ds = FedSynthetic("", "Synthetic", train=True, do_iid=False,
+                          num_clients=16, per_class=8, seed=0)
+        with pytest.raises(ValueError, match="multiple of 10"):
+            ds.data_per_client  # property: the split is computed here
+
+    def test_divisible_count_and_iid_still_work(self):
+        from commefficient_tpu.data.synthetic import FedSynthetic
+        ds = FedSynthetic("", "Synthetic", train=True, do_iid=False,
+                          num_clients=20, per_class=8, seed=0)
+        assert len(ds.data_per_client) == 20
+        ds_iid = FedSynthetic("", "Synthetic", train=True, do_iid=True,
+                              num_clients=16, per_class=8, seed=0)
+        assert len(ds_iid.data_per_client) == 16

@@ -157,3 +157,59 @@ class TestSublaneRotations:
         np.testing.assert_allclose(np.asarray(cs.sketch_sparse(idx, vals)),
                                    np.asarray(cs.sketch(dense)),
                                    rtol=1e-6, atol=1e-5)
+
+
+class TestPackedSigns:
+    """Packed-sign streaming (CountSketch.packed_signs) must be a pure
+    perf lever: identical sign VALUES to in-kernel hashing, so tables
+    and recoveries are bit-identical between the two kernel modes."""
+
+    @pytest.mark.parametrize("d,c,r", GEOMS)
+    def test_packed_vs_hashed_bit_identical(self, d, c, r):
+        packed = CountSketch(d=d, c=c, r=r, seed=7,
+                             backend="pallas_interpret")
+        hashed = CountSketch(d=d, c=c, r=r, seed=7,
+                             backend="pallas_interpret",
+                             packed_signs=False)
+        assert packed._packed_sign_kernels
+        assert not hashed._packed_sign_kernels
+        v = jnp.asarray(np.random.RandomState(3).randn(d)
+                        .astype(np.float32))
+        tp, th = packed.sketch(v), hashed.sketch(v)
+        assert jnp.array_equal(tp, th), "sketch tables differ"
+        ep = packed.estimates(tp, padded=True)
+        eh = hashed.estimates(tp, padded=True)
+        assert jnp.array_equal(ep, eh), "estimates differ"
+
+    def test_packed_bits_match_signs_row(self):
+        cs = CountSketch(d=4096, c=1024, r=5, seed=11)
+        bits = np.asarray(jax.jit(cs._packed_signs_traced)())
+        for row in range(cs.r):
+            want = np.asarray(cs._signs_row(row))
+            got = 1.0 - 2.0 * ((bits >> row) & 1).astype(np.float32)
+            np.testing.assert_array_equal(got, want)
+
+    def test_r9_falls_back_to_hashing(self):
+        cs = CountSketch(d=2048, c=512, r=9, seed=7,
+                         backend="pallas_interpret")
+        assert not cs._packed_sign_kernels  # u8 holds 8 row bits
+        t = cs.sketch(jnp.ones(2048, jnp.float32))
+        assert t.shape == (9, 512)
+
+
+def test_r17_per_row_mix_path():
+    """r > 16 leaves the one-mix scheme: the kernels hash once per
+    (row, coord) via _flip_chunk. Pin that branch of the flip-mask
+    formulation against the XLA path (it is outside GEOMS and the
+    packed-sign eligibility, so nothing else executes it)."""
+    d, c, r = 2048, 512, 17
+    xla = CountSketch(d=d, c=c, r=r, seed=7, backend="xla")
+    pal = CountSketch(d=d, c=c, r=r, seed=7,
+                      backend="pallas_interpret")
+    assert not pal._one_mix_signs and not pal._packed_sign_kernels
+    v = jnp.asarray(np.random.RandomState(5).randn(d)
+                    .astype(np.float32))
+    tx, tp = xla.sketch(v), pal.sketch(v)
+    np.testing.assert_allclose(np.asarray(tx), np.asarray(tp),
+                               rtol=1e-6, atol=1e-5)
+    assert jnp.array_equal(xla.estimates(tx), pal.estimates(tx))
