@@ -207,10 +207,20 @@ class Config:
     # models/gpt2.py GPT2Config.attn_impl
     attn_impl: str = "xla"
     # sketch rotation granularity (ops/sketch.py CountSketch.rot_lanes):
-    # 0 = full (default); >0 quantizes rotations to multiples of this,
-    # turning the kernels' rolls sublane-only. Quality at the flagship
-    # ratio measured indistinguishable (scripts/rot_quality.py)
-    sketch_rot_lanes: int = 0
+    # -1 = auto (default): 1024 on a TPU backend when the geometry is
+    # large-d Pallas-eligible (the round-5 24-epoch anchors measured
+    # tail-accuracy parity with full-granularity rotations at both
+    # seeds, so the −44% kernel-pair / −8% flagship-round win is on by
+    # default — core/rounds.py args2sketch); 0 everywhere else, since
+    # quantized rotations pay their heavier collision tail for nothing
+    # without the Pallas sublane roll. 0 = force full granularity;
+    # >0 quantizes rotations to multiples of that lane width.
+    # Sketch tables/error state are not comparable across different
+    # resolved values (different rotation streams) — a checkpoint
+    # resumed under a different backend re-resolves -1, so pin an
+    # explicit value when moving sketch-mode checkpoints across
+    # platforms.
+    sketch_rot_lanes: int = -1
     # scan the round's client fan-out in chunks of this many clients
     # (0 = all at once): caps live per-client intermediates at
     # chunk x d — the memory lever for large-W rounds of the local-
@@ -456,11 +466,12 @@ def build_parser(default_lr: Optional[float] = None,
                         choices=["xla", "flash"],
                         help="GPT-2 attention lowering: XLA fusion or "
                         "the Pallas TPU flash-attention kernel")
-    parser.add_argument("--sketch_rot_lanes", type=int, default=0,
+    parser.add_argument("--sketch_rot_lanes", type=int, default=-1,
                         help="quantize sketch rotations to multiples "
-                        "of this lane width (0 = full granularity); "
-                        "speeds the Pallas kernels' rolls, see "
-                        "BENCHMARKS.md")
+                        "of this lane width (-1 = auto: 1024 on TPU "
+                        "at large-d Pallas-eligible geometries, else "
+                        "0; 0 = force full granularity); speeds the "
+                        "Pallas kernels' rolls, see BENCHMARKS.md")
     parser.add_argument("--client_chunk", type=int, default=0,
                         help="scan the round's client fan-out in "
                         "chunks of this many clients (0 = all at "

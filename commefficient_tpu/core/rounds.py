@@ -83,6 +83,40 @@ class RoundResult(NamedTuple):
     bn_stats: Optional[tuple] = None
 
 
+_AUTO_ROT_LANES = 1024
+
+
+def resolve_rot_lanes(cfg: Config) -> int:
+    """Resolve ``--sketch_rot_lanes -1`` (auto, the default).
+
+    Quantized rotations pay a heavier collision tail (rot_lanes/c for
+    same-lane-offset pairs instead of 1/c) and buy a single sublane
+    roll ONLY inside the Pallas TPU kernels — so auto engages 1024
+    exactly where that trade was measured to win with no quality cost:
+    a TPU default backend at a Pallas-supported, lane-aligned,
+    large-d geometry (−44% on the sketch/estimates kernel pair at
+    d=124M, −8% on the flagship GPT-2 federated round; 24-epoch
+    anchor tail accuracy at parity with full-granularity rotations at
+    both seeds — BENCHMARKS.md round-5 sections). Everywhere else
+    auto resolves to 0 (full granularity). Explicit values pass
+    through untouched. The default-backend probe lives here, NOT in
+    CountSketch.__post_init__: round build runs after any
+    jax.distributed initialization / platform selection."""
+    lanes = getattr(cfg, "sketch_rot_lanes", 0)
+    if lanes >= 0:
+        return lanes
+    from commefficient_tpu.ops.sketch_pallas import supported
+    d, c, r = cfg.grad_size, cfg.num_cols, cfg.num_rows
+    # c % 1024 == 0 also implies _pick_lanes(c) == 1024 (it probes
+    # 1024 first), so the sublane fast path's rot_step % L == 0
+    # precondition holds whenever the modulus check passes
+    if (d < (1 << 20) or not supported(d, c, r)
+            or c % _AUTO_ROT_LANES or c // _AUTO_ROT_LANES < 8):
+        return 0
+    return (_AUTO_ROT_LANES
+            if jax.default_backend() in ("tpu", "axon") else 0)
+
+
 def args2sketch(cfg: Config) -> Optional[CountSketch]:
     """(reference fed_aggregator.py:466-469)"""
     if cfg.mode != "sketch":
@@ -91,7 +125,7 @@ def args2sketch(cfg: Config) -> Optional[CountSketch]:
                        num_blocks=cfg.num_blocks, seed=cfg.seed,
                        approx_topk=cfg.approx_topk,
                        approx_recall=cfg.approx_recall,
-                       rot_lanes=getattr(cfg, "sketch_rot_lanes", 0))
+                       rot_lanes=resolve_rot_lanes(cfg))
 
 
 def build_client_round(cfg: Config, loss_fn: Optional[Callable],

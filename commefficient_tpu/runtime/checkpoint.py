@@ -91,6 +91,13 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         "error_type": model.args.error_type,
         "extra": extra or {},
     }
+    if model.args.mode == "sketch":
+        # the RESOLVED rotation granularity, not the -1 sentinel: a
+        # sketch-space error table decoded under a different rotation
+        # stream is silent corruption, and auto (-1) re-resolves per
+        # platform — so resume validates the resolved value
+        from commefficient_tpu.core.rounds import resolve_rot_lanes
+        meta["rot_lanes"] = int(resolve_rot_lanes(model.args))
     if scheduler is not None:
         meta["scheduler_step"] = int(scheduler._step)
     if sampler is not None and hasattr(sampler.rng, "get_state"):
@@ -178,6 +185,18 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
             checks.append(("transmit_shape",
                            list(model.args.transmit_shape)))
             checks.append(("error_type", model.args.error_type))
+        if model.args.mode == "sketch":
+            # an absent key is a pre-round-5 checkpoint, written when
+            # the default was 0 (full granularity) — it must still
+            # refuse a run whose auto default now resolves nonzero,
+            # not skip the check
+            from commefficient_tpu.core.rounds import resolve_rot_lanes
+            got = int(meta.get("rot_lanes", 0))
+            want = int(resolve_rot_lanes(model.args))
+            if got != want:
+                raise ValueError(
+                    f"checkpoint rot_lanes={got} does not match "
+                    f"this run's {want} ({path})")
         for key, want in checks:
             if meta[key] != want:
                 raise ValueError(

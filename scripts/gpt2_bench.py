@@ -218,7 +218,11 @@ def main():
     ap.add_argument("--mode", default="sketch")
     ap.add_argument("--attn_impl", default="xla",
                     choices=["xla", "flash"])
-    ap.add_argument("--rot_lanes", type=int, default=0)
+    ap.add_argument("--rot_lanes", type=int, default=-1,
+                    help="-1 = the trainer's auto default (resolves "
+                    "per backend/geometry, core/rounds.py "
+                    "resolve_rot_lanes); 0 forces full-granularity "
+                    "rotations for A/Bs against it")
     ap.add_argument("--tokens_per_chunk", type=int, default=0,
                     help="vocab-CE chunk budget (0 = auto 1024); the "
                     "task-5 sweep knob — larger chunks trade logits "

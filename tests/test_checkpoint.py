@@ -68,6 +68,21 @@ def test_resume_rejects_mismatched_config(tmp_path):
                              "--virtual_momentum", "0")))
 
 
+def test_resume_rejects_rot_lanes_mismatch(tmp_path):
+    """A sketch checkpoint records its RESOLVED rotation granularity:
+    resuming under a different one would decode the saved sketch-space
+    error state against the wrong rotation stream — silent corruption,
+    so it must refuse (runtime/checkpoint.py rot_lanes check; the
+    cross-platform risk is the auto default re-resolving per
+    backend)."""
+    cv_train.main(_argv(tmp_path, 1))  # auto -> 0 on the CPU backend
+    with pytest.raises(ValueError, match="rot_lanes"):
+        # 1 is the only granularity the tiny --test sketch (c=10)
+        # admits; any resolved value != the checkpoint's 0 must refuse
+        cv_train.main(_argv(tmp_path, 2,
+                            ("--resume", "--sketch_rot_lanes", "1")))
+
+
 def test_resume_requires_existing_checkpoint(tmp_path):
     with pytest.raises(FileNotFoundError):
         cv_train.main(_argv(tmp_path / "empty", 1, ("--resume",)))

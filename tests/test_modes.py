@@ -668,3 +668,56 @@ class TestDeadSlotServerMasking:
         round_with_mask(dead)
         np.testing.assert_array_equal(
             np.asarray(model.client_states.velocities[1]), vel_before)
+
+
+class TestResolveRotLanes:
+    """--sketch_rot_lanes -1 (auto) resolution — core/rounds.py
+    resolve_rot_lanes engages 1024 only on a TPU default backend at a
+    Pallas-supported, lane-aligned, large-d geometry; everywhere else
+    (and for any explicit value) the sketch keeps what it was given."""
+
+    FLAGSHIP = dict(mode="sketch", error_type="virtual",
+                    virtual_momentum=0.9, k=100, num_rows=5,
+                    num_cols=524288, grad_size=6_600_000)
+
+    def _resolve(self, **kw):
+        from commefficient_tpu.core.rounds import resolve_rot_lanes
+        base = dict(self.FLAGSHIP)
+        base.update(kw)
+        return resolve_rot_lanes(make_cfg(**base))
+
+    def test_config_default_is_auto(self):
+        assert make_cfg(**self.FLAGSHIP).sketch_rot_lanes == -1
+
+    def test_auto_is_off_on_cpu(self, monkeypatch):
+        # on a CPU backend auto must keep full-granularity rotations
+        # (quantization would pay its collision tail for zero speedup
+        # — no sublane roll there); pinned via monkeypatch so the
+        # test also passes when the suite runs on a TPU host
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert self._resolve() == 0
+        cs = args2sketch(make_cfg(**self.FLAGSHIP))
+        assert cs.rot_lanes == 0
+
+    def test_auto_engages_on_tpu_at_flagship_geometry(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert self._resolve() == 1024
+
+    def test_auto_stays_off_for_small_d(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert self._resolve(grad_size=100_000) == 0
+
+    def test_auto_stays_off_for_coarse_c(self, monkeypatch):
+        # c // 1024 < 8: the rotation space would collapse
+        # (CountSketch asserts the same bound for explicit values)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert self._resolve(num_cols=4096) == 0
+
+    def test_explicit_values_pass_through(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert self._resolve(sketch_rot_lanes=0) == 0
+        assert self._resolve(sketch_rot_lanes=1024) == 1024
+        # explicit quantization off-TPU passes through too (the
+        # CountSketch-level warning covers the footgun)
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert self._resolve(sketch_rot_lanes=1024) == 1024
