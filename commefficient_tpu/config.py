@@ -235,6 +235,13 @@ class Config:
     # knee, BENCHMARKS.md SP table), 1024 on the single-device path
     # (throughput-flat across 512-4096 at the 8x geometry).
     tokens_per_chunk: int = 0
+    # GPT-2: fused-linear-CE vocab head (ops/flce_pallas.py) — the
+    # per-chunk logits round-trips of the chunked path go away
+    # entirely. "auto" = Pallas kernels on a TPU default backend at
+    # lane-aligned widths, chunked elsewhere; "on"/"off" force.
+    # Default off pending the on-chip A/B (scripts/gpt2_bench.py
+    # --fused_ce).
+    fused_ce: str = "off"
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -256,6 +263,8 @@ class Config:
             "--pipeline_depth must be >= 1"
         assert self.tokens_per_chunk >= 0, \
             "--tokens_per_chunk must be >= 0 (0 = auto)"
+        assert self.fused_ce in ("auto", "on", "off"), \
+            "--fused_ce must be auto|on|off"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -462,6 +471,11 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--tokens_per_chunk", type=int, default=0,
                         help="tokens per logits chunk in the chunked "
                         "vocab cross-entropy (0 = auto)")
+    parser.add_argument("--fused_ce", type=str, default="off",
+                        choices=["auto", "on", "off"],
+                        help="fused-linear-CE vocab head (Pallas; "
+                        "ops/flce_pallas.py): auto = on at TPU "
+                        "default backend, chunked elsewhere")
     parser.add_argument("--attn_impl", type=str, default="xla",
                         choices=["xla", "flash"],
                         help="GPT-2 attention lowering: XLA fusion or "

@@ -39,12 +39,15 @@ from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
 MAX_SEQ_LEN = 256  # static pad length (persona sequences are short)
 
 
-def _lm_nll_sums(module, params, batch, tokens_per_chunk=0):
+def _lm_nll_sums(module, params, batch, tokens_per_chunk=0,
+                 fused=False):
     """Shared forward for the train and val losses: hidden states +
-    MC logits from the module, then the chunked tied-head
-    cross-entropy (models/gpt2.py lm_nll_sums_chunked — the
-    (tokens, vocab) logits tensor never materialises). Returns
-    per-example ((B*N,) Σnll, (B*N,) Σvalid), mc_logits, B, N.
+    MC logits from the module, then the tied-head cross-entropy — the
+    (tokens, vocab) logits tensor never materialises: chunked
+    (models/gpt2.py lm_nll_sums_chunked) by default, or the fused
+    Pallas kernels (ops/flce_pallas.py, ``fused=True``) where even the
+    per-chunk logits tiles stay in VMEM. Returns per-example
+    ((B*N,) Σnll, (B*N,) Σvalid), mc_logits, B, N.
     ``tokens_per_chunk`` 0 = auto (1024 — throughput-flat 512-4096
     at the 8x geometry, BENCHMARKS.md)."""
     from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
@@ -55,11 +58,24 @@ def _lm_nll_sums(module, params, batch, tokens_per_chunk=0):
         {"params": params}, ids, batch["mc_token_ids"],
         batch["token_type_ids"], return_hidden=True)
     labels = batch["lm_labels"].reshape(B * N, T)
-    sn, sv = lm_nll_sums_chunked(h[:, :-1], wte, labels[:, 1:],
-                                 module.cfg.dtype, ignore_index=-1,
-                                 tokens_per_chunk=tokens_per_chunk
-                                 or 1024)
+    if fused:
+        from commefficient_tpu.ops.flce_pallas import lm_nll_sums_fused
+        sn, sv = lm_nll_sums_fused(h[:, :-1], wte, labels[:, 1:],
+                                   module.cfg.dtype, ignore_index=-1,
+                                   tokens_per_chunk=tokens_per_chunk
+                                   or 1024)
+    else:
+        sn, sv = lm_nll_sums_chunked(h[:, :-1], wte, labels[:, 1:],
+                                     module.cfg.dtype, ignore_index=-1,
+                                     tokens_per_chunk=tokens_per_chunk
+                                     or 1024)
     return sn, sv, mc_logits, B, N
+
+
+def _resolve_fused(args, module):
+    from commefficient_tpu.ops.flce_pallas import resolve_fused_ce
+    return resolve_fused_ce(getattr(args, "fused_ce", "off"),
+                            module.cfg.n_embd)
 
 
 def _token_nll(logits, labels, ignore_index=-1):
@@ -74,8 +90,9 @@ def make_compute_loss_train(module, args):
     per-example vmap (which XLA lowers to a serial scan over examples
     with a materialised f32 logits buffer — measured 10x the cost).
     The LM term is computed by the chunked tied-head cross-entropy
-    (models/gpt2.py lm_nll_sums_chunked via _lm_nll_sums): the
-    (tokens, vocab) logits tensor never materialises — its f32
+    (models/gpt2.py lm_nll_sums_chunked via _lm_nll_sums) — or the
+    fused Pallas kernels (ops/flce_pallas.py) with --fused_ce — so
+    the (tokens, vocab) logits tensor never materialises: its f32
     store/reload chain dominated the large-batch training profile."""
 
     def compute_loss(params, batch, cfg):
@@ -83,7 +100,8 @@ def make_compute_loss_train(module, args):
         # per example i: token-mean over its valid positions
         sn, sv, mc_logits, B, N = _lm_nll_sums(
             module, params, batch,
-            getattr(args, "tokens_per_chunk", 0))
+            getattr(args, "tokens_per_chunk", 0),
+            fused=_resolve_fused(args, module))
         lm_i = sn.reshape(B, N).sum(1) \
             / jnp.maximum(sv.reshape(B, N).sum(1), 1.0)
 
@@ -101,14 +119,16 @@ def make_compute_loss_train(module, args):
 
 def make_compute_loss_val(module, args):
     """(reference gpt2_train.py:55-86): token-mean NLL + MC accuracy.
-    The NLL uses the chunked tied-head cross-entropy: with
+    The NLL uses the chunked (or, with --fused_ce, the fused-kernel)
+    tied-head cross-entropy: with
     full-candidate validation (N ~ 20) a materialised f32
     (B, N, T, V) logits tensor would be ~8 GB per val shard at the
     natural PersonaChat candidate count."""
     def compute_loss(params, batch, cfg):
         sn, sv, mc_logits, B, N = _lm_nll_sums(
             module, params, batch,
-            getattr(args, "tokens_per_chunk", 0))
+            getattr(args, "tokens_per_chunk", 0),
+            fused=_resolve_fused(args, module))
         m = batch["mask"]
         w = jnp.broadcast_to(m[:, None], (B, N)).reshape(B * N)
         nll = jnp.sum(sn * w) / jnp.maximum(jnp.sum(sv * w), 1.0)

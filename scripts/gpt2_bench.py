@@ -46,7 +46,8 @@ def build(args):
                  approx_recall=0.95, num_candidates=args.candidates,
                  lm_coef=1.0, mc_coef=1.0,
                  sketch_rot_lanes=args.rot_lanes,
-                 tokens_per_chunk=args.tokens_per_chunk)
+                 tokens_per_chunk=args.tokens_per_chunk,
+                 fused_ce=args.fused_ce)
 
     gcfg = GPT2Config(vocab_size=50262, n_positions=1024,
                       dtype=jnp.bfloat16, remat=args.remat,
@@ -122,7 +123,8 @@ def build_bare(args):
                  local_batch_size=args.examples,
                  dataset_name="PERSONA", seed=21,
                  num_candidates=args.candidates,
-                 tokens_per_chunk=args.tokens_per_chunk)
+                 tokens_per_chunk=args.tokens_per_chunk,
+                 fused_ce=args.fused_ce)
     gcfg = GPT2Config(vocab_size=50262, n_positions=1024,
                       dtype=jnp.bfloat16, remat=args.remat,
                       attn_impl=args.attn_impl)
@@ -223,6 +225,10 @@ def main():
                     "per backend/geometry, core/rounds.py "
                     "resolve_rot_lanes); 0 forces full-granularity "
                     "rotations for A/Bs against it")
+    ap.add_argument("--fused_ce", default="off",
+                    choices=["auto", "on", "off"],
+                    help="fused-linear-CE vocab head (ops/"
+                    "flce_pallas.py); auto = on at TPU backend")
     ap.add_argument("--tokens_per_chunk", type=int, default=0,
                     help="vocab-CE chunk budget (0 = auto 1024); the "
                     "task-5 sweep knob — larger chunks trade logits "
