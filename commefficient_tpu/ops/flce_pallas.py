@@ -58,8 +58,10 @@ _VMEM_LIMIT = 100 * 1024 * 1024
 # transient but real: nv * M * C * 2 bytes per call (times the client
 # axis under vmap), so calls whose partials would exceed this cap
 # fall back to the chunked path instead of risking an HBM OOM the
-# chunked path doesn't have.
-_DXP_LIMIT = 256 * 1024 * 1024
+# chunked path doesn't have. 512 MB admits the T=1024 long-context
+# geometry (M=8184 -> 315 MB/client) with an order of magnitude of
+# HBM headroom at the benched client counts.
+_DXP_LIMIT = 512 * 1024 * 1024
 
 
 def supported(c: int) -> bool:

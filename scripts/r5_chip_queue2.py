@@ -1,0 +1,69 @@
+"""Round-5 chip queue, part 2 (run after the local_topk lr-0.1 anchor):
+
+1. local_topk at 20% participation (the last unexplored dial for an
+   anchor row where the mode learns): 100 clients x cpc3, w20, lr 0.1.
+   Full-participation runs are flat at BOTH lr 0.4 and 0.1, so LR is
+   ruled out; averaging 100 mostly-disjoint k=50000 masks shrinks the
+   per-coordinate step ~100x, and participation is the remaining
+   lever the round-3 small-scale evidence (localtopk_cpc3_w5.log,
+   acc 1.0 at 50% participation of 10 clients) says matters.
+2. FLCE A/B: flagship (4x2x2x256) and 8x (8x8x2x256) federated sketch
+   rounds, --fused_ce off vs on; plus bare-model 8x control.
+3. T=1024 long-context federated: sketch mode, 2x4x2x1024, XLA vs
+   flash attention, fused CE on/off — the verdict-5 end-to-end run.
+
+Everything prints to stdout; anchor logs land in runs/.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def run(cmd):
+    print("==>", " ".join(cmd), flush=True)
+    r = subprocess.run(cmd, cwd=REPO, check=False,
+                       capture_output=True, text=True)
+    out = (r.stdout or "") + (r.stderr or "")
+    for line in out.splitlines():
+        if line.startswith("{") or "==" in line or "acc" in line:
+            print(line, flush=True)
+    return out
+
+
+def main():
+    # 1. local_topk participation probe
+    run([PY, "scripts/anchor24.py", "--modes", "local_topk",
+         "--num_clients", "100", "--lr_scale", "0.1", "--seed", "21",
+         "--suffix", "_c100cpc3w20_lr01",
+         "--extra",
+         "--client_chunk 10 --classes_per_client 3 --num_workers 20"])
+
+    # 2. FLCE end-to-end A/Bs
+    for geom in (["--clients", "4", "--examples", "2"],
+                 ["--clients", "8", "--examples", "8"]):
+        for fused in ("off", "on"):
+            run([PY, "scripts/gpt2_bench.py", "--mode", "sketch",
+                 "--rounds", "10", "--reps", "3",
+                 "--fused_ce", fused] + geom)
+    for fused in ("off", "on"):
+        run([PY, "scripts/gpt2_bench.py", "--mode", "bare",
+             "--clients", "8", "--examples", "8",
+             "--rounds", "10", "--reps", "3", "--fused_ce", fused])
+
+    # 3. T=1024 federated long-context: attn x fused matrix
+    for attn in ("xla", "flash"):
+        for fused in ("off", "on"):
+            run([PY, "scripts/gpt2_bench.py", "--mode", "sketch",
+                 "--clients", "2", "--examples", "4",
+                 "--seq", "1024", "--rounds", "5", "--reps", "3",
+                 "--attn_impl", attn, "--fused_ce", fused])
+    print("QUEUE2 DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
