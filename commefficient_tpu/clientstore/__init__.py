@@ -1,0 +1,26 @@
+"""Host-backed per-client state store (see store.py for the design).
+
+Public surface:
+  HostClientStore     — budgeted NumPy arena + mmap spill tier
+  StorePrefetcher     — double-buffered async gather thread
+  state_fields        — which fields a Config needs
+  state_row_bytes     — per-client state footprint under a Config
+  resolve_clientstore — build-time resolution of --clientstore auto
+  shard_range         — contiguous multi-host client-id ownership
+"""
+
+from commefficient_tpu.clientstore.prefetch import StorePrefetcher
+from commefficient_tpu.clientstore.store import (HostClientStore,
+                                                 resolve_clientstore,
+                                                 shard_range,
+                                                 state_fields,
+                                                 state_row_bytes)
+
+__all__ = [
+    "HostClientStore",
+    "StorePrefetcher",
+    "resolve_clientstore",
+    "shard_range",
+    "state_fields",
+    "state_row_bytes",
+]

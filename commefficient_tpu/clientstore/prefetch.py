@@ -1,0 +1,111 @@
+"""Double-buffered background gather for the host client store.
+
+The trainer knows round N+1's participant ids one round ahead
+(``FedSampler.peek_next_client_ids``), so a single worker thread can
+stage their rows while round N's jitted compute runs, hiding the
+host gather + H2D behind device time — the same overlap the C++
+dataplane's ring gets for batches.
+
+Two staging buffer sets alternate between consecutive submits, so
+the consumer can still be uploading buffer A while the worker fills
+buffer B.  Correctness does not depend on the prediction: ``take``
+verifies the ids match, patches any row written after the async
+gather's snapshot (store write-versions), and returns ``None`` on a
+miss so the caller falls back to a synchronous gather.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+class StorePrefetcher:
+    def __init__(self, store, name="clientstore-prefetch"):
+        self._store = store
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._done: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._pending = 0
+        self._buffers = [{}, {}]
+        self._buf_i = 0
+        self.hits = 0
+        self.misses = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                job = self._jobs.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if job is None:
+                return
+            ids, buf = job
+            try:
+                rows, version = self._store.gather(ids, out=buf)
+                self._done.put((ids, rows, version, None))
+            except BaseException as exc:  # surfaced by take()
+                self._done.put((ids, None, 0, exc))
+
+    # ------------------------------------------------------------------
+    def submit(self, ids):
+        """Stage an async gather for next round's participant ids."""
+        if self._stop.is_set():
+            return
+        ids = np.array(ids, dtype=np.int64).reshape(-1)
+        buf = self._buffers[self._buf_i]
+        self._buf_i ^= 1
+        self._pending += 1
+        self._jobs.put((ids, buf))
+
+    def take(self, ids, timeout=60.0):
+        """Rows for ``ids`` if a staged gather matches, else ``None``.
+
+        Drains stale jobs (mispredicted or skipped rounds) until a
+        matching one is found; patches rows the store wrote after the
+        job's version snapshot so the result is always current.
+        """
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        while self._pending > 0:
+            try:
+                job_ids, rows, version, exc = self._done.get(
+                    timeout=timeout)
+            except queue.Empty:
+                return None  # worker wedged: fall back synchronously
+            self._pending -= 1
+            if exc is not None:
+                raise exc
+            if len(job_ids) != len(ids) or \
+                    not np.array_equal(job_ids, ids):
+                self.misses += 1
+                continue
+            stale = [i for i, cid in enumerate(job_ids)
+                     if self._store.row_version(int(cid)) > version]
+            if stale:
+                fresh, _ = self._store.gather(job_ids[stale])
+                for name in rows:
+                    rows[name][stale] = fresh[name]
+            self.hits += 1
+            return rows
+        return None
+
+    # ------------------------------------------------------------------
+    def close(self, timeout=5.0):
+        """Stop the worker and join it; idempotent, never hangs the
+        caller past ``timeout`` even with staged jobs un-taken."""
+        self._stop.set()
+        self._jobs.put(None)
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    def __del__(self):
+        try:
+            self.close(timeout=0.5)
+        except Exception:
+            pass

@@ -242,6 +242,19 @@ class Config:
     # Default off pending the on-chip A/B (scripts/gpt2_bench.py
     # --fused_ce).
     fused_ce: str = "off"
+    # Per-client state placement (commefficient_tpu/clientstore):
+    # "device" keeps the dense (num_clients, *transmit_shape) arrays in
+    # HBM (reference-shaped); "host" keeps them in a budgeted host
+    # arena with an mmap spill tier and materializes only the round's
+    # participants on device — million-client populations on a fixed
+    # HBM budget; "auto" resolves at build time: host when the dense
+    # population would exceed --clientstore_bytes, device otherwise.
+    clientstore: str = "device"
+    # arena budget for --clientstore host/auto (bytes); rows beyond it
+    # are evicted LRU-first to the mmap spill tier
+    clientstore_bytes: int = 1 << 30
+    # spill-tier directory ("" = private temp dir, removed on exit)
+    clientstore_dir: str = ""
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -265,6 +278,10 @@ class Config:
             "--tokens_per_chunk must be >= 0 (0 = auto)"
         assert self.fused_ce in ("auto", "on", "off"), \
             "--fused_ce must be auto|on|off"
+        assert self.clientstore in ("device", "host", "auto"), \
+            "--clientstore must be device|host|auto"
+        assert self.clientstore_bytes >= 0, \
+            "--clientstore_bytes must be >= 0"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -491,6 +508,20 @@ def build_parser(default_lr: Optional[float] = None,
                         "chunks of this many clients (0 = all at "
                         "once) — memory lever for large rounds of "
                         "the local-state modes on one chip")
+    parser.add_argument("--clientstore", type=str, default="device",
+                        choices=["device", "host", "auto"],
+                        help="per-client state placement: dense HBM "
+                        "arrays (device), budgeted host arena + mmap "
+                        "spill with per-round participant gather "
+                        "(host), or resolve by footprint vs "
+                        "--clientstore_bytes (auto)")
+    parser.add_argument("--clientstore_bytes", type=int,
+                        default=1 << 30,
+                        help="host client-store arena budget in bytes "
+                        "(rows beyond it spill to mmap)")
+    parser.add_argument("--clientstore_dir", type=str, default="",
+                        help="client-store spill directory "
+                        "(default: private temp dir)")
 
     return parser
 

@@ -132,10 +132,18 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                        padded_batch_size: int,
                        mesh=None, stats_fn: Callable = None,
                        tree_loss: Callable = None,
-                       unravel: Callable = None) -> Callable:
+                       unravel: Callable = None,
+                       dense_rows: bool = False) -> Callable:
     """Returns jit-able
     ``client_round(ps_weights, client_states, batch, client_ids, rng,
     fedavg_lr) -> RoundResult``.
+
+    ``dense_rows``: host-clientstore mode (runtime/fed_model.py) — the
+    ``client_states`` arrays hold ONLY the round's W participant rows
+    (gathered host-side, ordered like ``client_ids``), so state rows
+    are indexed by POSITION while the RNG folding below keeps the real
+    client ids: every per-client stream is bit-identical to the
+    device-resident path.
 
     Sketch-mode fast path: because sketching is linear and (absent
     ``max_grad_norm``'s per-sketch clip) no per-client op touches the
@@ -303,8 +311,15 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         # client 0 in the same round would otherwise RACE the pad's
         # no-op row in the state scatter (duplicate indices, order
         # unspecified). Remap them to an out-of-range id: gathers
-        # clamp (values unused), scatters drop.
-        client_ids = _state_ids(client_ids, batch)
+        # clamp (values unused), scatters drop. In dense_rows mode the
+        # state arrays hold only this round's W rows, so state indices
+        # are slot POSITIONS (same sentinel treatment); the rngs above
+        # were already folded from the REAL ids.
+        if dense_rows:
+            client_ids = _state_ids(
+                jnp.arange(W, dtype=client_ids.dtype), batch)
+        else:
+            client_ids = _state_ids(client_ids, batch)
 
         chunk = getattr(cfg, "client_chunk", 0)
         ndev = mesh.devices.size if mesh is not None else 1

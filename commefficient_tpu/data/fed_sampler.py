@@ -12,6 +12,42 @@ import numpy as np
 __all__ = ["FedSampler"]
 
 
+class _Lookahead:
+    """Iterator that buffers ONE item ahead so the round spec the
+    consumer will receive next is peekable — the client-store prefetch
+    thread (runtime/fed_model.py) needs round N+1's participant ids
+    while round N computes. Each underlying draw happens one ``next``
+    earlier than it would unbuffered, but the draw ORDER (and hence
+    the sampler RNG stream a checkpoint captures) is unchanged."""
+
+    def __init__(self, it):
+        self._it = it
+        self._buf = None
+        self._has = False
+        self._advance()
+
+    def _advance(self):
+        try:
+            self._buf = next(self._it)
+            self._has = True
+        except StopIteration:
+            self._buf = None
+            self._has = False
+
+    def peek(self):
+        return self._buf if self._has else None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._has:
+            raise StopIteration
+        out = self._buf
+        self._advance()
+        return out
+
+
 class FedSampler:
     def __init__(self, dataset, num_workers, local_batch_size,
                  shuffle_clients=True, seed=None):
@@ -21,6 +57,16 @@ class FedSampler:
         self.shuffle_clients = shuffle_clients
         self.rng = (np.random if seed is None
                     else np.random.RandomState(seed))
+        self._lookahead = None
+
+    def peek_next_client_ids(self):
+        """Participant ids of the round the active iterator will yield
+        NEXT, or None (no active iterator / epoch exhausted)."""
+        la = self._lookahead
+        spec = la.peek() if la is not None else None
+        if spec is None:
+            return None
+        return [cid for cid, _ in spec]
 
     def __iter__(self):
         data_per_client = np.asarray(self.dataset.data_per_client)
@@ -51,7 +97,8 @@ class FedSampler:
                 yield list(zip(workers.tolist(), idx_lists))
                 cur[workers] += sizes
 
-        return sampler()
+        self._lookahead = _Lookahead(sampler())
+        return self._lookahead
 
     def __len__(self):
         return len(self.dataset)

@@ -70,6 +70,19 @@ class _RoundLoaderBase:
                 continue  # incomplete round: skip
             yield self._apply_dropout(self.collate(round_spec))
 
+    def peek_next_client_ids(self):
+        """Next round's participant ids one round ahead (the
+        client-store prefetch feed, runtime/fed_model.py). None when
+        the sampler can't see ahead or the peeked round is incomplete
+        (it would be skipped above) — the consumer then falls back to
+        a synchronous gather, so a miss costs latency, never
+        correctness."""
+        peek = getattr(self.sampler, "peek_next_client_ids", None)
+        ids = peek() if peek is not None else None
+        if ids is None or len(ids) < self.W:
+            return None
+        return ids
+
     def collate(self, round_spec) -> dict:
         raise NotImplementedError
 

@@ -39,6 +39,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the params class was renamed TPUCompilerParams -> CompilerParams
+# across JAX releases; accept either so the kernels (and their
+# interpret-mode tests) run on both
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 # Default tiles: (1024, 2048) keeps the weight-streaming traffic low
 # (W is re-read once per token block: M/BM * |W|) while the f32
 # logits tile (8 MB) and the backward's f32 dW accumulator (6.3 MB)
@@ -223,7 +229,7 @@ def _flce_fwd_impl(x, w, labels, block_m, block_v, interpret):
             pltpu.VMEM((bm, _STATS_LANES), jnp.float32),
             pltpu.VMEM((bm, _STATS_LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(lp, xp, wp)
@@ -279,12 +285,16 @@ def _flce_vjp_bwd(block_m, block_v, interpret, res, g):
         scratch_shapes=[
             pltpu.VMEM((block_v, c), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(lp, xp, wp, lsep, glp, gtp)
 
-    dx = jnp.sum(dxp, axis=0)[:m].astype(x.dtype)
+    # f32 partials reduction: the nv per-vocab-block dX contributions
+    # are near-cancelling around softmax mass, so a bf16 tree-sum
+    # loses mantissa exactly where the gradient is smallest — bound
+    # the rounding to the final cast
+    dx = jnp.sum(dxp, axis=0, dtype=jnp.float32)[:m].astype(x.dtype)
     dwo = dw[:v].astype(w.dtype)
     return dx, dwo, np.zeros(labels.shape, jax.dtypes.float0)
 
@@ -305,20 +315,57 @@ def resolve_fused_ce(flag: str, n_embd: int) -> bool:
     return jax.default_backend() == "tpu" and supported(n_embd)
 
 
+_warned_fallbacks: set = set()
+
+
+def fused_fallback_reason(e, tm, c, v, dtype, interpret=False,
+                          batch_mult=1):
+    """Why ``lm_nll_sums_fused`` would take the chunked path for this
+    geometry — None when the fused kernels engage.
+
+    ``batch_mult`` is the caller's vmapped multiplicity (the round's
+    client axis): the dX-partials buffer exists once PER mapped call
+    concurrently, so the OOM guard must scale by it — 8 clients x
+    315 MB must not pass a 512 MB per-call check."""
+    if not supported(c):
+        return (f"embedding width {c} is not lane-aligned / "
+                "VMEM-admissible")
+    _, mp, _, _, nv = _tile_geometry(e * tm, v, _BLOCK_M, _BLOCK_V)
+    dxp_bytes = max(1, int(batch_mult)) * nv * mp * c \
+        * jnp.dtype(dtype).itemsize
+    if dxp_bytes > _DXP_LIMIT:
+        return (f"dX partials would be {dxp_bytes >> 20} MB "
+                f"(x{max(1, int(batch_mult))} vmapped calls) — over "
+                f"the {_DXP_LIMIT >> 20} MB cap")
+    if not interpret and jax.default_backend() != "tpu":
+        return (f"default backend is {jax.default_backend()!r}, "
+                "not tpu (Mosaic kernels cannot lower)")
+    return None
+
+
 def lm_nll_sums_fused(h, wte, labels, dtype, ignore_index=-100,
-                      tokens_per_chunk=1024, interpret=False):
+                      tokens_per_chunk=1024, interpret=False,
+                      batch_mult=1):
     """Drop-in for models.gpt2.lm_nll_sums_chunked backed by the
     fused kernels: per-example (Σ nll, Σ valid) of the tied-head LM
     cross-entropy, logits never materialised even per chunk. Falls
     back to the chunked path (honoring ``tokens_per_chunk``) at
-    non-lane-aligned widths and — unless ``interpret`` — on non-TPU
-    default backends, where the Mosaic kernels cannot lower."""
+    non-lane-aligned widths, when the backward's dX partials would
+    exceed _DXP_LIMIT across ``batch_mult`` concurrent vmapped calls,
+    and — unless ``interpret`` — on non-TPU default backends, where
+    the Mosaic kernels cannot lower. The fallback warns once per
+    reason: it used to be silent, so flce_bench could 'measure' the
+    chunked path against itself."""
     e, tm, c = h.shape
-    bm, mp, vp, _, nv = _tile_geometry(
-        e * tm, wte.shape[0], _BLOCK_M, _BLOCK_V)
-    dxp_bytes = nv * mp * c * jnp.dtype(dtype).itemsize
-    if (not supported(c) or dxp_bytes > _DXP_LIMIT
-            or (not interpret and jax.default_backend() != "tpu")):
+    reason = fused_fallback_reason(e, tm, c, wte.shape[0], dtype,
+                                   interpret=interpret,
+                                   batch_mult=batch_mult)
+    if reason is not None:
+        if reason not in _warned_fallbacks:
+            _warned_fallbacks.add(reason)
+            import warnings
+            warnings.warn("lm_nll_sums_fused falling back to the "
+                          "chunked path: " + reason)
         from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
         return lm_nll_sums_chunked(h, wte, labels, dtype,
                                    ignore_index=ignore_index,

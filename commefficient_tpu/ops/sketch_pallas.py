@@ -58,11 +58,17 @@ _TABLE_VMEM_LIMIT = 20 * 1024 * 1024
 _VMEM_CEILING = 64 * 1024 * 1024
 
 
+# the params class was renamed TPUCompilerParams -> CompilerParams
+# across JAX releases; accept either
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
+
 def _compiler_params(table_bytes: int):
     # table resident + r per-chunk temp rows (~table again) + double-
     # buffered chunk blocks + relayout scratch, with margin
     want = min(_VMEM_CEILING, max(32 * 1024 * 1024, 3 * table_bytes))
-    return pltpu.CompilerParams(vmem_limit_bytes=want)
+    return _CompilerParams(vmem_limit_bytes=want)
 
 
 def _pick_lanes(c: int) -> int | None:

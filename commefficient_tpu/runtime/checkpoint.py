@@ -40,6 +40,25 @@ def checkpoint_file(directory: str, tag: str = "state") -> str:
     return os.path.join(directory, f"ckpt_{tag}.npz")
 
 
+def _shard_file(path: str, process_index: int) -> str:
+    """Side file holding a non-zero process's client-store shard."""
+    return f"{path}.shard{int(process_index)}.npz"
+
+
+def _atomic_savez(path: str, **arrays):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
 def save_checkpoint(path: str, model, opt, scheduler=None,
                     sampler=None, epoch: int = 0,
                     extra: Optional[dict] = None,
@@ -56,6 +75,11 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
     # — process_allgather (a collective every process must reach)
     # reassembles the global rows; replicated arrays pass through
     from commefficient_tpu.runtime.fed_model import _host
+
+    if getattr(model, "client_store", None) is not None:
+        # host client store: land any round still awaiting write-back
+        # so the store snapshot below is complete
+        model._store_writeback()
 
     arrays = {"ps_weights": _host(model.ps_weights)}
     cs = model.client_states
@@ -98,6 +122,22 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         # platform — so resume validates the resolved value
         from commefficient_tpu.core.rounds import resolve_rot_lanes
         meta["rot_lanes"] = int(resolve_rot_lanes(model.args))
+    store = getattr(model, "client_store", None)
+    if store is not None:
+        # sparse store snapshot: only the rows clients actually wrote
+        # (plus each field's init row, so never-seen clients replay the
+        # ORIGINAL run's init on resume). Process 0's shard rides in
+        # the main archive; every other process writes its own side
+        # file next to it (its rows are not addressable from here).
+        meta["clientstore"] = {"fields": list(store.field_names),
+                               "processes": int(jax.process_count())}
+        shard = store.export_shard()
+        if jax.process_index() == 0:
+            for k, v in shard.items():
+                arrays["store:" + k] = v
+        else:
+            _atomic_savez(_shard_file(path, jax.process_index()),
+                          **shard)
     if scheduler is not None:
         meta["scheduler_step"] = int(scheduler._step)
     if sampler is not None and hasattr(sampler.rng, "get_state"):
@@ -205,16 +245,25 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
         # the set of client-state buffers is determined by the config
         # (local momentum / local error / topk_down) — a presence
         # mismatch means the hyperparameters changed, and silently
-        # keeping fresh zeros would diverge from the saved trajectory
-        cs_now = model.client_states
-        for name, val in (("cs_velocities", cs_now.velocities),
-                          ("cs_errors", cs_now.errors),
-                          ("cs_weights", cs_now.weights)):
-            if (name in z.files) != (val is not None):
+        # keeping fresh zeros would diverge from the saved trajectory.
+        # Derived from the CONFIG (not model.client_states) so it
+        # holds for both placements: a host-store run keeps the device
+        # arrays None and records its fields in meta instead.
+        ck_store = meta.get("clientstore")
+        ck_fields = set((ck_store or {}).get("fields", []))
+        uses = {
+            "velocities": model.args.local_momentum > 0,
+            "errors": model.args.error_type == "local",
+            "weights": bool(getattr(model.args, "do_topk_down",
+                                    False)),
+        }
+        for field, used in uses.items():
+            has = ("cs_" + field in z.files) or (field in ck_fields)
+            if has != used:
                 raise ValueError(
-                    f"checkpoint {'has' if name in z.files else 'lacks'}"
-                    f" {name} but this run "
-                    f"{'does not use' if val is None else 'needs'} it "
+                    f"checkpoint {'has' if has else 'lacks'} "
+                    f"client {field} but this run "
+                    f"{'does not use' if not used else 'needs'} them "
                     "— momentum/error/topk_down flags differ")
 
         import jax.numpy as jnp
@@ -243,15 +292,71 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
             return jax.device_put(arr, csh)
 
         model.ps_weights = jnp.asarray(z["ps_weights"])
-        cs = model.client_states
-        model.client_states = ClientStates(
-            put_client_rows(z["cs_velocities"])
-            if "cs_velocities" in z else cs.velocities,
-            put_client_rows(z["cs_errors"])
-            if "cs_errors" in z else cs.errors,
-            put_client_rows(z["cs_weights"])
-            if "cs_weights" in z else cs.weights,
-        )
+        store = getattr(model, "client_store", None)
+        if store is not None:
+            # this run keeps client state in the host store
+            if ck_store is not None:
+                if int(ck_store.get("processes", 1)) != \
+                        jax.process_count():
+                    raise ValueError(
+                        "clientstore checkpoint written by "
+                        f"{ck_store.get('processes')} processes; this "
+                        f"run has {jax.process_count()} — shard "
+                        "ownership would not line up")
+                if jax.process_index() == 0:
+                    shard = {k[len("store:"):]: np.asarray(z[k])
+                             for k in z.files if k.startswith("store:")}
+                else:
+                    with np.load(_shard_file(path, jax.process_index()),
+                                 allow_pickle=False) as sz:
+                        shard = {k: np.asarray(sz[k])
+                                 for k in sz.files}
+                store.import_shard(shard)
+            else:
+                # dense (device-placement) checkpoint: import every
+                # client's row into the store
+                nc0 = int(model.num_clients)
+                shard = {"ids": np.arange(nc0, dtype=np.int64)}
+                for field in store.field_names:
+                    shard[field] = np.asarray(z["cs_" + field])[:nc0]
+                store.import_shard(shard)
+            model.client_states = ClientStates(None, None, None)
+        elif ck_fields:
+            # host-store checkpoint into a device-placement run:
+            # densify each shard over the init rows
+            if int(ck_store.get("processes", 1)) != 1:
+                raise ValueError(
+                    "cannot densify a multi-process clientstore "
+                    "checkpoint into device placement")
+
+            def densify(field):
+                if field not in ck_fields:
+                    return None
+                ids = np.asarray(z["store:ids"], np.int64)
+                rows_f = np.asarray(z["store:" + field])
+                shape = (int(model.num_clients),) + rows_f.shape[1:]
+                init_key = "store:init:" + field
+                if init_key in z.files:
+                    base = np.broadcast_to(np.asarray(z[init_key]),
+                                           shape).copy()
+                else:
+                    base = np.zeros(shape, np.float32)
+                base[ids] = rows_f
+                return put_client_rows(base)
+
+            model.client_states = ClientStates(densify("velocities"),
+                                               densify("errors"),
+                                               densify("weights"))
+        else:
+            cs = model.client_states
+            model.client_states = ClientStates(
+                put_client_rows(z["cs_velocities"])
+                if "cs_velocities" in z else cs.velocities,
+                put_client_rows(z["cs_errors"])
+                if "cs_errors" in z else cs.errors,
+                put_client_rows(z["cs_weights"])
+                if "cs_weights" in z else cs.weights,
+            )
         opt.server_state = ServerState(jnp.asarray(z["ss_Vvelocity"]),
                                        jnp.asarray(z["ss_Verror"]))
         model.last_updated = np.asarray(z["last_updated"])

@@ -35,6 +35,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from commefficient_tpu.clientstore import (HostClientStore,
+                                           StorePrefetcher,
+                                           resolve_clientstore,
+                                           shard_range, state_fields)
 from commefficient_tpu.config import Config, NATURAL_NUM_CLIENTS
 from commefficient_tpu.core.rounds import (ClientStates,
                                            build_client_round,
@@ -121,11 +125,44 @@ class FedModel:
         self.num_clients = num_clients
 
         self.ps_weights = flat
-        # big per-client buffers created directly sharded over the
-        # client axis, row-padded to the mesh size — never
-        # materialised replicated (see ClientStates.init)
-        self.client_states = ClientStates.init(
-            args, num_clients, flat, sharding=client_sharding(self.mesh))
+        # per-client state placement (commefficient_tpu/clientstore):
+        # device = dense (num_clients, ...) HBM arrays (below); host =
+        # budgeted host arena + mmap spill, with only the round's W
+        # participant rows materialised on device (gather -> H2D ->
+        # round -> D2H -> write-back)
+        self.clientstore = resolve_clientstore(args, num_clients)
+        self.client_store = None
+        self._prefetcher = None
+        self._participant_feed = None
+        self._store_pending = None
+        if self.clientstore == "host":
+            if int(getattr(args, "pipeline_depth", 1)) > 1:
+                raise ValueError(
+                    "--clientstore host requires --pipeline_depth 1: "
+                    "round N's write-back must land before round "
+                    "N+1's gather reads the store")
+            fields = state_fields(
+                args, init_weights=(np.asarray(flat)
+                                    if getattr(args, "do_topk_down",
+                                               False) else None))
+            self.client_store = HostClientStore(
+                num_clients, fields,
+                budget_bytes=args.clientstore_bytes,
+                spill_dir=(args.clientstore_dir or None),
+                owned=shard_range(num_clients))
+            self.client_states = ClientStates(None, None, None)
+            # gather/H2D overlap thread: single-process only — the
+            # multi-host row exchange is a collective and must stay on
+            # the main thread
+            if fields and jax.process_count() == 1:
+                self._prefetcher = StorePrefetcher(self.client_store)
+        else:
+            # big per-client buffers created directly sharded over the
+            # client axis, row-padded to the mesh size — never
+            # materialised replicated (see ClientStates.init)
+            self.client_states = ClientStates.init(
+                args, num_clients, flat,
+                sharding=client_sharding(self.mesh))
 
         if padded_batch_size is None:
             padded_batch_size = (args.local_batch_size
@@ -156,7 +193,8 @@ class FedModel:
             build_client_round(args, None, padded_batch_size,
                                mesh=self.mesh, stats_fn=stats_fn_flat,
                                tree_loss=loss_tree,
-                               unravel=self.unravel),
+                               unravel=self.unravel,
+                               dense_rows=(self.clientstore == "host")),
             donate_argnums=(1,))
         if stats_fn is not None:
             self._val_fn = jax.jit(build_val_fn(
@@ -209,9 +247,84 @@ class FedModel:
                 else self._call_val(batch))
 
     def finalize(self):
-        """Shutdown protocol parity (fed_aggregator.py:197-204); no
-        worker processes exist, so this is a barrier only."""
+        """Shutdown protocol parity (fed_aggregator.py:197-204): a
+        device barrier, plus host client-store teardown (prefetch
+        thread join, final write-back, spill-file removal)."""
         jax.block_until_ready(self.ps_weights)
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        self._store_writeback()
+        if self.client_store is not None:
+            self.client_store.close()
+            self.client_store = None
+
+    # --- host client store (commefficient_tpu/clientstore) ---------------
+
+    def attach_participant_feed(self, feed: Callable):
+        """``feed() -> next round's participant client ids (or None)``
+        — wires the sampler's one-round lookahead
+        (data/fed_sampler.py peek_next_client_ids) into the prefetch
+        thread so round N+1's gather/H2D overlaps round N's compute."""
+        self._participant_feed = feed
+
+    def _gather_rows(self, ids_np):
+        """Host-side rows for this round's participants, prefetched
+        when the lookahead predicted them, synchronous otherwise."""
+        ids64 = np.asarray(ids_np, np.int64)
+        rows = None
+        if self._prefetcher is not None:
+            rows = self._prefetcher.take(ids64)
+        if rows is None:
+            rows, _ = self.client_store.gather(ids64)
+        if jax.process_count() > 1 and rows:
+            # every process contributed its owned rows (zeros
+            # elsewhere): one allgather-sum rebuilds each participant
+            # row everywhere. Main thread only — it's a collective.
+            from jax.experimental import multihost_utils
+            rows = {k: np.asarray(multihost_utils.process_allgather(
+                        v, tiled=False)).sum(axis=0, dtype=np.float32)
+                    for k, v in rows.items()}
+        return rows
+
+    def _rows_to_states(self, rows) -> ClientStates:
+        def put(name):
+            v = rows.get(name)
+            return (None if v is None
+                    else shard_batch(self.mesh, jnp.asarray(v)))
+
+        return ClientStates(put("velocities"), put("errors"),
+                            put("weights"))
+
+    def _submit_prefetch(self):
+        if self._prefetcher is None or self._participant_feed is None:
+            return
+        ids = self._participant_feed()
+        if ids is not None:
+            self._prefetcher.submit(np.asarray(ids, np.int64))
+
+    def _store_writeback(self):
+        """D2H the pending round's updated participant rows into the
+        store. Runs from FedOptimizer.step (after the server round's
+        velocity rewrite, so true_topk's momentum-factor masking is
+        captured), and defensively before the next gather, at
+        checkpoint save and at shutdown. Dead slots (dropout/padding)
+        are excluded, matching the device path's dropped scatters."""
+        if self.client_store is None or self._store_pending is None:
+            return
+        ids_np, alive = self._store_pending
+        self._store_pending = None
+        cs = self.client_states
+        self.client_states = ClientStates(None, None, None)
+        rows = {}
+        for name, val in (("velocities", cs.velocities),
+                          ("errors", cs.errors),
+                          ("weights", cs.weights)):
+            if val is not None:
+                rows[name] = np.asarray(_host(val), np.float32)
+        if rows and alive.any():
+            self.client_store.write(
+                ids_np[alive], {k: v[alive] for k, v in rows.items()})
 
     def params(self):
         """Current weights as the module's pytree (the reference's
@@ -295,7 +408,13 @@ class FedModel:
         ids = jax.device_put(jnp.asarray(ids_np, jnp.int32))
 
         rng = jax.random.fold_in(self._rng, self.round_index)
-        res = self._client_round(self.ps_weights, self.client_states,
+        cs_in = self.client_states
+        if self.client_store is not None:
+            # normally a no-op: opt.step() already wrote round N-1's
+            # rows back; covers trainers that skip the server step
+            self._store_writeback()
+            cs_in = self._rows_to_states(self._gather_rows(ids_np))
+        res = self._client_round(self.ps_weights, cs_in,
                                  dev_batch, ids, rng,
                                  jnp.float32(self.fedavg_lr))
         self.client_states = res.client_states
@@ -307,7 +426,19 @@ class FedModel:
         # client-side state (core/rounds.py _state_ids; regression
         # found by tests/test_fuzz_modes.py)
         from commefficient_tpu.core.rounds import _state_ids
-        self.pending_client_ids = _state_ids(ids, dev_batch)
+        if self.client_store is not None:
+            # host mode: state rows are positional (dense_rows), so the
+            # server round's velocity scatter needs slot positions —
+            # dead slots keep the sentinel either way
+            W = ids_np.shape[0]
+            self.pending_client_ids = _state_ids(
+                jnp.arange(W, dtype=jnp.int32), dev_batch)
+            alive = np.asarray(batch["mask"]).reshape(W, -1) \
+                .sum(axis=1) > 0
+            self._store_pending = (np.asarray(ids_np, np.int64), alive)
+            self._submit_prefetch()
+        else:
+            self.pending_client_ids = _state_ids(ids, dev_batch)
         self.round_index += 1
         if res.bn_stats is not None:
             # running-stats blend (torch BN momentum 0.1); a fully
@@ -544,6 +675,9 @@ class FedOptimizer:
             m.client_states = m.client_states._replace(
                 velocities=new_vel)
         m.pending_aggregated = None
+        # host client store: the round's participant rows (incl. any
+        # server-side velocity rewrite above) go back to the host now
+        m._store_writeback()
         if support is None:
             # dense-update modes. fedavg/momentum updates touch every
             # coordinate; the exceptions that don't: a zero scalar LR

@@ -490,6 +490,11 @@ def main(argv=None):
                      compute_loss_val=loss_val,
                      padded_batch_size=train_loader.B,
                      stats_fn=stats_fn, init_model_state=init_stats)
+    if hasattr(train_loader, "peek_next_client_ids"):
+        # host client store: the loader's one-round lookahead feeds
+        # the prefetch thread (no-op under --clientstore device)
+        model.attach_participant_feed(
+            train_loader.peek_next_client_ids)
 
     if args.model.startswith("Fixup") and args.mode != "fedavg":
         # Fixup LR groups (reference cv_train.py:366-376): bias and

@@ -40,7 +40,7 @@ MAX_SEQ_LEN = 256  # static pad length (persona sequences are short)
 
 
 def _lm_nll_sums(module, params, batch, tokens_per_chunk=0,
-                 fused=False):
+                 fused=False, batch_mult=1):
     """Shared forward for the train and val losses: hidden states +
     MC logits from the module, then the tied-head cross-entropy — the
     (tokens, vocab) logits tensor never materialises: chunked
@@ -60,10 +60,13 @@ def _lm_nll_sums(module, params, batch, tokens_per_chunk=0,
     labels = batch["lm_labels"].reshape(B * N, T)
     if fused:
         from commefficient_tpu.ops.flce_pallas import lm_nll_sums_fused
+        # batch_mult: this runs under the round's per-client vmap, so
+        # the kernel's dX-partials OOM guard must see the vmapped
+        # multiplicity — the buffer exists once PER CLIENT concurrently
         sn, sv = lm_nll_sums_fused(h[:, :-1], wte, labels[:, 1:],
                                    module.cfg.dtype, ignore_index=-1,
                                    tokens_per_chunk=tokens_per_chunk
-                                   or 1024)
+                                   or 1024, batch_mult=batch_mult)
     else:
         sn, sv = lm_nll_sums_chunked(h[:, :-1], wte, labels[:, 1:],
                                      module.cfg.dtype, ignore_index=-1,
@@ -101,7 +104,8 @@ def make_compute_loss_train(module, args):
         sn, sv, mc_logits, B, N = _lm_nll_sums(
             module, params, batch,
             getattr(args, "tokens_per_chunk", 0),
-            fused=_resolve_fused(args, module))
+            fused=_resolve_fused(args, module),
+            batch_mult=max(1, getattr(args, "num_workers", 1)))
         lm_i = sn.reshape(B, N).sum(1) \
             / jnp.maximum(sv.reshape(B, N).sum(1), 1.0)
 
@@ -125,10 +129,13 @@ def make_compute_loss_val(module, args):
     (B, N, T, V) logits tensor would be ~8 GB per val shard at the
     natural PersonaChat candidate count."""
     def compute_loss(params, batch, cfg):
+        # val shards run under a vmap over shards_per_step =
+        # max(1, num_workers) (get_data_loaders) — same multiplicity
         sn, sv, mc_logits, B, N = _lm_nll_sums(
             module, params, batch,
             getattr(args, "tokens_per_chunk", 0),
-            fused=_resolve_fused(args, module))
+            fused=_resolve_fused(args, module),
+            batch_mult=max(1, getattr(args, "num_workers", 1)))
         m = batch["mask"]
         w = jnp.broadcast_to(m[:, None], (B, N)).reshape(B * N)
         nll = jnp.sum(sn * w) / jnp.maximum(jnp.sum(sv * w), 1.0)
@@ -388,6 +395,11 @@ def main(argv=None):
                          compute_loss_val=make_compute_loss_val(module,
                                                                 args),
                          padded_batch_size=train_loader.B)
+    if hasattr(model, "attach_participant_feed") \
+            and hasattr(train_loader, "peek_next_client_ids"):
+        # host client store: one-round lookahead feeds the prefetcher
+        model.attach_participant_feed(
+            train_loader.peek_next_client_ids)
     opt = FedOptimizer([{"lr": 1.0}], args)
 
     spe = steps_per_epoch(args.local_batch_size, train_ds,

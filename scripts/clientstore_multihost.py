@@ -1,0 +1,226 @@
+"""Two-process client-store smoke: shard-per-process ownership.
+
+Each worker joins the JAX multi-controller runtime (2 localhost CPU
+processes x 2 devices), builds a ``--clientstore host`` FedModel over
+a tiny linear task, and drives deterministic rounds whose participants
+span both processes' shards. Asserted in-worker:
+
+- ``shard_range`` gives each process its contiguous client-id block;
+- the store only ever persists rows it owns (``written_ids`` stays
+  inside the shard), while the cross-process allgather-sum rebuilds
+  every participant row identically on both processes;
+- the host placement's weight trajectory is bit-identical to a
+  device-placement run on the same spanning mesh;
+- a checkpoint written through the store (process 0's shard in the
+  main archive, process 1's in a ``.shard1.npz`` side file) resumes
+  bit-exactly.
+
+The launcher parses per-worker result lines and prints
+``CLIENTSTORE_MULTIHOST_OK`` only if both workers exit 0 and agree.
+
+Usage:  python scripts/clientstore_multihost.py
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+DEVICES_PER_PROC = 2
+NC = 10   # population; shard per process: [0,5) / [5,10)
+W = 4     # participants per round == total devices
+B = 2
+D = 5
+
+
+def worker(args):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.clientstore import shard_range
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.parallel.mesh import initialize_multihost
+    from commefficient_tpu.runtime.checkpoint import (load_checkpoint,
+                                                      save_checkpoint)
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+
+    initialize_multihost(args.coordinator, args.num_processes,
+                         args.process_id)
+    assert jax.process_index() == args.process_id
+    assert jax.device_count() == DEVICES_PER_PROC * args.num_processes
+
+    lo, hi = shard_range(NC)
+    assert (lo, hi) == ((0, 5) if args.process_id == 0 else (5, 10)), \
+        (lo, hi)
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    def cfg(placement):
+        return Config(mode="local_topk", error_type="local",
+                      local_momentum=0.9, virtual_momentum=0.0,
+                      k=3, num_workers=W, local_batch_size=B,
+                      num_clients=NC, seed=9, clientstore=placement,
+                      clientstore_bytes=1 << 16)
+
+    def build(placement):
+        model = FedModel(None, {"w": jnp.zeros((D,), jnp.float32)},
+                         loss, cfg(placement), padded_batch_size=B)
+        return model, FedOptimizer([{"lr": 0.25}], model.args,
+                                   model=model)
+
+    # deterministic rounds, same on both processes; participants drawn
+    # from the full population so every round crosses both shards
+    rng = np.random.RandomState(3)
+    rounds = []
+    for _ in range(4):
+        ids = rng.choice(NC, W, replace=False).astype(np.int32)
+        rounds.append((ids, rng.randn(W, B, D).astype(np.float32),
+                       rng.randn(W, B).astype(np.float32)))
+
+    def drive(model, opt, rnds):
+        traj = []
+        for ids, x, y in rnds:
+            batch = {"client_ids": ids, "x": jnp.asarray(x),
+                     "y": jnp.asarray(y),
+                     "mask": jnp.ones((W, B), jnp.float32)}
+            model(batch)
+            opt.step()
+            traj.append(np.asarray(model.ps_weights, np.float64))
+        return traj
+
+    # (1) host placement across the 2-process mesh
+    mh, oh = build("host")
+    assert mh.client_store.owned == (lo, hi)
+    assert mh._prefetcher is None  # collectives stay on main thread
+    traj_h = drive(mh, oh, rounds)
+
+    written = mh.client_store.written_ids()
+    participants = {int(c) for ids, _, _ in rounds for c in ids}
+    owned_participants = {c for c in participants if lo <= c < hi}
+    assert set(written) == owned_participants, \
+        (sorted(written), sorted(owned_participants))
+
+    # the allgather-sum exchange rebuilds the same full rows everywhere
+    rows = mh._gather_rows(np.arange(NC, dtype=np.int64))
+    row_sum = float(sum(np.abs(v).sum() for v in rows.values()))
+    assert row_sum > 0
+
+    # (2) device placement on the same spanning mesh: bit-identical
+    md, od = build("device")
+    traj_d = drive(md, od, rounds)
+    for r, (a, b) in enumerate(zip(traj_h, traj_d)):
+        np.testing.assert_array_equal(a, b, err_msg=f"round {r}")
+
+    # (3) checkpoint through the store: main archive + side shard file
+    shared = os.environ["CS_SHARED_DIR"]
+    path = os.path.join(shared, "ck.npz")
+    m1, o1 = build("host")
+    drive(m1, o1, rounds[:3])
+    save_checkpoint(path, m1, o1, epoch=1)
+    m1.finalize()
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".shard1.npz")
+    m2, o2 = build("host")
+    load_checkpoint(path, m2, o2)
+    traj_r = drive(m2, o2, rounds[3:])
+    np.testing.assert_array_equal(traj_h[-1], traj_r[-1])
+    m2.finalize()
+    mh.finalize()
+
+    print(f"WORKER{args.process_id}_CS "
+          f"{traj_h[-1].sum():.12f}/{row_sum:.12f}", flush=True)
+
+
+def launcher():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    shared_dir = tempfile.mkdtemp(prefix="clientstore_mh_")
+    procs, logs = [], []
+    for i in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count="
+                      f"{DEVICES_PER_PROC}",
+            PYTHONPATH=repo_root + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+            CS_SHARED_DIR=shared_dir,
+        )
+        log = tempfile.TemporaryFile(mode="w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--process_id", str(i), "--num_processes", "2",
+             "--coordinator", f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT))
+    import time
+    deadline = time.time() + 900
+    pending = set(range(2))
+    failed = False
+    while pending and time.time() < deadline:
+        for i in list(pending):
+            rc = procs[i].poll()
+            if rc is not None:
+                pending.discard(i)
+                failed = failed or rc != 0
+        if failed:
+            break
+        time.sleep(0.5)
+    for i in pending:
+        procs[i].kill()
+    outs = []
+    for p, log in zip(procs, logs):
+        p.wait(timeout=60)
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    import shutil
+    shutil.rmtree(shared_dir, ignore_errors=True)
+    codes = [p.returncode for p in procs]
+    if any("Multiprocess computations aren't implemented" in out
+           for out in outs):
+        # this jaxlib's CPU backend cannot run cross-process
+        # computations at all (same limitation hits
+        # scripts/multihost_smoke.py) — report an explicit SKIP so the
+        # test tier can distinguish "environment can't" from "broken"
+        print("CLIENTSTORE_MULTIHOST_SKIP "
+              "(CPU backend lacks multiprocess computations)")
+        sys.exit(3)
+    vals = []
+    for i, out in enumerate(outs):
+        for line in out.splitlines():
+            if line.startswith(f"WORKER{i}_CS "):
+                vals.append(line.split()[1])
+    if codes != [0, 0] or len(vals) != 2:
+        for i, out in enumerate(outs):
+            sys.stderr.write(f"--- worker {i} (exit {codes[i]}) ---\n")
+            sys.stderr.write(out[-4000:] + "\n")
+        sys.exit(1)
+    assert vals[0] == vals[1], f"processes disagree: {vals}"
+    print(f"CLIENTSTORE_MULTIHOST_OK {vals[0]}")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process_id", type=int, default=None)
+    ap.add_argument("--num_processes", type=int, default=2)
+    ap.add_argument("--coordinator", type=str, default=None)
+    args = ap.parse_args()
+    if args.process_id is None:
+        launcher()
+    else:
+        worker(args)

@@ -38,13 +38,32 @@ def main():
     ap.add_argument("--iters", type=int, default=20,
                     help="op pairs per timed call (amortizes "
                     "dispatch through the relay)")
+    ap.add_argument("--allow_fallback", action="store_true",
+                    help="bench even when the fused path cannot "
+                    "engage (the 'fused' column is then the chunked "
+                    "fallback — reported, not asserted)")
     args = ap.parse_args()
 
     from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
-    from commefficient_tpu.ops.flce_pallas import lm_nll_sums_fused
+    from commefficient_tpu.ops.flce_pallas import (fused_fallback_reason,
+                                                   lm_nll_sums_fused)
 
     W, E, Tm, C, V = (args.clients, args.examples, args.tokens,
                       args.width, args.vocab)
+
+    # the fused timing below is meaningless if lm_nll_sums_fused is
+    # silently taking the chunked fallback (it used to: any off-TPU
+    # run "measured" the chunked path against itself) — refuse unless
+    # told otherwise. batch_mult = W: the bench vmaps the client axis
+    # exactly like the federated round.
+    reason = fused_fallback_reason(E, Tm, C, V, jnp.bfloat16,
+                                   batch_mult=W)
+    if reason is not None and not args.allow_fallback:
+        print(json.dumps({"error": "fused path would not engage: "
+                          + reason,
+                          "hint": "pass --allow_fallback to bench "
+                          "the fallback anyway"}), file=sys.stderr)
+        sys.exit(2)
     rng = np.random.RandomState(0)
     h = jnp.asarray(rng.randn(W, E, Tm, C) * 0.02, jnp.float32)
     w = jnp.asarray(rng.randn(V, C) * 0.02, jnp.float32)
@@ -81,7 +100,7 @@ def main():
 
     chunk_ms = bench(lm_nll_sums_chunked,
                      {"tokens_per_chunk": args.tokens_per_chunk})
-    fused_ms = bench(lm_nll_sums_fused, {})
+    fused_ms = bench(lm_nll_sums_fused, {"batch_mult": W})
     print(json.dumps({
         "geometry": {"clients": W, "examples": E, "tokens": Tm,
                      "width": C, "vocab": V,
@@ -89,6 +108,8 @@ def main():
         "chunked_ms_per_pair": round(chunk_ms, 3),
         "fused_ms_per_pair": round(fused_ms, 3),
         "speedup": round(chunk_ms / fused_ms, 3),
+        "fused_path_engaged": reason is None,
+        "fallback_reason": reason,
         "backend": jax.default_backend(),
     }))
 

@@ -10,12 +10,22 @@ cumsum sizes, fd behavior) and times:
   dataset construction, item access rate, FedSampler round rate
 - FEMNIST: LEAF json parse + packed-memmap write (prepare_datasets),
   item access rate
+- clientstore: the host-resident client-state store
+  (commefficient_tpu/clientstore) — per-round throughput vs the dense
+  device placement at a matched moderate population, plus the scale
+  axis the device placement cannot reach: local_topk/fedavg rounds at
+  --store_scale_clients (default 1M) simulated clients under a FIXED
+  --store_budget_mb arena, reporting ``clients_resident_max_local_topk``
+  (peak arena rows — the store's working set, independent of the
+  population).
 
 Usage:  python scripts/host_scale_bench.py [--persona_clients 17568]
         [--emnist_writers 3500] [--emnist_images 20] [--workdir DIR]
+        [--only all|persona|emnist|clientstore]
+        [--store_scale_clients 1000000] [--store_budget_mb 4]
 
 Results are recorded in BENCHMARKS.md ("Host data-plane at natural
-scale").
+scale" and "Host client store").
 """
 
 import argparse
@@ -26,6 +36,9 @@ import shutil
 import sys
 import tempfile
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def bench_persona(root, num_clients):
@@ -140,21 +153,130 @@ def bench_emnist(root, writers, images_per_writer):
     }
 
 
+def bench_clientstore(matched_clients, scale_clients, budget_bytes,
+                      n_rounds, dim):
+    """Client-state placement A/B + the host-only scale axis.
+
+    Matched population: identical deterministic local_topk rounds
+    through the dense device placement and the host store — the store
+    path's per-round overhead (host gather + H2D + D2H + write-back)
+    is the delta. Scale population: host-only (the device placement
+    would need the full (N, 2*dim) f32 state resident in HBM),
+    local_topk AND fedavg, under the fixed arena budget.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+
+    W, B = 8, 2
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    def run(placement, num_clients, **mode_kw):
+        base = dict(mode="local_topk", error_type="local",
+                    local_momentum=0.9, virtual_momentum=0.0, k=8,
+                    num_workers=W, local_batch_size=B,
+                    num_clients=num_clients, seed=0,
+                    clientstore=placement,
+                    clientstore_bytes=budget_bytes)
+        base.update(mode_kw)
+        cfg = Config(**base)
+        model = FedModel(None, {"w": jnp.zeros((dim,), jnp.float32)},
+                         loss, cfg, padded_batch_size=B)
+        opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+        rng = np.random.RandomState(1)
+        ids_all = [rng.choice(num_clients, W, replace=False)
+                   .astype(np.int32) for _ in range(n_rounds + 1)]
+        model.attach_participant_feed(
+            lambda: ids_all[model.round_index + 1]
+            if model.round_index + 1 < len(ids_all) else None)
+
+        def one_round(r):
+            batch = {"client_ids": ids_all[r],
+                     "x": jnp.asarray(rng.randn(W, B, dim), jnp.float32),
+                     "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+                     "mask": jnp.ones((W, B), jnp.float32)}
+            model(batch)
+            opt.step()
+
+        one_round(0)  # warmup: jit compile + first H2D
+        jax.block_until_ready(model.ps_weights)
+        t0 = time.time()
+        for r in range(1, n_rounds + 1):
+            one_round(r)
+        jax.block_until_ready(model.ps_weights)
+        dt = (time.time() - t0) / n_rounds
+        stats = (dict(model.client_store.stats)
+                 if model.client_store is not None else None)
+        model.finalize()
+        return dt, stats
+
+    out = {"clientstore_budget_bytes": int(budget_bytes),
+           "clientstore_state_dim": dim,
+           "clientstore_rounds": n_rounds,
+           "clientstore_backend": jax.default_backend()}
+
+    dev_s, _ = run("device", matched_clients)
+    host_s, _ = run("host", matched_clients)
+    out["clientstore_matched_clients"] = matched_clients
+    out["clientstore_device_round_ms"] = round(dev_s * 1e3, 2)
+    out["clientstore_host_round_ms"] = round(host_s * 1e3, 2)
+    out["clientstore_host_overhead_pct"] = round(
+        (host_s / dev_s - 1.0) * 100, 1)
+
+    lt_s, lt_stats = run("host", scale_clients)
+    fa_s, _ = run("host", scale_clients, mode="fedavg",
+                  error_type="none", local_momentum=0.0,
+                  local_batch_size=-1)
+    out["clientstore_scale_clients"] = scale_clients
+    out["clientstore_scale_local_topk_rounds_per_s"] = round(
+        1.0 / lt_s, 2)
+    out["clientstore_scale_fedavg_rounds_per_s"] = round(1.0 / fa_s, 2)
+    out["clients_resident_max_local_topk"] = int(
+        lt_stats["resident_rows_max"])
+    out["clientstore_scale_evictions"] = int(lt_stats["evictions"])
+    out["clientstore_scale_spill_rows"] = int(lt_stats["spill_rows"])
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--persona_clients", type=int, default=17568)
     ap.add_argument("--emnist_writers", type=int, default=3500)
     ap.add_argument("--emnist_images", type=int, default=20)
     ap.add_argument("--workdir", type=str, default=None)
+    ap.add_argument("--only", type=str, default="all",
+                    choices=("all", "persona", "emnist", "clientstore"))
+    ap.add_argument("--store_matched_clients", type=int, default=4096)
+    ap.add_argument("--store_scale_clients", type=int,
+                    default=1_000_000)
+    ap.add_argument("--store_budget_mb", type=int, default=4)
+    ap.add_argument("--store_rounds", type=int, default=20)
+    ap.add_argument("--store_dim", type=int, default=256)
     args = ap.parse_args()
 
     root = args.workdir or tempfile.mkdtemp(prefix="host_scale_")
     print(f"workdir: {root}", file=sys.stderr)
     out = {}
     try:
-        out.update(bench_persona(root, args.persona_clients))
-        out.update(bench_emnist(root, args.emnist_writers,
-                                args.emnist_images))
+        if args.only in ("all", "persona"):
+            out.update(bench_persona(root, args.persona_clients))
+        if args.only in ("all", "emnist"):
+            out.update(bench_emnist(root, args.emnist_writers,
+                                    args.emnist_images))
+        if args.only in ("all", "clientstore"):
+            out.update(bench_clientstore(
+                args.store_matched_clients, args.store_scale_clients,
+                args.store_budget_mb << 20, args.store_rounds,
+                args.store_dim))
     finally:
         if args.workdir is None:
             shutil.rmtree(root, ignore_errors=True)

@@ -33,3 +33,21 @@ def test_two_process_trainer_smoke():
         capture_output=True, text=True, timeout=1500)
     assert out.returncode == 0, out.stderr[-4000:]
     assert "MULTIHOST_OK" in out.stdout
+
+
+@pytest.mark.slow
+def test_two_process_clientstore_shards():
+    """Shard-per-process client store: ownership by client-id block,
+    allgather-sum row exchange, bit-equality with the device placement
+    on the spanning mesh, and the side-shard checkpoint round-trip
+    (see scripts/clientstore_multihost.py)."""
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "clientstore_multihost.py")
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(script)],
+        env=dict(os.environ), capture_output=True, text=True,
+        timeout=900)
+    if out.returncode == 3:
+        pytest.skip("CPU backend lacks multiprocess computations")
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "CLIENTSTORE_MULTIHOST_OK" in out.stdout
