@@ -16,8 +16,8 @@ the same config (the repo publishes no numbers — BASELINE.md; estimate
 derived from per-round fwd/bwd + CSVec cost at batch 8).
 """
 
+import argparse
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -26,10 +26,12 @@ import numpy as np
 from commefficient_tpu.config import Config
 from commefficient_tpu.core.rounds import (ClientStates,
                                            build_client_round,
-                                           build_server_round)
+                                           build_server_round,
+                                           round_plan)
 from commefficient_tpu.core.server import ServerState
 from commefficient_tpu.models import get_model
 from commefficient_tpu.ops.vec import flatten_params
+from commefficient_tpu.telemetry import clock
 from commefficient_tpu.train.cv_train import make_compute_loss
 
 BASELINE_CLIENTS_PER_SEC = 60.0  # est. reference single-A100 (see doc)
@@ -37,7 +39,12 @@ BASELINE_CLIENTS_PER_SEC = 60.0  # est. reference single-A100 (see doc)
 W, B, NUM_CLIENTS, ROUNDS = 8, 8, 100, 100
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ledger", type=str, default="",
+                    help="append the result as a telemetry JSONL bench "
+                         "record (the stdout line is unchanged)")
+    bench_args = ap.parse_args(argv)
     cfg = Config(mode="sketch", error_type="virtual", local_momentum=0.0,
                  virtual_momentum=0.9, weight_decay=5e-4,
                  num_workers=W, local_batch_size=B,
@@ -103,20 +110,38 @@ def main():
     # with ~±15% run-to-run variance, so a single draw is noisy
     times = []
     for _ in range(3):
-        t0 = time.perf_counter()
+        t0 = clock.tick()
         _, _, checksum = run_rounds(ps, ss)
         float(checksum)
-        times.append(time.perf_counter() - t0)
+        times.append(clock.tick() - t0)
     dt = sorted(times)[1]
 
     clients_per_sec = W * ROUNDS / dt
-    print(json.dumps({
+    line = {
         "metric": "client_updates_per_sec_resnet9_sketch",
         "value": round(clients_per_sec, 2),
         "unit": "clients/s",
         "vs_baseline": round(clients_per_sec / BASELINE_CLIENTS_PER_SEC,
                              3),
-    }))
+    }
+    # the stdout line is the harness contract — it stays exactly as-is;
+    # --ledger additionally appends schema-v1 records for
+    # scripts/telemetry_report.py
+    print(json.dumps(line))
+    if bench_args.ledger:
+        from commefficient_tpu.telemetry import (JSONLSink,
+                                                 make_bench_record,
+                                                 make_meta_record)
+        sink = JSONLSink(bench_args.ledger)
+        sink.write(make_meta_record(
+            bench="bench.py", rounds=ROUNDS, workers=W,
+            local_batch_size=B, plan=round_plan(cfg)))
+        sink.write(make_bench_record(
+            line["metric"], line["value"], line["unit"],
+            vs_baseline=line["vs_baseline"],
+            round_times_s=[round(t, 4) for t in times],
+            backend=jax.default_backend()))
+        sink.close()
 
 
 if __name__ == "__main__":

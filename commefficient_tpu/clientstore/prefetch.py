@@ -21,6 +21,8 @@ import threading
 
 import numpy as np
 
+from commefficient_tpu.telemetry import clock
+
 
 class StorePrefetcher:
     def __init__(self, store, name="clientstore-prefetch"):
@@ -33,29 +35,45 @@ class StorePrefetcher:
         self._buf_i = 0
         self.hits = 0
         self.misses = 0
+        # exception that killed the worker LOOP (vs a per-job gather
+        # error, which rides the done-queue): re-raised on the main
+        # thread at the next submit/take — the next round boundary —
+        # instead of the thread dying silently and every later take()
+        # stalling out its timeout
+        self._failure = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=name)
         self._thread.start()
 
     # ------------------------------------------------------------------
     def _run(self):
-        while not self._stop.is_set():
-            try:
-                job = self._jobs.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            if job is None:
-                return
-            ids, buf = job
-            try:
-                rows, version = self._store.gather(ids, out=buf)
-                self._done.put((ids, rows, version, None))
-            except BaseException as exc:  # surfaced by take()
-                self._done.put((ids, None, 0, exc))
+        try:
+            while not self._stop.is_set():
+                try:
+                    job = self._jobs.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if job is None:
+                    return
+                ids, buf = job
+                try:
+                    rows, version = self._store.gather(ids, out=buf)
+                    self._done.put((ids, rows, version, None))
+                except BaseException as exc:  # surfaced by take()
+                    self._done.put((ids, None, 0, exc))
+        except BaseException as exc:
+            self._failure = exc
+
+    def _check_failure(self):
+        if self._failure is not None:
+            raise RuntimeError(
+                "clientstore prefetch worker died; round state may be "
+                "stale") from self._failure
 
     # ------------------------------------------------------------------
     def submit(self, ids):
         """Stage an async gather for next round's participant ids."""
+        self._check_failure()
         if self._stop.is_set():
             return
         ids = np.array(ids, dtype=np.int64).reshape(-1)
@@ -72,12 +90,21 @@ class StorePrefetcher:
         job's version snapshot so the result is always current.
         """
         ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        deadline = clock.tick() + timeout
         while self._pending > 0:
+            self._check_failure()
             try:
+                # short poll, not one big blocking get: a dead worker
+                # must surface within ~0.1s, not after `timeout`
                 job_ids, rows, version, exc = self._done.get(
-                    timeout=timeout)
+                    timeout=0.1)
             except queue.Empty:
-                return None  # worker wedged: fall back synchronously
+                if not self._thread.is_alive():
+                    self._check_failure()
+                    return None  # worker exited cleanly (close())
+                if clock.tick() >= deadline:
+                    return None  # worker wedged: fall back sync
+                continue
             self._pending -= 1
             if exc is not None:
                 raise exc

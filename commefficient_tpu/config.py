@@ -255,6 +255,16 @@ class Config:
     clientstore_bytes: int = 1 << 30
     # spill-tier directory ("" = private temp dir, removed on exit)
     clientstore_dir: str = ""
+    # telemetry (commefficient_tpu/telemetry): path of the JSONL round
+    # ledger ("" = disabled — the no-op fast path costs nothing on the
+    # round hot loop). One schema-v1 record per training round: spans,
+    # comm bytes (identical to the accounting counters), prefetch
+    # hit/miss, compile events, memory watermarks. Render/diff with
+    # scripts/telemetry_report.py.
+    ledger: str = ""
+    # end-of-run console summary of the round ledger (per-span
+    # totals/means, byte totals) — works with or without --ledger
+    telemetry_console: bool = False
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -522,6 +532,14 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--clientstore_dir", type=str, default="",
                         help="client-store spill directory "
                         "(default: private temp dir)")
+    parser.add_argument("--ledger", type=str, default="",
+                        help="write one JSONL telemetry record per "
+                        "training round to this path (spans, comm "
+                        "bytes, memory watermarks; see "
+                        "scripts/telemetry_report.py)")
+    parser.add_argument("--telemetry_console", action="store_true",
+                        help="print an end-of-run summary of the "
+                        "round telemetry (span totals/means, bytes)")
 
     return parser
 

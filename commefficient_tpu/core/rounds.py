@@ -117,6 +117,56 @@ def resolve_rot_lanes(cfg: Config) -> int:
             if jax.default_backend() in ("tpu", "axon") else 0)
 
 
+def sketch_is_late(cfg: Config) -> bool:
+    """Sketch-mode fast path predicate: sketching after the local
+    dense sum (linearity) is legal whenever no per-client op touches
+    the table — i.e. absent ``max_grad_norm``'s per-sketch clip."""
+    return cfg.mode == "sketch" and cfg.max_grad_norm is None
+
+
+def fused_grad_eligible(cfg: Config) -> bool:
+    """Fused-gradient fast path predicate: the aggregated quantity is
+    exactly the gradient of the sample-weighted mean loss (one
+    backward, no (W, d) buffer) when no per-client transform touches
+    the gradient. Shared by ``build_client_round`` and
+    ``round_plan`` so the telemetry meta record cannot drift from the
+    program actually built."""
+    return (cfg.mode in ("sketch", "uncompressed", "true_topk")
+            and cfg.local_momentum == 0 and cfg.error_type != "local"
+            and not cfg.do_topk_down and not cfg.do_dp
+            and cfg.max_grad_norm is None and cfg.microbatch_size <= 0)
+
+
+def round_plan(cfg: Config) -> dict:
+    """Static description of the round program this Config builds —
+    which fast paths engage, what one client transmits, what the
+    geometry is. Logged once per run as the ledger's meta record
+    (telemetry/record.py) so a ledger is interpretable without the
+    launching command line."""
+    plan = {
+        "mode": cfg.mode,
+        "error_type": cfg.error_type,
+        "grad_size": int(cfg.grad_size),
+        "num_workers": int(cfg.num_workers),
+        "transmit_shape": list(cfg.transmit_shape),
+        "upload_floats_per_client": int(cfg.upload_floats_per_client),
+        "fused_grad": fused_grad_eligible(cfg),
+        "pipeline_depth": int(getattr(cfg, "pipeline_depth", 1)),
+        "client_chunk": int(getattr(cfg, "client_chunk", 0)),
+        "clientstore": getattr(cfg, "clientstore", "device"),
+    }
+    if cfg.mode == "sketch":
+        plan["sketch"] = {"rows": int(cfg.num_rows),
+                          "cols": int(cfg.num_cols),
+                          "blocks": int(cfg.num_blocks),
+                          "k": int(cfg.k),
+                          "late": sketch_is_late(cfg),
+                          "rot_lanes": resolve_rot_lanes(cfg)}
+    if cfg.mode in ("true_topk", "local_topk"):
+        plan["k"] = int(cfg.k)
+    return plan
+
+
 def args2sketch(cfg: Config) -> Optional[CountSketch]:
     """(reference fed_aggregator.py:466-469)"""
     if cfg.mode != "sketch":
@@ -165,7 +215,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             return tree_loss(unravel(p), b)
 
     sketch = args2sketch(cfg)
-    sketch_late = (cfg.mode == "sketch" and cfg.max_grad_norm is None)
+    sketch_late = sketch_is_late(cfg)
     # Fused-gradient fast path: when no per-client transform touches
     # the gradient (no local momentum/error, clip, DP, topk_down or
     # microbatching), the aggregated quantity is exactly the gradient
@@ -178,11 +228,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     # backward over its local clients and ONE psum crosses the ICI —
     # of (r, c) sketch tables in sketch mode (compressed traffic, the
     # FetchSGD linearity identity), of the dense gradient otherwise.
-    fused_grad = (
-        cfg.mode in ("sketch", "uncompressed", "true_topk")
-        and cfg.local_momentum == 0 and cfg.error_type != "local"
-        and not cfg.do_topk_down and not cfg.do_dp
-        and cfg.max_grad_norm is None and cfg.microbatch_size <= 0)
+    fused_grad = fused_grad_eligible(cfg)
     if cfg.mode == "fedavg":
         per_client = _build_fedavg_client_step(cfg, loss_fn,
                                                padded_batch_size)

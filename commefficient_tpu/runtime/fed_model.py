@@ -43,8 +43,9 @@ from commefficient_tpu.config import Config, NATURAL_NUM_CLIENTS
 from commefficient_tpu.core.rounds import (ClientStates,
                                            build_client_round,
                                            build_server_round,
-                                           build_val_fn)
+                                           build_val_fn, round_plan)
 from commefficient_tpu.core.server import ServerState
+from commefficient_tpu.telemetry import build_telemetry
 from commefficient_tpu.ops.vec import flatten_params
 from commefficient_tpu.parallel import make_mesh
 from commefficient_tpu.parallel.mesh import client_sharding, shard_batch
@@ -232,8 +233,19 @@ class FedModel:
         self.pipeline_depth = max(1, int(getattr(args,
                                                  "pipeline_depth", 1)))
         self._inflight = []   # per round: device metric arrays
-        self._oplog = []      # ordered ("account", ids, mask) /
+        self._oplog = []      # ordered ("account", ids, mask, ridx) /
         #                       ("note", support) deferred host ops
+
+        # round-ledger telemetry (commefficient_tpu/telemetry): spans
+        # around each host-side round stage, byte totals unified with
+        # the accounting above, memory/compile watermarks. Disabled
+        # (no --ledger/--telemetry_console) it's a no-op fast path.
+        self.telemetry = build_telemetry(args)
+        self.telemetry.emit_meta(
+            num_clients=num_clients,
+            num_devices=int(np.prod(self.mesh.devices.shape)),
+            clientstore=self.clientstore,
+            plan=round_plan(args))
 
         _CURRENT_MODEL = self
 
@@ -258,6 +270,7 @@ class FedModel:
         if self.client_store is not None:
             self.client_store.close()
             self.client_store = None
+        self.telemetry.close()
 
     # --- host client store (commefficient_tpu/clientstore) ---------------
 
@@ -275,6 +288,8 @@ class FedModel:
         rows = None
         if self._prefetcher is not None:
             rows = self._prefetcher.take(ids64)
+            self.telemetry.count("prefetch_hit" if rows is not None
+                                 else "prefetch_miss")
         if rows is None:
             rows, _ = self.client_store.gather(ids64)
         if jax.process_count() > 1 and rows:
@@ -312,19 +327,21 @@ class FedModel:
         are excluded, matching the device path's dropped scatters."""
         if self.client_store is None or self._store_pending is None:
             return
-        ids_np, alive = self._store_pending
-        self._store_pending = None
-        cs = self.client_states
-        self.client_states = ClientStates(None, None, None)
-        rows = {}
-        for name, val in (("velocities", cs.velocities),
-                          ("errors", cs.errors),
-                          ("weights", cs.weights)):
-            if val is not None:
-                rows[name] = np.asarray(_host(val), np.float32)
-        if rows and alive.any():
-            self.client_store.write(
-                ids_np[alive], {k: v[alive] for k, v in rows.items()})
+        with self.telemetry.span("writeback"):
+            ids_np, alive = self._store_pending
+            self._store_pending = None
+            cs = self.client_states
+            self.client_states = ClientStates(None, None, None)
+            rows = {}
+            for name, val in (("velocities", cs.velocities),
+                              ("errors", cs.errors),
+                              ("weights", cs.weights)):
+                if val is not None:
+                    rows[name] = np.asarray(_host(val), np.float32)
+            if rows and alive.any():
+                self.client_store.write(
+                    ids_np[alive],
+                    {k: v[alive] for k, v in rows.items()})
 
     def params(self):
         """Current weights as the module's pytree (the reference's
@@ -400,12 +417,16 @@ class FedModel:
 
     def _call_train(self, batch):
         args = self.args
+        tel = self.telemetry
+        ridx = self.round_index
+        tel.begin_round(ridx)
         ids_np = np.asarray(batch["client_ids"])
         dev_batch = {k: v for k, v in batch.items()
                      if k != "client_ids"}
-        dev_batch = shard_batch(self.mesh, jax.tree_util.tree_map(
-            jnp.asarray, dev_batch))
-        ids = jax.device_put(jnp.asarray(ids_np, jnp.int32))
+        with tel.span("h2d"):
+            dev_batch = shard_batch(self.mesh, jax.tree_util.tree_map(
+                jnp.asarray, dev_batch))
+            ids = jax.device_put(jnp.asarray(ids_np, jnp.int32))
 
         rng = jax.random.fold_in(self._rng, self.round_index)
         cs_in = self.client_states
@@ -413,10 +434,14 @@ class FedModel:
             # normally a no-op: opt.step() already wrote round N-1's
             # rows back; covers trainers that skip the server step
             self._store_writeback()
-            cs_in = self._rows_to_states(self._gather_rows(ids_np))
-        res = self._client_round(self.ps_weights, cs_in,
-                                 dev_batch, ids, rng,
-                                 jnp.float32(self.fedavg_lr))
+            with tel.span("gather"):
+                rows = self._gather_rows(ids_np)
+            with tel.span("h2d_state"):
+                cs_in = self._rows_to_states(rows)
+        with tel.span("round_dispatch"):
+            res = self._client_round(self.ps_weights, cs_in,
+                                     dev_batch, ids, rng,
+                                     jnp.float32(self.fedavg_lr))
         self.client_states = res.client_states
         self.pending_aggregated = res.aggregated
         # dead slots (dropout / loader padding) must carry the
@@ -451,13 +476,18 @@ class FedModel:
                 self.model_state, new_stats)
 
         if self.pipeline_depth > 1:
+            # bytes for this round attach at flush() replay — the
+            # ledger record stays buffered (round order preserved)
+            # until then
             self._oplog.append(("account", ids_np,
-                                np.asarray(batch["mask"])))
+                                np.asarray(batch["mask"]), ridx))
             self._inflight.append(list(res.metrics))
             return None
-        metrics = [_host(m) for m in res.metrics]
-        return metrics + list(self._account_bytes(ids_np,
-                                                  batch["mask"]))
+        with tel.span("metrics_host"):
+            metrics = [_host(m) for m in res.metrics]
+        down, up = self._account_bytes(ids_np, batch["mask"])
+        tel.set_round_bytes(ridx, float(down.sum()), float(up.sum()))
+        return metrics + [down, up]
 
     def flush(self, force=True):
         """Materialise buffered pipelined rounds, replaying the
@@ -477,6 +507,8 @@ class FedModel:
         for op in oplog:
             if op[0] == "account":
                 down, up = self._account_bytes(op[1], op[2])
+                self.telemetry.set_round_bytes(
+                    op[3], float(down.sum()), float(up.sum()))
                 results.append(next(rounds) + [down, up])
             else:
                 self._apply_note(op[1])
@@ -664,12 +696,17 @@ class FedOptimizer:
         self._step_count += 1
         noise_rng = jax.random.fold_in(self._noise_rng,
                                        self._step_count)
-        new_ps, self.server_state, new_vel, update, support = \
-            self._server_round(
-                m.ps_weights, self.server_state, m.pending_aggregated,
-                jnp.asarray(lr, jnp.float32),
-                m.client_states.velocities, m.pending_client_ids,
-                noise_rng)
+        # round ridx's ledger record is still current (the next
+        # _call_train's begin_round closes it), so the server span
+        # lands on the round whose aggregate it consumes
+        with m.telemetry.span("server"):
+            new_ps, self.server_state, new_vel, update, support = \
+                self._server_round(
+                    m.ps_weights, self.server_state,
+                    m.pending_aggregated,
+                    jnp.asarray(lr, jnp.float32),
+                    m.client_states.velocities, m.pending_client_ids,
+                    noise_rng)
         m.ps_weights = new_ps
         if new_vel is not None:
             m.client_states = m.client_states._replace(

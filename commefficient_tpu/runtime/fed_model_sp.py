@@ -97,23 +97,28 @@ class SeqParallelFedModel(FedModel):
         self._sp_round = round_and_compress
 
     def _call_train(self, batch):
+        tel = self.telemetry
+        ridx = self.round_index
+        tel.begin_round(ridx)
         ids_np = np.asarray(batch["client_ids"])
         W = ids_np.shape[0]
         if W % self._sp_mesh.shape["clients"] != 0:
             raise ValueError(
                 f"num_workers {W} must be divisible by the client "
                 f"axis {self._sp_mesh.shape['clients']}")
-        sp_batch = {
-            "input_ids": jnp.asarray(batch["input_ids"]),
-            "token_type_ids": jnp.asarray(batch["token_type_ids"]),
-            "shifted_labels": shift_lm_labels(
-                jnp.asarray(batch["lm_labels"])),
-            "mc_token_ids": jnp.asarray(batch["mc_token_ids"]),
-            "mc_labels": jnp.asarray(batch["mc_labels"]),
-            "mask": jnp.asarray(batch["mask"]),
-        }
-        agg, per_client_loss = self._sp_round(self.ps_weights,
-                                              sp_batch)
+        with tel.span("h2d"):
+            sp_batch = {
+                "input_ids": jnp.asarray(batch["input_ids"]),
+                "token_type_ids": jnp.asarray(batch["token_type_ids"]),
+                "shifted_labels": shift_lm_labels(
+                    jnp.asarray(batch["lm_labels"])),
+                "mc_token_ids": jnp.asarray(batch["mc_token_ids"]),
+                "mc_labels": jnp.asarray(batch["mc_labels"]),
+                "mask": jnp.asarray(batch["mask"]),
+            }
+        with tel.span("round_dispatch"):
+            agg, per_client_loss = self._sp_round(self.ps_weights,
+                                                  sp_batch)
         self.pending_aggregated = agg
         self.pending_client_ids = jnp.asarray(ids_np, jnp.int32)
         self.round_index += 1
@@ -123,6 +128,8 @@ class SeqParallelFedModel(FedModel):
         # device_get: the (W,) vector is client-axis sharded and not
         # fully addressable on a multi-process mesh
         from commefficient_tpu.runtime.fed_model import _host
-        metrics = [np.asarray(_host(per_client_loss), np.float64)]
-        return metrics + list(self._account_bytes(ids_np,
-                                                  batch["mask"]))
+        with tel.span("metrics_host"):
+            metrics = [np.asarray(_host(per_client_loss), np.float64)]
+        down, up = self._account_bytes(ids_np, batch["mask"])
+        tel.set_round_bytes(ridx, float(down.sum()), float(up.sum()))
+        return metrics + [down, up]

@@ -1,0 +1,45 @@
+"""Structured run observability: round ledgers, spans, sinks.
+
+The repo's single instrumented source of truth — per-round wall-time
+spans, uplink/downlink bytes unified with FedModel's accounting,
+memory watermarks, compile events — with pluggable sinks (JSONL
+ledger, TensorBoard, console summary) and near-zero overhead when
+disabled.  See record.py for the ledger schema, core.py for the span
+lifecycle, scripts/telemetry_report.py for rendering/diffing ledgers.
+
+``telemetry.profiler`` (jax.profiler trace windows) is imported
+lazily by its users, not here: it reaches back into ``utils`` for
+logdir naming and must not cycle through this package import.
+"""
+
+from commefficient_tpu.telemetry import clock
+from commefficient_tpu.telemetry.core import (NULL_TELEMETRY, Telemetry,
+                                              build_telemetry,
+                                              hbm_peak_bytes,
+                                              host_rss_peak_bytes)
+from commefficient_tpu.telemetry.record import (LEDGER_SCHEMA_VERSION,
+                                                make_bench_record,
+                                                make_meta_record,
+                                                make_round_record,
+                                                validate_record)
+from commefficient_tpu.telemetry.sinks import (ConsoleSink, JSONLSink,
+                                               TensorBoardSink,
+                                               append_bench_record)
+
+__all__ = [
+    "clock",
+    "NULL_TELEMETRY",
+    "Telemetry",
+    "build_telemetry",
+    "host_rss_peak_bytes",
+    "hbm_peak_bytes",
+    "LEDGER_SCHEMA_VERSION",
+    "make_bench_record",
+    "make_meta_record",
+    "make_round_record",
+    "validate_record",
+    "ConsoleSink",
+    "JSONLSink",
+    "TensorBoardSink",
+    "append_bench_record",
+]

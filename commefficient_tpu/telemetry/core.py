@@ -1,0 +1,281 @@
+"""Round-ledger telemetry: spans, counters, and record lifecycle.
+
+One ``Telemetry`` instance observes one run.  The hot-path contract:
+
+- **disabled** (no sinks): ``begin_round`` is a single truthiness
+  check, ``span()`` returns one shared no-op context manager, and
+  ``count()`` returns immediately — no per-round allocation, nothing
+  retained.  ``bench.py`` with telemetry off must stay within 1% of
+  the recorded baseline, and the whole disabled path is a handful of
+  attribute loads per round.
+- **enabled**: ``begin_round`` opens a round record; ``span(name)``
+  accumulates wall-time into it; ``count(name)`` bumps a counter.
+  Records are emitted to every sink in round order once they are (a)
+  no longer the current round and (b) carry their uplink/downlink
+  bytes (``set_round_bytes`` — deferred under ``--pipeline_depth``
+  until the trainer drains).  ``close()`` flushes whatever remains.
+
+Round lifecycle (mirrors runtime/fed_model.py):
+
+    begin_round(r)        # top of FedModel._call_train
+      span("h2d") ...     # client pass spans
+      set_round_bytes(r)  # sync path: end of _call_train;
+                          # pipelined: FedModel.flush replay
+      span("server") ...  # FedOptimizer.step (record still current)
+    begin_round(r+1)      # closes r -> watermark snapshot -> emit
+
+Compile events come from ``jax.monitoring``'s duration listener
+(registered once, process-wide); each record carries the delta of
+compile count/seconds observed while it was current.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from commefficient_tpu.telemetry import clock
+from commefficient_tpu.telemetry.record import (make_meta_record,
+                                                make_round_record)
+
+
+class _NullSpan:
+    """Shared, allocation-free no-op context manager."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_spans", "_name", "_t0")
+
+    def __init__(self, spans, name):
+        self._spans = spans
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = clock.tick()
+        return self
+
+    def __exit__(self, *exc):
+        dt = clock.tick() - self._t0
+        self._spans[self._name] = self._spans.get(self._name, 0.0) + dt
+        return False
+
+
+# --- process-wide compile-event accounting -----------------------------
+# jax.monitoring listeners cannot be unregistered, so one module-level
+# listener accumulates and each Telemetry snapshots deltas.
+_COMPILE = {"events": 0, "secs": 0.0}
+_LISTENER_STATE = {"done": False}
+
+
+def _ensure_compile_listener():
+    if _LISTENER_STATE["done"]:
+        return
+    _LISTENER_STATE["done"] = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event, secs, **kw):
+            if "compile" in event:
+                _COMPILE["events"] += 1
+                _COMPILE["secs"] += float(secs)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # jax too old/new: compile fields stay zero
+        pass
+
+
+def host_rss_peak_bytes():
+    """Peak resident set size of this process (bytes), or None."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+        return int(resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss) * 1024  # Linux: KiB
+    except Exception:
+        return None
+    return None
+
+
+def hbm_peak_bytes():
+    """Peak accelerator bytes-in-use on local device 0, or None (CPU
+    backends don't report; any failure degrades to None)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return int(stats.get("peak_bytes_in_use", 0)) or None
+    except Exception:
+        pass
+    return None
+
+
+class Telemetry:
+    """Span/counter recorder + sink fan-out for one run."""
+
+    def __init__(self, sinks=None):
+        self._sinks = list(sinks or ())
+        self._records = OrderedDict()   # round index -> record
+        self._closed_rounds = set()     # indices no longer current
+        self._current = None            # the open round record
+        self._compile_mark = (0, 0.0)
+        self._shut = False
+        if self._sinks:
+            _ensure_compile_listener()
+
+    # --- configuration --------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._sinks)
+
+    def add_sink(self, sink):
+        """Attach a sink mid-run (trainers attach the TensorBoard sink
+        once the run's logdir exists)."""
+        self._sinks.append(sink)
+        _ensure_compile_listener()
+
+    def emit(self, rec):
+        for sink in self._sinks:
+            sink.write(rec)
+
+    def emit_meta(self, **fields):
+        if self._sinks:
+            self.emit(make_meta_record(**fields))
+
+    # --- round lifecycle ------------------------------------------------
+
+    def begin_round(self, index: int):
+        """Open round ``index``; closes (and may emit) the previous
+        round. No-op when disabled."""
+        if not self._sinks:
+            return None
+        self._close_current()
+        rec = make_round_record(index)
+        self._records[index] = rec
+        self._current = rec
+        self._compile_mark = (_COMPILE["events"], _COMPILE["secs"])
+        return rec
+
+    def _close_current(self):
+        rec, self._current = self._current, None
+        if rec is None:
+            return
+        rec["host_rss_peak_bytes"] = host_rss_peak_bytes()
+        rec["hbm_peak_bytes"] = hbm_peak_bytes()
+        ev0, s0 = self._compile_mark
+        rec["counters"]["compile_events"] = _COMPILE["events"] - ev0
+        rec["counters"]["compile_secs"] = round(
+            _COMPILE["secs"] - s0, 6)
+        self._closed_rounds.add(rec["round"])
+        self._drain()
+
+    def span(self, name: str):
+        """Context manager accumulating wall-time into the current
+        round record; the shared no-op outside a round / disabled."""
+        if self._current is None:
+            return NULL_SPAN
+        return _Span(self._current["spans"], name)
+
+    def count(self, name: str, n: int = 1):
+        if self._current is not None:
+            c = self._current["counters"]
+            c[name] = c.get(name, 0) + n
+
+    def set_round_bytes(self, index: int, downlink, uplink):
+        """Attach the round's FedModel accounting totals. Arrives at
+        the end of the client pass (synchronous) or at flush replay
+        (``--pipeline_depth`` > 1)."""
+        rec = self._records.get(index)
+        if rec is None:
+            return
+        rec["downlink_bytes"] = float(downlink)
+        rec["uplink_bytes"] = float(uplink)
+        self._drain()
+
+    def _drain(self, force: bool = False):
+        """Emit front records that are closed and byte-complete (or
+        everything closed, when forced) — ledger order == round
+        order."""
+        while self._records:
+            idx, rec = next(iter(self._records.items()))
+            if idx not in self._closed_rounds:
+                break
+            if rec["uplink_bytes"] is None and not force:
+                break
+            self._records.pop(idx)
+            self._closed_rounds.discard(idx)
+            self.emit(rec)
+
+    # --- non-round records ----------------------------------------------
+
+    def epoch(self, row: dict, epoch: int):
+        """Emit the trainer's per-epoch row (TableLogger shape)."""
+        if not self._sinks:
+            return
+        from commefficient_tpu.telemetry.record import make_epoch_record
+        self.emit(make_epoch_record(row, epoch))
+
+    # --- shutdown ---------------------------------------------------------
+
+    def close(self):
+        """Flush every pending record and close sinks. Idempotent."""
+        if self._shut:
+            return
+        self._shut = True
+        self._close_current()
+        self._drain(force=True)
+        for sink in self._sinks:
+            try:
+                sink.close()
+            except Exception:
+                pass
+        self._sinks = []
+
+
+#: module-level disabled instance — importers needing "a telemetry"
+#: without plumbing can use this; everything on it no-ops.
+NULL_TELEMETRY = Telemetry()
+
+
+def build_telemetry(args, extra_sinks=()) -> Telemetry:
+    """Resolve a run's Telemetry from its Config.
+
+    ``--ledger PATH`` attaches the JSONL sink (process 0 only on
+    multi-process meshes — the accounting arrays are replicated, so
+    one writer suffices); ``--telemetry_console`` the end-of-run
+    console summary. The TensorBoard sink is attached later by the
+    trainer, which owns the run logdir.
+    """
+    sinks = list(extra_sinks)
+    path = getattr(args, "ledger", "") or ""
+    console = bool(getattr(args, "telemetry_console", False))
+    if path or console:
+        primary = True
+        try:
+            import jax
+            primary = jax.process_index() == 0
+        except Exception:
+            pass
+        if primary:
+            from commefficient_tpu.telemetry.sinks import (ConsoleSink,
+                                                           JSONLSink)
+            if path:
+                sinks.append(JSONLSink(path))
+            if console:
+                sinks.append(ConsoleSink())
+    return Telemetry(sinks)

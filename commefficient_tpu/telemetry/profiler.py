@@ -1,0 +1,50 @@
+"""Opt-in ``jax.profiler`` trace windows (``--profile``).
+
+The structured replacement for the reference's cProfile scaffolding
+(fed_aggregator.py:46-52, SURVEY §5): an xplane trace of a bounded
+window, written where the rest of the run's observability lands.
+``profile_epoch`` keeps its historical shape (trace the first trained
+epoch); ``trace_window`` is the generic round-window form for
+benches/scripts.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class trace_window:
+    """Context manager: capture a JAX profiler (xplane) trace of the
+    enclosed region into ``logdir`` when ``active``."""
+
+    def __init__(self, logdir: str, active: bool = True):
+        self.active = bool(active)
+        self.logdir = logdir
+
+    def __enter__(self):
+        if self.active:
+            import jax
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        if self.active:
+            import jax
+            jax.profiler.stop_trace()
+            print(f"profiler trace written to {self.logdir}")
+        return False
+
+
+class profile_epoch(trace_window):
+    """Trace ONE epoch (the first trained one) into
+    ``<logdir>/profile`` when ``--profile``."""
+
+    def __init__(self, args, epoch, start_epoch=0, logdir=None):
+        if logdir is None:
+            from commefficient_tpu.utils import make_logdir
+            logdir = make_logdir(args)
+        super().__init__(
+            os.path.join(logdir, "profile"),
+            active=(getattr(args, "do_profile", False)
+                    and epoch == start_epoch))

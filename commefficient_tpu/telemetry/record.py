@@ -1,0 +1,126 @@
+"""Ledger record schema (version 1).
+
+A run ledger is a JSONL file: one self-describing record per line.
+Every record carries ``schema`` (this module's version) and ``kind``:
+
+``meta``   — one per run, first line: the static description of the
+             round program (mode, grad_size, geometry, the
+             ``core.rounds.round_plan`` dict) so a ledger is
+             interpretable without the launching command line.
+``round``  — one per TRAINING round: wall-time spans (seconds) for
+             sampler / gather / h2d / round dispatch / metrics
+             materialisation / server step / write-back, counters
+             (clientstore prefetch hit-vs-miss, compile events),
+             uplink/downlink bytes (identical to FedModel's
+             accounting counters), and host-RSS / HBM peak
+             watermarks.
+``epoch``  — the trainer's per-epoch TableLogger row.
+``bench``  — a benchmark headline metric (bench.py, scripts/*_bench):
+             the same schema whether it lands in BENCH_*.json's
+             harness line or a run ledger.
+``summary``— end-of-run aggregate (ConsoleSink's closing record).
+
+Span attribution note: the ``sampler`` span measures fetching the
+NEXT round's batch and is attributed to the round that is open while
+the fetch happens (the first fetch of a run precedes any round and is
+not recorded).
+"""
+
+from __future__ import annotations
+
+from commefficient_tpu.telemetry import clock
+
+LEDGER_SCHEMA_VERSION = 1
+
+KINDS = ("meta", "round", "epoch", "bench", "summary")
+
+# keys every round record must carry (values may be None where noted)
+ROUND_REQUIRED_KEYS = (
+    "schema", "kind", "ts", "round", "spans", "counters",
+    "uplink_bytes", "downlink_bytes",      # None until accounted
+    "host_rss_peak_bytes",                 # None off-Linux
+    "hbm_peak_bytes",                      # None off-accelerator
+)
+
+
+def _base(kind: str) -> dict:
+    return {"schema": LEDGER_SCHEMA_VERSION, "kind": kind,
+            "ts": clock.wall()}
+
+
+def make_meta_record(**fields) -> dict:
+    rec = _base("meta")
+    rec.update(fields)
+    return rec
+
+
+def make_round_record(round_index: int) -> dict:
+    rec = _base("round")
+    rec.update({
+        "round": int(round_index),
+        "spans": {},
+        "counters": {},
+        "uplink_bytes": None,
+        "downlink_bytes": None,
+        "host_rss_peak_bytes": None,
+        "hbm_peak_bytes": None,
+    })
+    return rec
+
+
+def make_epoch_record(row: dict, epoch: int) -> dict:
+    rec = _base("epoch")
+    rec["epoch"] = int(epoch)
+    rec["row"] = {k: v for k, v in row.items()}
+    return rec
+
+
+def make_bench_record(metric: str, value, unit: str, **extra) -> dict:
+    rec = _base("bench")
+    rec.update({"metric": str(metric), "value": value,
+                "unit": str(unit)})
+    rec.update(extra)
+    return rec
+
+
+def make_summary_record(**fields) -> dict:
+    rec = _base("summary")
+    rec.update(fields)
+    return rec
+
+
+def validate_record(rec) -> list:
+    """Schema check: a list of problem strings, empty when valid."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not dict"]
+    if rec.get("schema") != LEDGER_SCHEMA_VERSION:
+        problems.append(f"schema {rec.get('schema')!r} != "
+                        f"{LEDGER_SCHEMA_VERSION}")
+    kind = rec.get("kind")
+    if kind not in KINDS:
+        problems.append(f"unknown kind {kind!r}")
+    if not isinstance(rec.get("ts"), (int, float)):
+        problems.append("ts missing or non-numeric")
+    if kind == "round":
+        for key in ROUND_REQUIRED_KEYS:
+            if key not in rec:
+                problems.append(f"round record missing {key!r}")
+        if not isinstance(rec.get("spans"), dict):
+            problems.append("spans is not a dict")
+        elif any(not isinstance(v, (int, float))
+                 for v in rec["spans"].values()):
+            problems.append("non-numeric span value")
+        if not isinstance(rec.get("counters"), dict):
+            problems.append("counters is not a dict")
+        for key in ("uplink_bytes", "downlink_bytes"):
+            v = rec.get(key)
+            if v is not None and not isinstance(v, (int, float)):
+                problems.append(f"{key} is non-numeric")
+    if kind == "bench":
+        for key in ("metric", "value", "unit"):
+            if key not in rec:
+                problems.append(f"bench record missing {key!r}")
+    if kind == "epoch" and not isinstance(rec.get("row"), dict):
+        problems.append("epoch record missing row dict")
+    return problems

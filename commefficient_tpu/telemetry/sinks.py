@@ -1,0 +1,160 @@
+"""Pluggable ledger sinks.
+
+Every sink exposes ``write(record)`` + ``close()``; records are the
+schema-v1 dicts of ``telemetry.record``.  A sink consumes the kinds
+it cares about and ignores the rest, so one Telemetry fans out to any
+combination.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from commefficient_tpu.telemetry.record import (make_bench_record,
+                                                make_summary_record)
+
+
+class JSONLSink:
+    """One JSON object per line, appended to ``path``; flushed per
+    record (rounds are coarse enough that durability wins)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a")
+
+    def write(self, rec):
+        json.dump(rec, self._f, separators=(",", ":"),
+                  default=_json_default)
+        self._f.write("\n")
+        self._f.flush()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def _json_default(obj):
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return str(obj)
+
+
+def append_bench_record(path: str, metric: str, result, **extra):
+    """One-call ``--ledger`` helper for the bench scripts: append
+    their headline result dict as a schema-v1 bench record (stdout
+    output stays the harness contract, untouched)."""
+    sink = JSONLSink(path)
+    try:
+        sink.write(make_bench_record(metric, result, "json", **extra))
+    finally:
+        sink.close()
+
+
+class TensorBoardSink:
+    """TensorBoard writer (the single home of what used to be
+    duplicated ``make_summary_writer``/``write_epoch_scalars`` setup
+    in cv_train/gpt2_train): epoch rows become per-epoch scalars,
+    round records become per-round span/byte scalars. Uses torch's
+    bundled SummaryWriter; degrades to a no-op with a warning when
+    unavailable."""
+
+    def __init__(self, logdir: str):
+        self._writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError:
+            import warnings
+            warnings.warn("tensorboard writer unavailable; "
+                          "--tensorboard ignored")
+            return
+        self._writer = SummaryWriter(log_dir=logdir)
+
+    def write(self, rec):
+        if self._writer is None:
+            return
+        kind = rec.get("kind")
+        if kind == "epoch":
+            for key, val in rec["row"].items():
+                if isinstance(val, (int, float, np.floating,
+                                    np.integer)):
+                    self._writer.add_scalar(key.replace(" ", "_"),
+                                            float(val), rec["epoch"])
+            self._writer.flush()
+        elif kind == "round":
+            step = rec["round"]
+            for name, secs in rec["spans"].items():
+                self._writer.add_scalar(f"round/{name}_ms",
+                                        1e3 * float(secs), step)
+            for key in ("uplink_bytes", "downlink_bytes"):
+                if rec.get(key) is not None:
+                    self._writer.add_scalar(f"round/{key}",
+                                            float(rec[key]), step)
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class ConsoleSink:
+    """End-of-run summary on stdout: per-span totals/means, byte
+    totals, prefetch hit rate, compile events — the quick look that
+    previously required reassembling three log formats."""
+
+    def __init__(self, out=None):
+        self._out = out
+        self.rounds = 0
+        self.spans = {}
+        self.counters = {}
+        self.uplink = 0.0
+        self.downlink = 0.0
+
+    def write(self, rec):
+        if rec.get("kind") != "round":
+            return
+        self.rounds += 1
+        for name, secs in rec["spans"].items():
+            self.spans[name] = self.spans.get(name, 0.0) + secs
+        for name, n in rec["counters"].items():
+            self.counters[name] = self.counters.get(name, 0) + n
+        self.uplink += rec.get("uplink_bytes") or 0.0
+        self.downlink += rec.get("downlink_bytes") or 0.0
+
+    def summary(self) -> dict:
+        n = max(self.rounds, 1)
+        return make_summary_record(
+            rounds=self.rounds,
+            uplink_mib=round(self.uplink / 2**20, 3),
+            downlink_mib=round(self.downlink / 2**20, 3),
+            span_total_s={k: round(v, 4)
+                          for k, v in sorted(self.spans.items())},
+            span_mean_ms={k: round(1e3 * v / n, 3)
+                          for k, v in sorted(self.spans.items())},
+            counters=dict(sorted(self.counters.items())),
+        )
+
+    def close(self):
+        if not self.rounds:
+            return
+        import sys
+        out = self._out or sys.stdout
+        s = self.summary()
+        print("== telemetry summary "
+              f"({s['rounds']} rounds) ==", file=out)
+        print(f"  comm: up {s['uplink_mib']} MiB, "
+              f"down {s['downlink_mib']} MiB", file=out)
+        for name in s["span_total_s"]:
+            print(f"  span {name}: total {s['span_total_s'][name]} s, "
+                  f"mean {s['span_mean_ms'][name]} ms/round", file=out)
+        if s["counters"]:
+            print(f"  counters: {s['counters']}", file=out)

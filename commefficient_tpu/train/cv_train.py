@@ -16,7 +16,6 @@ from __future__ import annotations
 import math
 import re
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +29,7 @@ from commefficient_tpu.data import transforms as T
 from commefficient_tpu.models import get_model
 from commefficient_tpu.runtime import (FedModel, FedOptimizer, LambdaLR,
                                        drain_rounds)
+from commefficient_tpu.telemetry import clock
 from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
                                      TSVLogger, Timer, steps_per_epoch)
 
@@ -183,7 +183,7 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
         upload_total = np.zeros(model.num_clients)
         spe = len(loader)
         max_batches = max(1, int(spe * epoch_fraction))
-        state = {"t0": time.time()}
+        state = {"t0": clock.wall()}
         pending = []
 
         def process(metrics, i, w, lr):
@@ -207,8 +207,8 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                 print("LR: {:0.5f}, Loss: {:0.5f}, Acc: {:0.5f}, "
                       "Time: {:0.2f}".format(
                           lr, losses[-1], accs[-1],
-                          time.time() - state["t0"]))
-                state["t0"] = time.time()
+                          clock.wall() - state["t0"]))
+                state["t0"] = clock.wall()
             if not math.isfinite(losses[-1]) or \
                     losses[-1] > args.nan_threshold:
                 print(f"Stopping at batch {i}: diverged "
@@ -216,7 +216,17 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                 return False
             return True
 
-        for i, batch in enumerate(loader):
+        tel = model.telemetry
+        it = enumerate(loader)
+        while True:
+            # manual pull so the sampler/loader wait is a ledger span
+            # (lands on the previous round's record — it's the
+            # inter-round host gap)
+            with tel.span("sampler"):
+                nxt = next(it, None)
+            if nxt is None:
+                break
+            i, batch = nxt
             if i >= max_batches:
                 break
             if mixup_rng is not None:
@@ -269,16 +279,19 @@ def train(model, opt, lr_scheduler, train_loader, val_loader, args,
           logger=None, timer=None, start_epoch=0, epoch_hook=None):
     """Epoch loop (reference cv_train.py:85-168). ``epoch_hook(ep)``
     runs after each completed epoch (checkpointing)."""
-    from commefficient_tpu.utils import (make_logdir,
-                                         make_summary_writer,
-                                         profile_epoch,
-                                         write_epoch_scalars)
+    from commefficient_tpu.telemetry.profiler import profile_epoch
+    from commefficient_tpu.telemetry.sinks import TensorBoardSink
+    from commefficient_tpu.utils import make_logdir
     timer = timer or Timer()
     logger = logger or TableLogger()
     tsv = TSVLogger()
     logdir = (make_logdir(args)
               if (args.use_tensorboard or args.do_profile) else None)
-    writer = make_summary_writer(args, logdir)
+    tel = model.telemetry
+    if args.use_tensorboard:
+        # the trainer owns the run logdir, so the TB sink attaches
+        # here rather than in build_telemetry
+        tel.add_sink(TensorBoardSink(logdir))
     results = []
     num_epochs = args.num_epochs
     # one persistent mixup stream across epochs (fresh draws per round)
@@ -317,12 +330,13 @@ def train(model, opt, lr_scheduler, train_loader, val_loader, args,
             logger.append(row)
             tsv.append(row)
             results.append(row)
-            write_epoch_scalars(writer, row, epoch + 1)
+            tel.epoch(row, epoch + 1)
             if epoch_hook is not None:
                 epoch_hook(epoch + 1)
     finally:
-        if writer is not None:
-            writer.close()
+        # sinks flush/close here even on abort; finalize()'s close is
+        # a no-op afterwards (idempotent)
+        tel.close()
     return results
 
 

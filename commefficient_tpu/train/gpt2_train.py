@@ -172,7 +172,16 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
                 return False
             return True
 
-        for i, batch in enumerate(loader):
+        tel = model.telemetry
+        it = enumerate(loader)
+        while True:
+            # manual pull so the loader wait is a ledger span (lands
+            # on the previous round's record — the inter-round gap)
+            with tel.span("sampler"):
+                nxt = next(it, None)
+            if nxt is None:
+                break
+            i, batch = nxt
             lr_scheduler.step()
             metrics = model(batch)
             opt.step()
@@ -209,16 +218,19 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
                args, logger=None, start_epoch=0, epoch_hook=None,
                logdir=None):
     """(reference gpt2_train.py:115-147)"""
-    from commefficient_tpu.utils import (make_logdir,
-                                         make_summary_writer,
-                                         profile_epoch,
-                                         write_epoch_scalars)
+    from commefficient_tpu.telemetry.profiler import profile_epoch
+    from commefficient_tpu.telemetry.sinks import TensorBoardSink
+    from commefficient_tpu.utils import make_logdir
     logger = logger or TableLogger()
     timer = Timer()
     if logdir is None:
         logdir = (make_logdir(args)
                   if (args.use_tensorboard or args.do_profile) else None)
-    writer = make_summary_writer(args, logdir)
+    tel = model.telemetry
+    if args.use_tensorboard:
+        # the trainer owns the run logdir, so the TB sink attaches
+        # here rather than in build_telemetry
+        tel.add_sink(TensorBoardSink(logdir))
     results = []
     try:
         for epoch in range(start_epoch, math.ceil(args.num_epochs)):
@@ -242,12 +254,13 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
                    "val_ppl": ppl, "total_time": timer.total_time}
             logger.append(row)
             results.append(row)
-            write_epoch_scalars(writer, row, epoch + 1)
+            tel.epoch(row, epoch + 1)
             if epoch_hook is not None:
                 epoch_hook(epoch + 1)
     finally:
-        if writer is not None:
-            writer.close()
+        # sinks flush/close here even on abort; finalize()'s close is
+        # a no-op afterwards (idempotent)
+        tel.close()
     return results
 
 

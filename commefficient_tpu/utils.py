@@ -7,11 +7,12 @@ Exp, TableLogger, TSVLogger, Timer, make_logdir).
 from __future__ import annotations
 
 import os
-import time
 from collections import namedtuple
 from datetime import datetime
 
 import numpy as np
+
+from commefficient_tpu.telemetry import clock
 
 
 class Logger:
@@ -54,59 +55,6 @@ def make_logdir(args) -> str:
         "runs", current_time + "_" + clients_str + "_" + sketch_str + "_" + k_str)
 
 
-def make_summary_writer(args, logdir=None):
-    """TensorBoard writer into the run-described logdir when
-    ``--tensorboard`` (reference utils.py:51-64 + cv_train.py:150-158);
-    None otherwise. Uses torch's bundled SummaryWriter (CPU torch is
-    in-image); degrades with a warning if unavailable."""
-    if not getattr(args, "use_tensorboard", False):
-        return None
-    try:
-        from torch.utils.tensorboard import SummaryWriter
-    except ImportError:
-        import warnings
-        warnings.warn("tensorboard writer unavailable; --tensorboard "
-                      "ignored")
-        return None
-    return SummaryWriter(log_dir=logdir or make_logdir(args))
-
-
-def write_epoch_scalars(writer, row, epoch):
-    """Log a TableLogger row's numeric fields as TB scalars."""
-    if writer is None:
-        return
-    for key, val in row.items():
-        if isinstance(val, (int, float, np.floating, np.integer)):
-            writer.add_scalar(key.replace(" ", "_"), float(val), epoch)
-    writer.flush()
-
-
-class profile_epoch:
-    """Context manager: capture a JAX profiler (xplane) trace of one
-    epoch into <logdir>/profile when ``--profile`` — the structured
-    replacement for the reference's cProfile scaffolding
-    (fed_aggregator.py:46-52, SURVEY §5 'Tracing / profiling')."""
-
-    def __init__(self, args, epoch, start_epoch=0, logdir=None):
-        self.active = (getattr(args, "do_profile", False)
-                       and epoch == start_epoch)
-        self.logdir = os.path.join(logdir or make_logdir(args),
-                                   "profile")
-
-    def __enter__(self):
-        if self.active:
-            import jax
-            os.makedirs(self.logdir, exist_ok=True)
-            jax.profiler.start_trace(self.logdir)
-        return self
-
-    def __exit__(self, *exc):
-        if self.active:
-            import jax
-            jax.profiler.stop_trace()
-            print(f"profiler trace written to {self.logdir}")
-
-
 class TableLogger:
     """Fixed-width stdout table (reference utils.py:66-74)."""
 
@@ -143,11 +91,11 @@ class Timer:
     """Wall-clock phase timer (reference utils.py:89-99)."""
 
     def __init__(self):
-        self.times = [time.time()]
+        self.times = [clock.wall()]
         self.total_time = 0.0
 
     def __call__(self, include_in_total=True):
-        self.times.append(time.time())
+        self.times.append(clock.wall())
         delta_t = self.times[-1] - self.times[-2]
         if include_in_total:
             self.total_time += delta_t

@@ -42,6 +42,9 @@ def main():
                     help="bench even when the fused path cannot "
                     "engage (the 'fused' column is then the chunked "
                     "fallback — reported, not asserted)")
+    ap.add_argument("--ledger", type=str, default="",
+                    help="append the result as a telemetry JSONL "
+                    "bench record (stdout line unchanged)")
     args = ap.parse_args()
 
     from commefficient_tpu.models.gpt2 import lm_nll_sums_chunked
@@ -101,7 +104,7 @@ def main():
     chunk_ms = bench(lm_nll_sums_chunked,
                      {"tokens_per_chunk": args.tokens_per_chunk})
     fused_ms = bench(lm_nll_sums_fused, {"batch_mult": W})
-    print(json.dumps({
+    out = {
         "geometry": {"clients": W, "examples": E, "tokens": Tm,
                      "width": C, "vocab": V,
                      "tokens_per_chunk": args.tokens_per_chunk},
@@ -111,7 +114,12 @@ def main():
         "fused_path_engaged": reason is None,
         "fallback_reason": reason,
         "backend": jax.default_backend(),
-    }))
+    }
+    print(json.dumps(out))
+    if args.ledger:
+        from commefficient_tpu.telemetry import append_bench_record
+        append_bench_record(args.ledger, "flce_bench", out,
+                            backend=jax.default_backend())
 
 
 if __name__ == "__main__":

@@ -234,6 +234,9 @@ def main():
                     "task-5 sweep knob — larger chunks trade logits "
                     "VMEM/HBM for fewer dWte carry accumulations")
     ap.add_argument("--profile", type=str, default=None)
+    ap.add_argument("--ledger", type=str, default="",
+                    help="append the result as a telemetry JSONL "
+                    "bench record (stdout line unchanged)")
     args = ap.parse_args()
 
     if args.mode == "bare":
@@ -265,6 +268,10 @@ def main():
         "geometry": vars(args),
     }
     print(json.dumps(out))
+    if args.ledger:
+        from commefficient_tpu.telemetry import append_bench_record
+        append_bench_record(args.ledger, "gpt2_bench", out,
+                            backend=jax.default_backend())
 
     if args.profile:
         with jax.profiler.trace(args.profile):

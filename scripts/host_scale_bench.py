@@ -261,6 +261,9 @@ def main():
     ap.add_argument("--store_budget_mb", type=int, default=4)
     ap.add_argument("--store_rounds", type=int, default=20)
     ap.add_argument("--store_dim", type=int, default=256)
+    ap.add_argument("--ledger", type=str, default="",
+                    help="append the result as a telemetry JSONL "
+                    "bench record (stdout line unchanged)")
     args = ap.parse_args()
 
     root = args.workdir or tempfile.mkdtemp(prefix="host_scale_")
@@ -281,6 +284,10 @@ def main():
         if args.workdir is None:
             shutil.rmtree(root, ignore_errors=True)
     print(json.dumps(out))
+    if args.ledger:
+        from commefficient_tpu.telemetry import append_bench_record
+        append_bench_record(args.ledger, "host_scale_bench", out,
+                            only=args.only)
 
 
 if __name__ == "__main__":

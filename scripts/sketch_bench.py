@@ -89,6 +89,9 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU platform (the container's "
                     "sitecustomize overrides JAX_PLATFORMS)")
+    ap.add_argument("--ledger", type=str, default="",
+                    help="append the result as a telemetry JSONL "
+                    "bench record (stdout line unchanged)")
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -177,6 +180,10 @@ def main():
             (time.perf_counter() - t0) / n * 1e3, 2)
 
     print(json.dumps(res))
+    if args.ledger:
+        from commefficient_tpu.telemetry import append_bench_record
+        append_bench_record(args.ledger, "sketch_bench", res,
+                            backend=jax.default_backend())
 
 
 if __name__ == "__main__":

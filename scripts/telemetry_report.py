@@ -1,0 +1,221 @@
+"""Render or diff run ledgers (commefficient_tpu/telemetry JSONL).
+
+    python scripts/telemetry_report.py runs/a.jsonl
+        one-run summary: round program, per-span totals/means, comm
+        byte totals, counters, memory watermarks, epoch table, bench
+        records
+
+    python scripts/telemetry_report.py runs/a.jsonl runs/b.jsonl
+        diff two ledgers: per-span mean deltas, comm/byte deltas,
+        bench metric ratios — the "did my change help" view
+
+``--json`` prints the summary (or diff) as one JSON object instead of
+text. Invalid records are reported but don't abort the render.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from commefficient_tpu.telemetry.record import validate_record  # noqa: E402
+
+
+def load_ledger(path):
+    """Parse a JSONL ledger -> (records, problems). Problems carry
+    the 1-based line number; bad lines are skipped, not fatal."""
+    records, problems = [], []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                problems.append(f"line {lineno}: not JSON ({exc})")
+                continue
+            issues = validate_record(rec)
+            if issues:
+                problems.append(
+                    f"line {lineno}: " + "; ".join(issues))
+                continue
+            records.append(rec)
+    return records, problems
+
+
+def summarize(records) -> dict:
+    """Aggregate a ledger's records into one summary dict."""
+    rounds = [r for r in records if r["kind"] == "round"]
+    spans, counters = {}, {}
+    uplink = downlink = 0.0
+    rss_peak = hbm_peak = None
+    for r in rounds:
+        for name, secs in r["spans"].items():
+            spans[name] = spans.get(name, 0.0) + float(secs)
+        for name, n in r["counters"].items():
+            counters[name] = counters.get(name, 0) + n
+        uplink += r.get("uplink_bytes") or 0.0
+        downlink += r.get("downlink_bytes") or 0.0
+        for key, best in (("host_rss_peak_bytes", rss_peak),
+                          ("hbm_peak_bytes", hbm_peak)):
+            v = r.get(key)
+            if v is not None and (best is None or v > best):
+                if key == "host_rss_peak_bytes":
+                    rss_peak = v
+                else:
+                    hbm_peak = v
+    n = max(len(rounds), 1)
+    return {
+        "meta": next((r for r in records if r["kind"] == "meta"),
+                     None),
+        "rounds": len(rounds),
+        "uplink_bytes": uplink,
+        "downlink_bytes": downlink,
+        "spans": {k: {"total_s": round(v, 4),
+                      "mean_ms": round(1e3 * v / n, 3)}
+                  for k, v in sorted(spans.items())},
+        "counters": dict(sorted(counters.items())),
+        "host_rss_peak_bytes": rss_peak,
+        "hbm_peak_bytes": hbm_peak,
+        "epochs": [r["row"] for r in records if r["kind"] == "epoch"],
+        "benches": [{k: v for k, v in r.items()
+                     if k not in ("schema", "kind", "ts")}
+                    for r in records if r["kind"] == "bench"],
+        "summary_records": [r for r in records
+                            if r["kind"] == "summary"],
+    }
+
+
+def _mib(b):
+    return f"{b / 2**20:.3f} MiB"
+
+
+def render_summary(s, label="") -> str:
+    lines = []
+    head = f"== ledger summary{' ' + label if label else ''} =="
+    lines.append(head)
+    meta = s["meta"]
+    if meta:
+        plan = meta.get("plan") or {}
+        bits = [f"mode={plan.get('mode')}",
+                f"grad_size={plan.get('grad_size')}",
+                f"workers={plan.get('num_workers')}"]
+        if "num_clients" in meta:
+            bits.append(f"clients={meta['num_clients']}")
+        if plan.get("fused_grad"):
+            bits.append("fused_grad")
+        lines.append("  run: " + ", ".join(bits))
+    lines.append(f"  rounds: {s['rounds']}")
+    lines.append(f"  comm: up {_mib(s['uplink_bytes'])}, "
+                 f"down {_mib(s['downlink_bytes'])}")
+    for name, v in s["spans"].items():
+        lines.append(f"  span {name}: total {v['total_s']} s, "
+                     f"mean {v['mean_ms']} ms/round")
+    if s["counters"]:
+        lines.append(f"  counters: {s['counters']}")
+    if s["host_rss_peak_bytes"] is not None:
+        lines.append(
+            f"  host RSS peak: {_mib(s['host_rss_peak_bytes'])}")
+    if s["hbm_peak_bytes"] is not None:
+        lines.append(f"  HBM peak: {_mib(s['hbm_peak_bytes'])}")
+    for row in s["epochs"]:
+        lines.append("  epoch " + json.dumps(row))
+    for b in s["benches"]:
+        lines.append("  bench " + json.dumps(b))
+    return "\n".join(lines)
+
+
+def diff_summaries(a: dict, b: dict) -> dict:
+    """B relative to A: per-span mean deltas, byte deltas, matching
+    bench metrics as ratios (>1 = B slower/bigger)."""
+    out = {"rounds": {"a": a["rounds"], "b": b["rounds"]}}
+    span_diff = {}
+    for name in sorted(set(a["spans"]) | set(b["spans"])):
+        ma = a["spans"].get(name, {}).get("mean_ms")
+        mb = b["spans"].get(name, {}).get("mean_ms")
+        entry = {"a_mean_ms": ma, "b_mean_ms": mb}
+        if ma and mb:
+            entry["ratio"] = round(mb / ma, 3)
+        span_diff[name] = entry
+    out["spans"] = span_diff
+    for key in ("uplink_bytes", "downlink_bytes"):
+        entry = {"a": a[key], "b": b[key],
+                 "delta": b[key] - a[key]}
+        if a[key]:
+            entry["ratio"] = round(b[key] / a[key], 6)
+        out[key] = entry
+    bench_a = {r.get("metric"): r for r in a["benches"]}
+    bench_diff = {}
+    for r in b["benches"]:
+        ra = bench_a.get(r.get("metric"))
+        if ra is None:
+            continue
+        va, vb = ra.get("value"), r.get("value")
+        entry = {"a": va, "b": vb}
+        if isinstance(va, (int, float)) and \
+                isinstance(vb, (int, float)) and va:
+            entry["ratio"] = round(vb / va, 4)
+        bench_diff[r["metric"]] = entry
+    if bench_diff:
+        out["benches"] = bench_diff
+    return out
+
+
+def render_diff(d, label_a, label_b) -> str:
+    lines = [f"== ledger diff: {label_a} -> {label_b} ==",
+             f"  rounds: {d['rounds']['a']} -> {d['rounds']['b']}"]
+    for name, e in d["spans"].items():
+        r = f" ({e['ratio']}x)" if "ratio" in e else ""
+        lines.append(f"  span {name}: {e['a_mean_ms']} -> "
+                     f"{e['b_mean_ms']} ms/round{r}")
+    for key in ("uplink_bytes", "downlink_bytes"):
+        e = d[key]
+        r = f" ({e['ratio']}x)" if "ratio" in e else ""
+        lines.append(f"  {key.split('_')[0]}: {_mib(e['a'])} -> "
+                     f"{_mib(e['b'])}{r}")
+    for name, e in d.get("benches", {}).items():
+        r = f" ({e['ratio']}x)" if "ratio" in e else ""
+        lines.append(f"  bench {name}: {e['a']} -> {e['b']}{r}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render or diff telemetry run ledgers")
+    ap.add_argument("ledger", help="run ledger (JSONL)")
+    ap.add_argument("other", nargs="?", default=None,
+                    help="second ledger: diff mode (other vs first)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    records, problems = load_ledger(args.ledger)
+    for p in problems:
+        print(f"WARNING {args.ledger}: {p}", file=sys.stderr)
+    summ = summarize(records)
+
+    if args.other is None:
+        if args.json:
+            print(json.dumps(summ))
+        else:
+            print(render_summary(summ, label=args.ledger))
+        return 0
+
+    records_b, problems_b = load_ledger(args.other)
+    for p in problems_b:
+        print(f"WARNING {args.other}: {p}", file=sys.stderr)
+    d = diff_summaries(summ, summarize(records_b))
+    if args.json:
+        print(json.dumps(d))
+    else:
+        print(render_diff(d, args.ledger, args.other))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,7 @@ FAST_MODULES = {
     "test_accounting",
     "test_sharding",
     "test_data_breadth",
+    "test_telemetry",
 }
 FAST_CLASSES = {
     "TestHandDerived",        # reference unit_test.py oracle traces

@@ -1,0 +1,291 @@
+"""Telemetry subsystem: no-op fast path, record lifecycle/ordering,
+JSONL schema, the report script's round-trip + diff, and the
+raw-clock grep guard (all host-side timing flows through
+``telemetry.clock`` so the ledger is the one source of truth)."""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import numpy as np
+
+from commefficient_tpu.telemetry import (NULL_TELEMETRY, Telemetry,
+                                         validate_record)
+from commefficient_tpu.telemetry.core import NULL_SPAN
+from commefficient_tpu.telemetry.sinks import ConsoleSink, JSONLSink
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parents[1] \
+    / "commefficient_tpu"
+
+
+# --- raw-clock grep guard ---------------------------------------------
+
+
+def test_no_raw_clocks_outside_telemetry():
+    """``time.time()`` / ``perf_counter`` may appear ONLY under
+    telemetry/ (clock.py is the one place raw clocks live); everything
+    else must go through ``telemetry.clock`` so spans, Timer and the
+    ledger agree on what a second is."""
+    offenders = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        rel = path.relative_to(PKG_ROOT)
+        if rel.parts[0] == "telemetry":
+            continue
+        text = path.read_text()
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if "time.time(" in line or "perf_counter" in line:
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw clock calls outside commefficient_tpu/telemetry/ "
+        "(use telemetry.clock.wall/tick):\n" + "\n".join(offenders))
+
+
+# --- disabled fast path -----------------------------------------------
+
+
+def test_disabled_telemetry_is_noop():
+    tel = Telemetry()
+    assert not tel.enabled
+    assert tel.begin_round(0) is None
+    # the no-op span is ONE shared object — no per-call allocation
+    assert tel.span("h2d") is NULL_SPAN
+    assert tel.span("server") is tel.span("gather")
+    with tel.span("x"):
+        pass
+    tel.count("prefetch_hit")
+    tel.set_round_bytes(0, 1.0, 2.0)
+    tel.epoch({"epoch": 1}, 1)
+    tel.close()
+    assert NULL_TELEMETRY.span("anything") is NULL_SPAN
+
+
+def test_disabled_round_retains_nothing():
+    tel = Telemetry()
+    for r in range(100):
+        tel.begin_round(r)
+        tel.count("c")
+    assert not tel._records and tel._current is None
+
+
+# --- record lifecycle + JSONL sink ------------------------------------
+
+
+def test_jsonl_ledger_schema_and_order(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    tel = Telemetry([JSONLSink(path)])
+    tel.emit_meta(num_clients=4, plan={"mode": "sketch"})
+    for r in range(3):
+        tel.begin_round(r)
+        with tel.span("h2d"):
+            pass
+        with tel.span("h2d"):  # accumulates, same key
+            pass
+        tel.count("prefetch_hit")
+        tel.set_round_bytes(r, downlink=10.0 * r, uplink=4.0)
+    tel.epoch({"epoch": 1, "train_loss": 0.5}, 1)
+    tel.close()
+
+    with open(path) as f:
+        records = [json.loads(line) for line in f]
+    for rec in records:
+        assert validate_record(rec) == [], rec
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "meta"
+    rounds = [r for r in records if r["kind"] == "round"]
+    assert [r["round"] for r in rounds] == [0, 1, 2]
+    for r in rounds:
+        assert r["spans"]["h2d"] >= 0.0
+        assert r["counters"]["prefetch_hit"] == 1
+        assert "compile_events" in r["counters"]
+        assert r["uplink_bytes"] == 4.0
+    assert any(r["kind"] == "epoch" for r in records)
+
+
+def test_deferred_bytes_preserve_round_order(tmp_path):
+    """Pipelined shape: rounds close before their bytes arrive (the
+    flush replay attaches them later). Emission must wait and stay in
+    round order."""
+    path = str(tmp_path / "run.jsonl")
+    sink = JSONLSink(path)
+    tel = Telemetry([sink])
+    tel.begin_round(0)
+    tel.begin_round(1)   # closes 0 — but 0 has no bytes yet
+    tel.begin_round(2)   # closes 1
+    with open(path) as f:
+        assert f.read() == ""  # nothing emitted yet
+    # bytes arrive out of order: 1 before 0
+    tel.set_round_bytes(1, 0.0, 1.0)
+    with open(path) as f:
+        assert f.read() == ""  # 0 still blocks the front
+    tel.set_round_bytes(0, 0.0, 1.0)
+    with open(path) as f:
+        emitted = [json.loads(x) for x in f]
+    assert [r["round"] for r in emitted] == [0, 1]
+    tel.set_round_bytes(2, 0.0, 1.0)
+    tel.close()
+    with open(path) as f:
+        emitted = [json.loads(x) for x in f]
+    assert [r["round"] for r in emitted] == [0, 1, 2]
+
+
+def test_close_flushes_byteless_rounds(tmp_path):
+    """An aborted run (divergence) never attaches bytes to the last
+    rounds; close() must still emit them (bytes stay null) rather
+    than dropping the tail."""
+    path = str(tmp_path / "run.jsonl")
+    tel = Telemetry([JSONLSink(path)])
+    tel.begin_round(0)
+    tel.close()
+    with open(path) as f:
+        recs = [json.loads(x) for x in f]
+    assert len(recs) == 1 and recs[0]["round"] == 0
+    assert recs[0]["uplink_bytes"] is None
+    assert validate_record(recs[0]) == []
+
+
+def test_console_sink_aggregates(capsys):
+    tel = Telemetry([ConsoleSink()])
+    for r in range(2):
+        tel.begin_round(r)
+        with tel.span("server"):
+            pass
+        tel.set_round_bytes(r, downlink=2 ** 20, uplink=2 ** 20)
+    tel.close()
+    out = capsys.readouterr().out
+    assert "telemetry summary (2 rounds)" in out
+    assert "span server" in out
+    assert "up 2.0 MiB" in out
+
+
+def test_json_default_handles_numpy(tmp_path):
+    path = str(tmp_path / "np.jsonl")
+    sink = JSONLSink(path)
+    sink.write({"schema": 1, "kind": "bench", "ts": 0.0,
+                "metric": "m", "unit": "u",
+                "value": np.float32(1.5), "n": np.int64(3),
+                "arr": np.arange(2)})
+    sink.close()
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["value"] == 1.5 and rec["n"] == 3 and rec["arr"] == [0, 1]
+
+
+# --- report script round-trip -----------------------------------------
+
+
+def _load_report_module():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "telemetry_report.py")
+    spec = importlib.util.spec_from_file_location("telemetry_report",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_ledger(path, n_rounds, ms_per_round, bytes_per_round):
+    tel = Telemetry([JSONLSink(str(path))])
+    tel.emit_meta(num_clients=4,
+                  plan={"mode": "sketch", "grad_size": 10,
+                        "num_workers": 2})
+    for r in range(n_rounds):
+        rec = tel.begin_round(r)
+        rec["spans"]["server"] = ms_per_round / 1e3
+        tel.set_round_bytes(r, bytes_per_round, bytes_per_round)
+    tel.close()
+
+
+def test_report_summarize_round_trips(tmp_path):
+    report = _load_report_module()
+    path = tmp_path / "a.jsonl"
+    _write_ledger(path, n_rounds=3, ms_per_round=10.0,
+                  bytes_per_round=100.0)
+    records, problems = report.load_ledger(str(path))
+    assert problems == []
+    s = report.summarize(records)
+    assert s["rounds"] == 3
+    assert s["uplink_bytes"] == 300.0
+    assert s["spans"]["server"]["mean_ms"] == 10.0
+    text = report.render_summary(s)
+    assert "rounds: 3" in text and "span server" in text
+
+
+def test_report_diff(tmp_path):
+    report = _load_report_module()
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write_ledger(a, n_rounds=2, ms_per_round=10.0,
+                  bytes_per_round=100.0)
+    _write_ledger(b, n_rounds=2, ms_per_round=20.0,
+                  bytes_per_round=50.0)
+    sa = report.summarize(report.load_ledger(str(a))[0])
+    sb = report.summarize(report.load_ledger(str(b))[0])
+    d = report.diff_summaries(sa, sb)
+    assert d["spans"]["server"]["ratio"] == 2.0
+    assert d["uplink_bytes"]["ratio"] == 0.5
+    text = report.render_diff(d, "a", "b")
+    assert "span server" in text
+
+
+def test_report_flags_invalid_lines(tmp_path):
+    report = _load_report_module()
+    path = tmp_path / "bad.jsonl"
+    path.write_text('not json\n{"schema": 99, "kind": "round"}\n'
+                    + json.dumps({"schema": 1, "kind": "meta",
+                                  "ts": 0.0}) + "\n")
+    records, problems = report.load_ledger(str(path))
+    assert len(records) == 1
+    assert len(problems) == 2
+
+
+# --- prefetch worker-death surfacing ----------------------------------
+
+
+def test_prefetch_worker_death_surfaces():
+    """An exception that escapes the worker LOOP (not a per-job
+    gather error) must raise on the main thread at the next take(),
+    not stall the round out to the take timeout."""
+    import pytest
+
+    from commefficient_tpu.clientstore.prefetch import StorePrefetcher
+
+    class EvilStore:
+        def gather(self, ids, out=None):
+            raise MemoryError("host arena exhausted")
+
+    pf = StorePrefetcher(EvilStore())
+    try:
+        # malformed job: unpack fails OUTSIDE the per-job try
+        pf._jobs.put("not-a-tuple")
+        pf._pending += 1
+        pf._thread.join(timeout=5.0)
+        assert not pf._thread.is_alive()
+        with pytest.raises(RuntimeError, match="prefetch worker died"):
+            pf.take(np.array([0], np.int64), timeout=5.0)
+        with pytest.raises(RuntimeError, match="prefetch worker died"):
+            pf.submit(np.array([1], np.int64))
+    finally:
+        pf.close(timeout=1.0)
+
+
+def test_prefetch_per_job_error_still_raises_via_take():
+    """Per-job store errors keep the existing surfacing path: the
+    exception rides the done-queue and re-raises in take()."""
+    import pytest
+
+    from commefficient_tpu.clientstore.prefetch import StorePrefetcher
+
+    class EvilStore:
+        def gather(self, ids, out=None):
+            raise MemoryError("host arena exhausted")
+
+        def row_version(self, cid):
+            return 0
+
+    pf = StorePrefetcher(EvilStore())
+    try:
+        pf.submit(np.array([0, 1], np.int64))
+        with pytest.raises(MemoryError, match="arena exhausted"):
+            pf.take(np.array([0, 1], np.int64), timeout=5.0)
+    finally:
+        pf.close(timeout=1.0)
