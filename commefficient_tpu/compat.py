@@ -1,0 +1,39 @@
+"""Version-compatibility shims for jax APIs the runtime uses.
+
+The SPMD round code targets current jax (`jax.lax.axis_size`,
+`jax.lax.pvary`), but the library must also run on the 0.4.x line
+where those names don't exist yet. Each shim prefers the real API and
+falls back to the semantically-equivalent old-jax spelling, so call
+sites stay single-path.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` with a pre-0.5 fallback.
+
+    ``psum(1, axis)`` over a manual (shard_map) axis constant-folds to
+    the static mesh extent on the 0.4.x line, so loop bounds built
+    from it stay Python ints.
+    """
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pvary(x, axis_name):
+    """``jax.lax.pvary`` with a pre-0.5 identity fallback.
+
+    Old jax has no varying-axes type system, so there is nothing to
+    mark: values are implicitly device-varying inside shard_map and
+    grad transposes don't insert the replication psum the marker
+    exists to suppress.
+    """
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is not None:
+        return fn(x, axis_name)
+    return x

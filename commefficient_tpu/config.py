@@ -265,6 +265,29 @@ class Config:
     # end-of-run console summary of the round ledger (per-span
     # totals/means, byte totals) — works with or without --ledger
     telemetry_console: bool = False
+    # algorithm probes (telemetry schema v2): 0 = off (the round step
+    # compiles to exactly the pre-probe HLO — no extra outputs). N > 0
+    # compiles the cheap O(d) probes (update/residual/momentum norms,
+    # NaN/Inf counts, mass coverage) into every round and additionally
+    # runs the expensive true sketch-recovery-error probe
+    # ‖unsketch(S(g)) − g‖/‖g‖ on rounds where round % N == 0 (it
+    # needs the dense aggregate the sketch path otherwise never
+    # materialises).
+    probe_every: int = 0
+    # shorthand for --probe_every 1: every probe, every round
+    probe_full: bool = False
+    # alarm engine (telemetry/alarms.py) action when a probe rule
+    # fires: "log" (warn + ledger flag), "ledger-flag" (ledger flag
+    # only), "abort" (flag, then raise DivergenceAbort so the trainer
+    # stops at the offending round)
+    on_divergence: str = "log"
+    # residual-growth rule: Verror-norm growth ratio > this for
+    # --alarm_residual_rounds consecutive probed rounds
+    alarm_residual_ratio: float = 2.0
+    alarm_residual_rounds: int = 3
+    # recovery-error rule: ‖unsketch(S(g)) − g‖/‖g‖ above this (1.0 =
+    # the recovered update is no better than sending nothing)
+    alarm_recovery_error: float = 1.0
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -292,6 +315,12 @@ class Config:
             "--clientstore must be device|host|auto"
         assert self.clientstore_bytes >= 0, \
             "--clientstore_bytes must be >= 0"
+        assert self.probe_every >= 0, \
+            "--probe_every must be >= 0 (0 = probes off)"
+        assert self.on_divergence in ("log", "ledger-flag", "abort"), \
+            "--on_divergence must be log|ledger-flag|abort"
+        assert self.alarm_residual_rounds >= 1, \
+            "--alarm_residual_rounds must be >= 1"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -344,6 +373,13 @@ class Config:
                 "local error accumulation is pointless uncompressed " \
                 "(fed_worker.py:223-224)"
         return self
+
+    @property
+    def probe_period(self) -> int:
+        """Resolved probe cadence: 0 = probes off entirely;
+        --probe_full forces every-round probing regardless of
+        --probe_every."""
+        return 1 if self.probe_full else self.probe_every
 
     @property
     def resolved_num_clients(self) -> Optional[int]:
@@ -540,6 +576,29 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--telemetry_console", action="store_true",
                         help="print an end-of-run summary of the "
                         "round telemetry (span totals/means, bytes)")
+    parser.add_argument("--probe_every", type=int, default=0,
+                        help="algorithm probes (ledger schema v2): "
+                        "cheap norm/NaN probes every round, the "
+                        "sketch-recovery-error probe every N rounds "
+                        "(0 = probes off, no compiled overhead)")
+    parser.add_argument("--probe_full", action="store_true",
+                        help="shorthand for --probe_every 1")
+    parser.add_argument("--on_divergence", type=str, default="log",
+                        choices=["log", "ledger-flag", "abort"],
+                        help="alarm action when a probe rule fires "
+                        "(NaN/Inf, residual growth, recovery error): "
+                        "warn, flag the ledger record, or abort the "
+                        "run at the offending round")
+    parser.add_argument("--alarm_residual_ratio", type=float,
+                        default=2.0,
+                        help="fire when the error-feedback residual "
+                        "norm grows by more than this ratio for "
+                        "--alarm_residual_rounds consecutive rounds")
+    parser.add_argument("--alarm_residual_rounds", type=int, default=3)
+    parser.add_argument("--alarm_recovery_error", type=float,
+                        default=1.0,
+                        help="fire when relative sketch-recovery "
+                        "error exceeds this")
 
     return parser
 

@@ -81,6 +81,13 @@ class RoundResult(NamedTuple):
     # the sample-weighted mean of participating clients' batch
     # statistics this round (BatchNorm running-stats parity mode)
     bn_stats: Optional[tuple] = None
+    # schema-v2 client-pass probe scalars (--probe_every): aggregate
+    # norm + NaN/Inf counts, per-client transmit-norm statistics
+    # (paths that materialise per-client transmits), and — on probe
+    # cadence rounds in sketch mode — the true recovery error against
+    # the dense gradient. None unless the round was built with
+    # ``probes=True``; probes-off builds stay HLO-identical.
+    probes: Optional[dict] = None
 
 
 _AUTO_ROT_LANES = 1024
@@ -183,10 +190,23 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                        mesh=None, stats_fn: Callable = None,
                        tree_loss: Callable = None,
                        unravel: Callable = None,
-                       dense_rows: bool = False) -> Callable:
+                       dense_rows: bool = False,
+                       probes: bool = False,
+                       probe_recovery: bool = False) -> Callable:
     """Returns jit-able
     ``client_round(ps_weights, client_states, batch, client_ids, rng,
     fedavg_lr) -> RoundResult``.
+
+    ``probes=True`` fills ``RoundResult.probes`` with the cheap O(d)
+    diagnostics (aggregate norm/NaN/Inf, per-client transmit-norm
+    stats where per-client transmits exist). ``probe_recovery=True``
+    (sketch mode, the ``--probe_every`` cadence variant) additionally
+    computes the TRUE recovery error ‖unsketch(S(g)) − g‖/‖g‖ against
+    the dense aggregated gradient — paths where the dense aggregate
+    doesn't naturally exist materialise it only in this variant (the
+    clipped per-client-sketch path cannot and omits the key). Both are
+    trace-time flags: with both False the emitted program is identical
+    to a build without them.
 
     ``dense_rows``: host-clientstore mode (runtime/fed_model.py) — the
     ``client_states`` arrays hold ONLY the round's W participant rows
@@ -205,6 +225,9 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     back to sketch-of-local-sum without one.
     """
     cfg.validate_runtime()
+    # recovery needs probes on and a sketch to recover from
+    probe_recovery = bool(probes and probe_recovery
+                          and cfg.mode == "sketch")
     if loss_fn is None:
         # flat loss derived from the tree loss: callers holding a
         # pytree-level loss need not duplicate the unravel closure
@@ -254,12 +277,19 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     tree_sketch = (cfg.mode == "sketch" and tree_loss is not None
                    and unravel is not None)
 
-    def _fused_local(ps_weights, batch, total, n_shards):
+    def _fused_local(ps_weights, batch, total, n_shards,
+                     with_dense=False):
         """Fused backward over the clients in ``batch`` (all of them
         single-device; one device's shard under shard_map), already
         normalised by the GLOBAL datapoint total. The weight-decay
         term is split evenly across shards so the cross-shard sum
-        reconstructs (wd/num_workers)·p exactly once."""
+        reconstructs (wd/num_workers)·p exactly once.
+
+        ``with_dense`` (probe cadence rounds only) appends the dense
+        flat gradient to the return — the recovery-error probe's
+        ground truth. On the tree-sketch path this materialises the
+        (d,) concatenation the fast path exists to avoid; that cost is
+        paid only in the probed program variant."""
 
         def make_local_loss(fn):
             def local_loss(p):
@@ -294,8 +324,13 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                     lambda g, p: (g.astype(jnp.float32)
                                   + coef * p.astype(jnp.float32)),
                     g_tree, tree)
-            return sketch.sketch_from_leaves(
-                jax.tree_util.tree_leaves(g_tree)), metrics
+            leaves = jax.tree_util.tree_leaves(g_tree)
+            table = sketch.sketch_from_leaves(leaves)
+            if with_dense:
+                return table, metrics, jnp.concatenate(
+                    [jnp.ravel(l).astype(jnp.float32)
+                     for l in leaves])
+            return table, metrics
 
         (_, metrics), g = jax.value_and_grad(
             make_local_loss(loss_fn), has_aux=True)(ps_weights)
@@ -303,8 +338,10 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             # Σ_i (wd/num_workers)·p·n_i / total = (wd/num_workers)·p
             g = g + (cfg.weight_decay / cfg.num_workers
                      / n_shards) * ps_weights
-        return (sketch.sketch(g) if cfg.mode == "sketch" else g), \
-            metrics
+        t = sketch.sketch(g) if cfg.mode == "sketch" else g
+        if with_dense:
+            return t, metrics, g
+        return t, metrics
 
     def client_round_fused(ps_weights, client_states: ClientStates,
                            batch, client_ids, rng,
@@ -313,6 +350,11 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         W = client_ids.shape[0]
         total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
         ndev = mesh.devices.size if mesh is not None else 1
+        # recovery probe needs the dense aggregate next to the table;
+        # in non-sketch fused modes the aggregate IS dense and there
+        # is no recovery to measure
+        want_dense = probe_recovery and cfg.mode == "sketch"
+        dense_g = None
         if ndev > 1 and W % ndev == 0:
             from jax.sharding import PartitionSpec as P
 
@@ -329,23 +371,49 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 if hasattr(jax.lax, "pcast"):
                     p = jax.lax.pcast(p, CLIENT_AXIS, to="varying")
                 else:
-                    p = jax.lax.pvary(p, CLIENT_AXIS)
+                    from commefficient_tpu.compat import pvary
+                    p = pvary(p, CLIENT_AXIS)
+                if want_dense:
+                    # probed cadence round: the dense gradient crosses
+                    # the ICI too — the one round where uncompressed
+                    # traffic is the price of the ground-truth probe
+                    t, metrics, g = _fused_local(p, local_batch, tot,
+                                                 ndev, with_dense=True)
+                    return (jax.lax.psum(t, CLIENT_AXIS),
+                            jax.lax.psum(g, CLIENT_AXIS), metrics)
                 t, metrics = _fused_local(p, local_batch, tot, ndev)
                 # the round's ONE all-reduce (reference
                 # fed_worker.py:139-140 NCCL reduce): sketch tables in
                 # sketch mode — inter-chip traffic stays compressed
                 return jax.lax.psum(t, CLIENT_AXIS), metrics
 
-            aggregated, metrics = shard_map(
-                block, mesh=mesh,
-                in_specs=(P(), P(CLIENT_AXIS), P()),
-                out_specs=(P(), P(CLIENT_AXIS)))(ps_weights, batch,
-                                                 total)
+            if want_dense:
+                aggregated, dense_g, metrics = shard_map(
+                    block, mesh=mesh,
+                    in_specs=(P(), P(CLIENT_AXIS), P()),
+                    out_specs=(P(), P(), P(CLIENT_AXIS)))(
+                        ps_weights, batch, total)
+            else:
+                aggregated, metrics = shard_map(
+                    block, mesh=mesh,
+                    in_specs=(P(), P(CLIENT_AXIS), P()),
+                    out_specs=(P(), P(CLIENT_AXIS)))(ps_weights, batch,
+                                                     total)
+        elif want_dense:
+            aggregated, metrics, dense_g = _fused_local(
+                ps_weights, batch, total, 1, with_dense=True)
         else:
             aggregated, metrics = _fused_local(ps_weights, batch,
                                                total, 1)
+        pr = None
+        if probes:
+            pr = _agg_probes(aggregated)
+            if dense_g is not None:
+                pr["recovery_error"] = sketch.recovery_error(
+                    aggregated, dense_g, cfg.k)
         return RoundResult(aggregated, metrics, client_states,
-                           _round_bn_stats(stats_fn, ps_weights, batch))
+                           _round_bn_stats(stats_fn, ps_weights, batch),
+                           probes=pr)
 
     def client_round(ps_weights, client_states: ClientStates, batch,
                      client_ids, rng, fedavg_lr=1.0) -> RoundResult:
@@ -395,13 +463,27 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         else:
             aggregated = jnp.sum(transmit, axis=0) / total
 
+        pr = None
+        if probes:
+            pr = _agg_probes(aggregated)
+            pr.update(_client_norm_probes(transmit, batch))
+            if probe_recovery and sketch_late:
+                # the dense transmits exist on this path anyway, so
+                # the ground-truth aggregate is one extra sum; the
+                # clipped per-client-sketch path (max_grad_norm set)
+                # has no dense gradient to compare against and omits
+                # the key
+                dense_g = jnp.sum(transmit, axis=0) / total
+                pr["recovery_error"] = sketch.recovery_error(
+                    aggregated, dense_g, cfg.k)
         states = ClientStates(
             _scatter(client_states.velocities, client_ids, new_vel),
             _scatter(client_states.errors, client_ids, new_err),
             _scatter(client_states.weights, client_ids, new_wts),
         )
         return RoundResult(aggregated, metrics, states,
-                           _round_bn_stats(stats_fn, ps_weights, batch))
+                           _round_bn_stats(stats_fn, ps_weights, batch),
+                           probes=pr)
 
     def _client_round_chunked(ps_weights, client_states, batch,
                               client_ids, rngs, fedavg_lr, chunk):
@@ -460,42 +542,105 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 _scatter(states.errors, ids_c, new_err),
                 _scatter(states.weights, ids_c, new_wts),
             )
-            return (acc + jnp.sum(transmit, axis=0), states), metrics
+            ys = metrics
+            if probes:
+                # per-client transmit norms ride the scan's stacked
+                # outputs like the metrics do
+                norms = jnp.sqrt(jnp.sum(jax.lax.square(
+                    transmit.reshape(chunk, -1)), axis=1))
+                ys = (metrics, norms)
+            return (acc + jnp.sum(transmit, axis=0), states), ys
 
-        if sketch_late:
+        dense_g = None
+        if sketch_late and not probe_recovery:
             # chunked + sketch-late: sketch each chunk's dense sum and
             # accumulate tables (linearity) — the (W, d) transmit
             # stack never exists
             def body_sketch(carry, inp):
                 table_acc, states = carry
-                ids_c, rngs_c, batch_c = inp
-                (chunk_sum, states), metrics = body(
+                (chunk_sum, states), ys = body(
                     (jnp.zeros(cfg.grad_size, jnp.float32), states),
                     inp)
                 return (table_acc + sketch.sketch(chunk_sum),
-                        states), metrics
+                        states), ys
 
-            (table, states), metrics = jax.lax.scan(
+            (table, states), ys = jax.lax.scan(
                 body_sketch,
                 (jnp.zeros((sketch.r, sketch.c), jnp.float32),
                  client_states),
                 (ids_p, rngs_p, batch_p))
             aggregated = table / total
         else:
-            # transmit_shape covers both dense (d,) transmits and the
-            # (r, c) tables of the clipped (non-late) sketch path
-            (acc, states), metrics = jax.lax.scan(
+            # dense accumulator: transmit_shape covers both dense (d,)
+            # transmits and the (r, c) tables of the clipped (non-late)
+            # sketch path; the sketch-late PROBED variant accumulates
+            # dense and sketches once at the end (linearity — same
+            # table as per-chunk accumulation) so the recovery probe's
+            # ground truth exists without a (W, d) stack
+            init_shape = ((cfg.grad_size,) if sketch_late
+                          else cfg.transmit_shape)
+            (acc, states), ys = jax.lax.scan(
                 body,
-                (jnp.zeros(cfg.transmit_shape, jnp.float32),
-                 client_states),
+                (jnp.zeros(init_shape, jnp.float32), client_states),
                 (ids_p, rngs_p, batch_p))
-            aggregated = acc / total
+            if sketch_late:
+                aggregated = sketch.sketch(acc) / total
+                dense_g = acc / total
+            else:
+                aggregated = acc / total
 
+        if probes:
+            metrics, norms = ys
+        else:
+            metrics = ys
         metrics = tuple(m.reshape(-1)[:W] for m in metrics)
+        pr = None
+        if probes:
+            pr = _agg_probes(aggregated)
+            pr.update(_client_norm_stats(norms.reshape(-1)[:W], batch))
+            if dense_g is not None:
+                pr["recovery_error"] = sketch.recovery_error(
+                    aggregated, dense_g, cfg.k)
         return RoundResult(aggregated, metrics, states,
-                           _round_bn_stats(stats_fn, ps_weights, batch))
+                           _round_bn_stats(stats_fn, ps_weights, batch),
+                           probes=pr)
 
     return client_round_fused if fused_grad else client_round
+
+
+def _agg_probes(aggregated) -> dict:
+    """O(d) reductions over the round's aggregated transmit (dense
+    vector or sketch table): its norm plus NaN/Inf element counts —
+    the cheapest possible per-round health signal, compiled into the
+    round program so no extra device round-trip is ever taken."""
+    return {
+        "agg_norm": jnp.sqrt(jnp.sum(jax.lax.square(aggregated))),
+        "agg_nan": jnp.sum(jnp.isnan(aggregated)).astype(jnp.float32),
+        "agg_inf": jnp.sum(jnp.isinf(aggregated)).astype(jnp.float32),
+    }
+
+
+def _client_norm_stats(norms, batch) -> dict:
+    """Mean/max/std of per-client transmit norms over ALIVE clients
+    (dead dropout/padding slots transmit zero and are excluded from
+    mean/std; the max is alive-masked for the same reason). The
+    dispersion is the population std — a sudden spread blow-up is the
+    straggler/poisoned-client signature."""
+    alive = jax.vmap(
+        lambda b: jnp.sum(b["mask"]) > 0)(batch).astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(alive), 1.0)
+    mean = jnp.sum(norms * alive) / n
+    var = jnp.sum(alive * jax.lax.square(norms - mean)) / n
+    return {"client_norm_mean": mean,
+            "client_norm_max": jnp.max(norms * alive),
+            "client_norm_std": jnp.sqrt(jnp.maximum(var, 0.0))}
+
+
+def _client_norm_probes(transmit, batch) -> dict:
+    W = transmit.shape[0]
+    norms = jnp.sqrt(jnp.sum(jax.lax.square(
+        transmit.reshape(W, -1)), axis=1))
+    return _client_norm_stats(norms, batch)
 
 
 def _round_bn_stats(stats_fn, ps_weights, batch):
@@ -697,7 +842,7 @@ def build_val_fn(cfg: Config, loss_fn: Callable,
     return val_shards
 
 
-def build_server_round(cfg: Config) -> Callable:
+def build_server_round(cfg: Config, probes: bool = False) -> Callable:
     """Returns jit-able ``server_round(ps_weights, server_state,
     aggregated, lr, client_velocities, client_ids, noise_rng) ->
     (new_ps_weights, new_server_state, new_client_velocities,
@@ -709,6 +854,10 @@ def build_server_round(cfg: Config) -> Callable:
     None on the large-d sparse sketch path (prefer_sparse_resketch):
     the update was applied as a k-sized scatter and only ``support``
     (tuple form there) carries its values.
+
+    ``probes=True`` appends a sixth output — the server-side probe
+    dict (core/server.py server_update) — so the default arity stays
+    five and probes-off callers build a bit-identical program.
 
     Covers FedOptimizer.step (fed_aggregator.py:431-460) including
     true_topk's masking of participating clients' local velocities at
@@ -724,7 +873,8 @@ def build_server_round(cfg: Config) -> Callable:
                      noise_rng=None):
         eff_lr = 1.0 if cfg.mode == "fedavg" else lr
         res: ServerUpdate = server_update(cfg, aggregated, server_state,
-                                          eff_lr, sketch, noise_rng)
+                                          eff_lr, sketch, noise_rng,
+                                          probes=probes)
         if res.weight_update is None:
             # large-d k-sparse modes: the support already carries the
             # lr-scaled update values — apply them as a k-sized
@@ -757,6 +907,8 @@ def build_server_round(cfg: Config) -> Callable:
             rows = client_velocities[client_ids]
             rows = rows * res.client_velocity_keep.astype(rows.dtype)
             new_vel = client_velocities.at[client_ids].set(rows)
-        return new_ps, res.state, new_vel, res.weight_update, res.support
+        out = (new_ps, res.state, new_vel, res.weight_update,
+               res.support)
+        return out + (res.probes,) if probes else out
 
     return server_round

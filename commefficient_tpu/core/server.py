@@ -57,6 +57,11 @@ class ServerUpdate(NamedTuple):
     # host never needs the dense update shipped off device.
     support: Optional[Union[Tuple[jax.Array, jax.Array],
                             dict]] = None
+    # schema-v2 probe scalars (--probe_every): update/residual/momentum
+    # norms + selection mass coverage, computed inside the compiled
+    # step as O(d) reductions. None unless the caller opted in — the
+    # probes-off program must stay HLO-identical to pre-probe builds.
+    probes: Optional[dict] = None
 
 
 def _use_threshold_select(cfg: Config) -> bool:
@@ -78,18 +83,41 @@ def _lr_scaled_support(idx, vals, lr):
     return idx, vals * scale
 
 
+def _l2(x) -> jax.Array:
+    return jnp.sqrt(jnp.sum(jax.lax.square(x)))
+
+
+def _coverage(selected_mass, dense_mass) -> jax.Array:
+    """‖selected‖² / ‖dense‖² — the fraction of the pre-selection
+    vector's energy the transmitted top-k/threshold support carries.
+    A zero denominator (cold-start buffers) reads as full coverage."""
+    return jnp.where(dense_mass > 0,
+                     selected_mass / jnp.maximum(dense_mass, 1e-30),
+                     1.0)
+
+
 def server_update(cfg: Config,
                   gradient: jax.Array,
                   state: ServerState,
                   lr,
                   sketch: Optional[CountSketch] = None,
-                  noise_rng: Optional[jax.Array] = None) -> ServerUpdate:
+                  noise_rng: Optional[jax.Array] = None,
+                  probes: bool = False) -> ServerUpdate:
     """Dispatch on mode (reference get_server_update,
     fed_aggregator.py:471-483). ``lr`` may be a scalar or a
     (grad_size,) per-parameter vector (per-param-group LRs,
     fed_aggregator.py:413-429). For fedavg the caller passes lr=1 —
     the LR was already applied in the clients' local SGD
-    (fed_aggregator.py:448-453)."""
+    (fed_aggregator.py:448-453).
+
+    ``probes=True`` (a trace-time flag) additionally fills
+    ``ServerUpdate.probes`` with the schema-v2 server diagnostics:
+    ``update_norm`` (‖lr-scaled weight update‖), ``residual_norm``
+    (‖post-mask Verror‖ — table-space in sketch mode),
+    ``momentum_norm`` (‖post-mask Vvelocity‖) and, for the selecting
+    modes, ``mass_coverage`` (‖selected‖²/‖dense‖² against the
+    pre-selection residual, sketch mode estimating the denominator via
+    ``l2estimate``)."""
     helper = {
         "sketch": _sketched,
         "local_topk": _local_topk,
@@ -97,18 +125,31 @@ def server_update(cfg: Config,
         "fedavg": _fedavg,
         "uncompressed": _uncompressed,
     }[cfg.mode]
-    return helper(cfg, gradient, state, lr, sketch, noise_rng)
+    return helper(cfg, gradient, state, lr, sketch, noise_rng, probes)
 
 
-def _fedavg(cfg, avg_update, state, lr, sketch, noise_rng):
+def _state_probes(update_norm, state: ServerState, extra=None) -> dict:
+    pr = {"update_norm": update_norm,
+          "momentum_norm": _l2(state.Vvelocity),
+          "residual_norm": _l2(state.Verror)}
+    if extra:
+        pr.update(extra)
+    return pr
+
+
+def _fedavg(cfg, avg_update, state, lr, sketch, noise_rng,
+            probes=False):
     # (fed_aggregator.py:485-497) — avg_update is the data-weighted
     # mean of client weight *deltas*, LR already applied locally
     assert cfg.error_type == "none" and cfg.local_momentum == 0
     Vvel = avg_update + cfg.virtual_momentum * state.Vvelocity
-    return ServerUpdate(Vvel, ServerState(Vvel, state.Verror), None)
+    new_state = ServerState(Vvel, state.Verror)
+    pr = _state_probes(_l2(Vvel), new_state) if probes else None
+    return ServerUpdate(Vvel, new_state, None, probes=pr)
 
 
-def _uncompressed(cfg, gradient, state, lr, sketch, noise_rng):
+def _uncompressed(cfg, gradient, state, lr, sketch, noise_rng,
+                  probes=False):
     # (fed_aggregator.py:499-511)
     Vvel = gradient + cfg.virtual_momentum * state.Vvelocity
     if cfg.do_dp and cfg.dp_mode == "server" and cfg.noise_multiplier != 0:
@@ -119,10 +160,13 @@ def _uncompressed(cfg, gradient, state, lr, sketch, noise_rng):
         # noise persists into the momentum buffer — keep that
         Vvel = Vvel + cfg.noise_multiplier * jax.random.normal(
             noise_rng, Vvel.shape, Vvel.dtype)
-    return ServerUpdate(Vvel * lr, ServerState(Vvel, state.Verror), None)
+    new_state = ServerState(Vvel, state.Verror)
+    pr = _state_probes(_l2(Vvel * lr), new_state) if probes else None
+    return ServerUpdate(Vvel * lr, new_state, None, probes=pr)
 
 
-def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
+def _true_topk(cfg, gradient, state, lr, sketch, noise_rng,
+               probes=False):
     # (fed_aggregator.py:513-544)
     assert cfg.error_type == "virtual"
     Vvel = gradient + cfg.virtual_momentum * state.Vvelocity
@@ -143,28 +187,40 @@ def _true_topk(cfg, gradient, state, lr, sketch, noise_rng):
         update, idx, vals = topk_with_support(
             Verr, k, approx=cfg.approx_topk, recall=cfg.approx_recall)
         support = _lr_scaled_support(idx, vals, lr)
+    dense_mass = jnp.sum(jax.lax.square(Verr)) if probes else None
     keep = update == 0
     # error feedback + momentum factor masking at transmitted coords
     Verr = jnp.where(keep, Verr, 0.0)
     Vvel = jnp.where(keep, Vvel, 0.0)
+    new_state = ServerState(Vvel, Verr)
+    pr = None
+    if probes:
+        pr = _state_probes(
+            _l2(update * lr), new_state,
+            {"mass_coverage": _coverage(
+                jnp.sum(jax.lax.square(update)), dense_mass)})
     # participating clients' *local* velocities are masked at the same
     # coords by the round engine (the reference does this from the
     # optimizer via globals; here the mask travels in the result —
     # avoiding the reference's latent unset-global bug, SURVEY.md §2.1)
-    return ServerUpdate(update * lr, ServerState(Vvel, Verr), keep,
-                        support)
+    return ServerUpdate(update * lr, new_state, keep, support,
+                        probes=pr)
 
 
-def _local_topk(cfg, local_topk_grad, state, lr, sketch, noise_rng):
+def _local_topk(cfg, local_topk_grad, state, lr, sketch, noise_rng,
+                probes=False):
     # (fed_aggregator.py:546-568): momentum accumulation only; virtual
     # error is impossible (the transmitted quantity is already sparse)
     # and masking virtual momentum would zero all of it every round
     assert cfg.error_type in ("local", "none")
     Vvel = local_topk_grad + cfg.virtual_momentum * state.Vvelocity
-    return ServerUpdate(Vvel * lr, ServerState(Vvel, state.Verror), None)
+    new_state = ServerState(Vvel, state.Verror)
+    pr = _state_probes(_l2(Vvel * lr), new_state) if probes else None
+    return ServerUpdate(Vvel * lr, new_state, None, probes=pr)
 
 
-def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng):
+def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng,
+              probes=False):
     """FetchSGD server step (fed_aggregator.py:570-615): momentum and
     error accumulation happen in (r, c) sketch-table space; top-k
     recovery via unsketch; error feedback and momentum factor masking
@@ -193,16 +249,24 @@ def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng):
     # mask instead of the top-k sort (22.3 -> ~11 ms full round at
     # ResNet9 scale, BENCHMARKS.md).
     sparse = sketch.prefer_sparse_resketch(cfg.k)
+    # pre-mask residual mass for the coverage probe: the true dense
+    # residual never exists in sketch mode, so its energy comes from
+    # the table's own l2estimate (unbiased median-of-rows)
+    dense_mass = (jax.lax.square(CountSketch.l2estimate(Verr))
+                  if probes else None)
     if sketch.prefer_threshold_unsketch(cfg.k):  # implies not sparse
         update, _ = sketch.unsketch_dense_mask(Verr, k=cfg.k)
         # bit-packed support of the LR-scaled update: same value-
         # compare semantics as _lr_scaled_support
         support = {"bitmap": jnp.packbits((update * lr) != 0)}
+        sel_mass = (jnp.sum(jax.lax.square(update)) if probes
+                    else None)
     else:
         update, idx, vals = sketch.unsketch(Verr, k=cfg.k,
                                             with_support=True,
                                             with_dense=not sparse)
         support = _lr_scaled_support(idx, vals, lr)
+        sel_mass = jnp.sum(jax.lax.square(vals)) if probes else None
 
     # re-sketch the recovered update to find which table buckets it
     # occupies (fed_aggregator.py:595-597)
@@ -221,11 +285,20 @@ def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng):
     if cfg.error_type == "local":
         Verr = Vvel
 
+    new_state = ServerState(Vvel, Verr)
+    pr = None
+    if probes:
+        # update_norm from the lr-scaled support on the sparse path —
+        # the dense update is never materialised there
+        upd_norm = (_l2(support[1]) if sparse else _l2(update * lr))
+        pr = _state_probes(
+            upd_norm, new_state,
+            {"mass_coverage": _coverage(sel_mass, dense_mass)})
     if sparse:
         # weight_update None: the server round applies the update as a
         # k-sized scatter of the (already lr-scaled) support instead
         # of materialising the dense (d,) vector
-        return ServerUpdate(None, ServerState(Vvel, Verr), None,
-                            support)
-    return ServerUpdate(update * lr, ServerState(Vvel, Verr), None,
-                        support)
+        return ServerUpdate(None, new_state, None, support,
+                            probes=pr)
+    return ServerUpdate(update * lr, new_state, None, support,
+                        probes=pr)

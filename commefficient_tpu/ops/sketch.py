@@ -593,6 +593,20 @@ class CountSketch:
         estimate of ‖v‖₂ (reference utils.py:309 via CSVec.l2estimate)."""
         return jnp.sqrt(jnp.median(jnp.sum(jax.lax.square(table), axis=1)))
 
+    def recovery_error(self, table: jax.Array, dense: jax.Array,
+                       k: int) -> jax.Array:
+        """Relative top-k recovery error ‖unsketch(S(v)) − v‖ / ‖v‖
+        of this operator against the TRUE dense vector — the ground-
+        truth fidelity probe (--probe_full). 0 would be lossless; the
+        top-k floor is sqrt(1 − ‖v_topk‖²/‖v‖²) for an exact sketch,
+        so values near 1 mean the recovered heavy hitters carry almost
+        none of the vector's mass. A zero vector reports 0."""
+        assert dense.shape == (self.d,), dense.shape
+        est = self.unsketch(table, k)
+        num = jnp.linalg.norm(est - dense.astype(jnp.float32))
+        den = jnp.linalg.norm(dense.astype(jnp.float32))
+        return jnp.where(den > 0, num / jnp.maximum(den, 1e-30), 0.0)
+
 
 def clip_record(record: jax.Array, clip: float, *, is_sketch: bool) -> jax.Array:
     """Reference ``clip_grad`` (utils.py:305-313): L2-clip a dense

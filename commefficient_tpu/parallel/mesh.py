@@ -28,9 +28,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.8 moved shard_map out of experimental
     from jax import shard_map as _sm
-    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+    _shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # noqa: F401
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if hasattr(jax.lax, "pvary"):
+    shard_map = _shard_map
+else:
+    # pre-varying-axes jax: check_rep can't see through the explicit
+    # psum that replicates our P() outputs (no pvary/pcast types to
+    # track), so the static check must be disabled — the collectives
+    # themselves are unchanged
+    import functools as _functools
+    shard_map = _functools.partial(_shard_map, check_rep=False)
 
 CLIENT_AXIS = "clients"
 

@@ -190,13 +190,31 @@ class FedModel:
         def loss_tree(params_tree, batch, loss=compute_loss):
             return loss(params_tree, batch, args)
 
-        self._client_round = jax.jit(
-            build_client_round(args, None, padded_batch_size,
-                               mesh=self.mesh, stats_fn=stats_fn_flat,
-                               tree_loss=loss_tree,
-                               unravel=self.unravel,
-                               dense_rows=(self.clientstore == "host")),
-            donate_argnums=(1,))
+        # --probe_every/--probe_full: algorithm probes compile INTO
+        # the round program (core/rounds.py). Two jitted variants when
+        # the expensive recovery probe applies: the cheap one runs
+        # off-cadence rounds, the recovery one every probe_period-th
+        # round. jit is lazy, so a variant never dispatched never
+        # compiles (probe_period == 1 only ever compiles the full one).
+        self.probe_period = int(getattr(args, "probe_period", 0) or 0)
+        probes_on = self.probe_period > 0
+
+        def _build_round(with_probes, with_recovery):
+            return jax.jit(
+                build_client_round(
+                    args, None, padded_batch_size,
+                    mesh=self.mesh, stats_fn=stats_fn_flat,
+                    tree_loss=loss_tree,
+                    unravel=self.unravel,
+                    dense_rows=(self.clientstore == "host"),
+                    probes=with_probes,
+                    probe_recovery=with_recovery),
+                donate_argnums=(1,))
+
+        self._client_round = _build_round(probes_on, False)
+        self._client_round_probed = (
+            _build_round(True, True)
+            if probes_on and args.mode == "sketch" else None)
         if stats_fn is not None:
             self._val_fn = jax.jit(build_val_fn(
                 args, loss_flat_val_state, stateful=True))
@@ -241,6 +259,17 @@ class FedModel:
         # the accounting above, memory/compile watermarks. Disabled
         # (no --ledger/--telemetry_console) it's a no-op fast path.
         self.telemetry = build_telemetry(args)
+        # probe bookkeeping: _probe_host holds materialised client-
+        # pass values until the server pass completes the round's dict
+        # (sync path); _probe_log holds DEVICE scalars for pipelined
+        # rounds, materialised at flush replay. The alarm engine is
+        # None with probes off; it evaluates even without sinks, so
+        # --on_divergence abort works ledgerless.
+        self._probe_host = {}
+        self._probe_log = {}
+        self._prev_residual = None
+        from commefficient_tpu.telemetry.alarms import build_alarm_engine
+        self.alarm_engine = build_alarm_engine(args, self.telemetry)
         self.telemetry.emit_meta(
             num_clients=num_clients,
             num_devices=int(np.prod(self.mesh.devices.shape)),
@@ -438,10 +467,14 @@ class FedModel:
                 rows = self._gather_rows(ids_np)
             with tel.span("h2d_state"):
                 cs_in = self._rows_to_states(rows)
+        round_fn = self._client_round
+        if (self._client_round_probed is not None
+                and ridx % self.probe_period == 0):
+            round_fn = self._client_round_probed
         with tel.span("round_dispatch"):
-            res = self._client_round(self.ps_weights, cs_in,
-                                     dev_batch, ids, rng,
-                                     jnp.float32(self.fedavg_lr))
+            res = round_fn(self.ps_weights, cs_in,
+                           dev_batch, ids, rng,
+                           jnp.float32(self.fedavg_lr))
         self.client_states = res.client_states
         self.pending_aggregated = res.aggregated
         # dead slots (dropout / loader padding) must carry the
@@ -478,13 +511,25 @@ class FedModel:
         if self.pipeline_depth > 1:
             # bytes for this round attach at flush() replay — the
             # ledger record stays buffered (round order preserved)
-            # until then
+            # until then; probe scalars stay DEVICE arrays in
+            # _probe_log (no sync) and materialise at the same replay
             self._oplog.append(("account", ids_np,
                                 np.asarray(batch["mask"]), ridx))
             self._inflight.append(list(res.metrics))
+            if res.probes is not None:
+                self._probe_log.setdefault(ridx, {}).update(res.probes)
             return None
         with tel.span("metrics_host"):
             metrics = [_host(m) for m in res.metrics]
+            probe_vals = (None if res.probes is None else
+                          {k: float(_host(v))
+                           for k, v in res.probes.items()})
+        if probe_vals is not None:
+            # merge now (so eval-only callers still get them on the
+            # ledger); the server pass completes the dict and runs the
+            # alarms via _finish_probes
+            tel.merge_round_probes(ridx, probe_vals)
+            self._probe_host[ridx] = probe_vals
         down, up = self._account_bytes(ids_np, batch["mask"])
         tel.set_round_bytes(ridx, float(down.sum()), float(up.sum()))
         return metrics + [down, up]
@@ -506,6 +551,14 @@ class FedModel:
         results = []
         for op in oplog:
             if op[0] == "account":
+                # probes must land on the record BEFORE its bytes:
+                # set_round_bytes makes the record emission-eligible
+                pd = self._probe_log.pop(op[3], None)
+                if pd is not None:
+                    with self.telemetry.span("metrics_host"):
+                        vals = {k: float(_host(v))
+                                for k, v in pd.items()}
+                    self._finish_probes(op[3], vals)
                 down, up = self._account_bytes(op[1], op[2])
                 self.telemetry.set_round_bytes(
                     op[3], float(down.sum()), float(up.sum()))
@@ -513,6 +566,26 @@ class FedModel:
             else:
                 self._apply_note(op[1])
         return results
+
+    def _finish_probes(self, ridx: int, vals: dict):
+        """Complete round ``ridx``'s probe dict host-side: fold in any
+        stashed client-pass values, derive the residual growth ratio
+        from the previous round's residual norm (rounds are finished
+        in dispatch order on both the sync and flush-replay paths, so
+        the ratio is always consecutive-round), merge onto the ledger
+        record, and evaluate the alarm rules — which may raise
+        DivergenceAbort under ``--on_divergence abort``."""
+        full = self._probe_host.pop(ridx, {})
+        full.update(vals)
+        rn = full.get("residual_norm")
+        if rn is not None:
+            prev = self._prev_residual
+            if prev is not None and prev > 0:
+                full["residual_growth"] = rn / prev
+            self._prev_residual = rn
+        self.telemetry.merge_round_probes(ridx, full)
+        if self.alarm_engine is not None:
+            self.alarm_engine.check(ridx, full)
 
     def _rebuild_round_counts(self):
         """Histogram of ``last_updated`` by round (index = round + 1).
@@ -655,8 +728,11 @@ class FedOptimizer:
         # donate weights + server state: both are replaced by the
         # round's outputs and the stale buffers are never read again —
         # at GPT-2 scale that's ~1 GB of peak HBM saved per step
-        self._server_round = jax.jit(build_server_round(self.args),
-                                     donate_argnums=(0, 1))
+        self._probes = int(getattr(self.args, "probe_period", 0)
+                           or 0) > 0
+        self._server_round = jax.jit(
+            build_server_round(self.args, probes=self._probes),
+            donate_argnums=(0, 1))
         self._noise_rng = jax.random.PRNGKey(self.args.seed + 1)
         self._step_count = 0
 
@@ -700,13 +776,18 @@ class FedOptimizer:
         # _call_train's begin_round closes it), so the server span
         # lands on the round whose aggregate it consumes
         with m.telemetry.span("server"):
-            new_ps, self.server_state, new_vel, update, support = \
-                self._server_round(
-                    m.ps_weights, self.server_state,
-                    m.pending_aggregated,
-                    jnp.asarray(lr, jnp.float32),
-                    m.client_states.velocities, m.pending_client_ids,
-                    noise_rng)
+            out = self._server_round(
+                m.ps_weights, self.server_state,
+                m.pending_aggregated,
+                jnp.asarray(lr, jnp.float32),
+                m.client_states.velocities, m.pending_client_ids,
+                noise_rng)
+        sprobes = None
+        if self._probes:
+            new_ps, self.server_state, new_vel, update, support, \
+                sprobes = out
+        else:
+            new_ps, self.server_state, new_vel, update, support = out
         m.ps_weights = new_ps
         if new_vel is not None:
             m.client_states = m.client_states._replace(
@@ -736,6 +817,19 @@ class FedOptimizer:
                 # local_topk wall time at d=6.6M on the relay)
                 support = {"bitmap": jnp.packbits(update != 0)}
         m.note_update(support)
+        if sprobes is not None:
+            # the round this server pass belongs to (round_index was
+            # already advanced by _call_train)
+            sridx = m.round_index - 1
+            if m.pipeline_depth > 1:
+                # stay on device: values cross at flush replay, in
+                # round order, together with the client-pass probes
+                m._probe_log.setdefault(sridx, {}).update(sprobes)
+            else:
+                with m.telemetry.span("metrics_host"):
+                    svals = {k: float(_host(v))
+                             for k, v in sprobes.items()}
+                m._finish_probes(sridx, svals)
 
     def zero_grad(self):
         raise NotImplementedError(

@@ -83,18 +83,35 @@ class SeqParallelFedModel(FedModel):
             ignore_index=-1, tokens_per_chunk=args.tokens_per_chunk)
         sketch = args2sketch(args)
         wd = args.weight_decay / max(args.num_workers, 1)
+        probes_on = self.probe_period > 0
 
-        @jax.jit
-        def round_and_compress(ps, batch):
-            agg, loss = sp_round(ps, batch)
-            if wd > 0:  # 1-D engine's effective decay (core/grad.py)
-                agg = agg + wd * ps
-            if sketch is not None:
-                # linearity: sketch(mean of grads) == mean of sketches
-                agg = sketch.sketch(agg)
-            return agg, loss
+        def make_round(with_recovery):
+            @jax.jit
+            def round_and_compress(ps, batch):
+                agg, loss = sp_round(ps, batch)
+                if wd > 0:  # 1-D engine's effective decay (core/grad.py)
+                    agg = agg + wd * ps
+                dense = agg
+                if sketch is not None:
+                    # linearity: sketch(mean of grads) == mean of
+                    # sketches
+                    agg = sketch.sketch(dense)
+                pr = None
+                if probes_on:
+                    from commefficient_tpu.core.rounds import _agg_probes
+                    pr = _agg_probes(agg)
+                    if with_recovery and sketch is not None:
+                        # the dense aggregate exists pre-sketch on
+                        # this path, so ground truth is free here
+                        pr["recovery_error"] = sketch.recovery_error(
+                            agg, dense, args.k)
+                return agg, loss, pr
+            return round_and_compress
 
-        self._sp_round = round_and_compress
+        self._sp_round = make_round(False)
+        self._sp_round_probed = (
+            make_round(True)
+            if probes_on and sketch is not None else None)
 
     def _call_train(self, batch):
         tel = self.telemetry
@@ -116,9 +133,13 @@ class SeqParallelFedModel(FedModel):
                 "mc_labels": jnp.asarray(batch["mc_labels"]),
                 "mask": jnp.asarray(batch["mask"]),
             }
+        round_fn = self._sp_round
+        if (self._sp_round_probed is not None
+                and ridx % self.probe_period == 0):
+            round_fn = self._sp_round_probed
         with tel.span("round_dispatch"):
-            agg, per_client_loss = self._sp_round(self.ps_weights,
-                                                  sp_batch)
+            agg, per_client_loss, probes = round_fn(self.ps_weights,
+                                                    sp_batch)
         self.pending_aggregated = agg
         self.pending_client_ids = jnp.asarray(ids_np, jnp.int32)
         self.round_index += 1
@@ -130,6 +151,12 @@ class SeqParallelFedModel(FedModel):
         from commefficient_tpu.runtime.fed_model import _host
         with tel.span("metrics_host"):
             metrics = [np.asarray(_host(per_client_loss), np.float64)]
+            probe_vals = (None if probes is None else
+                          {k: float(_host(v))
+                           for k, v in probes.items()})
+        if probe_vals is not None:
+            tel.merge_round_probes(ridx, probe_vals)
+            self._probe_host[ridx] = probe_vals
         down, up = self._account_bytes(ids_np, batch["mask"])
         tel.set_round_bytes(ridx, float(down.sum()), float(up.sum()))
         return metrics + [down, up]

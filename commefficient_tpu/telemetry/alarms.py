@@ -1,0 +1,128 @@
+"""Declarative probe alarms (``--on_divergence``).
+
+The probe layer (core/rounds.py + core/server.py, schema-v2 records)
+gives every round a handful of host-side scalars; this module turns
+them into actions so unattended runs fail loudly at the offending
+round instead of silently training on garbage. Three rules:
+
+``nan_inf``          — any NaN/Inf in the round's aggregated transmit
+                       (``agg_nan`` + ``agg_inf`` > 0).
+``residual_growth``  — the error-feedback residual norm grew by more
+                       than ``--alarm_residual_ratio`` for
+                       ``--alarm_residual_rounds`` CONSECUTIVE probed
+                       rounds (one bad round is normal early in
+                       training; a sustained geometric climb is the
+                       EF-SGD divergence signature).
+``recovery_error``   — relative sketch-recovery error above
+                       ``--alarm_recovery_error`` (or non-finite);
+                       1.0 means the recovered top-k is no better
+                       than applying nothing.
+
+Every fired rule is appended to the round record's ``alarms`` list
+(when a ledger is attached) regardless of action. The action then
+escalates: ``log`` warns, ``ledger-flag`` stays silent outside the
+ledger, ``abort`` raises :class:`DivergenceAbort` — the trainers
+catch it, flush telemetry (the flagged record becomes the run's final
+round record) and stop, exactly like the existing NaN-loss path.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+logger = logging.getLogger("commefficient_tpu.telemetry.alarms")
+
+ACTIONS = ("log", "ledger-flag", "abort")
+
+
+class DivergenceAbort(RuntimeError):
+    """A probe alarm fired under ``--on_divergence abort``."""
+
+    def __init__(self, round_index: int, alarms):
+        self.round_index = int(round_index)
+        self.alarms = list(alarms)
+        rules = ", ".join(a["rule"] for a in self.alarms)
+        super().__init__(
+            f"probe alarm(s) [{rules}] at round {round_index}")
+
+
+def _finite(v):
+    return v is not None and math.isfinite(v)
+
+
+class AlarmEngine:
+    """Evaluates the alarm rules against each round's probe dict.
+
+    Stateful only for the consecutive-rounds residual rule; one
+    engine observes one run. ``telemetry`` may be a disabled
+    Telemetry (alarms still evaluate and can still abort — the
+    ledger flag is just unrecorded)."""
+
+    def __init__(self, cfg, telemetry=None):
+        assert cfg.on_divergence in ACTIONS, cfg.on_divergence
+        self.action = cfg.on_divergence
+        self.residual_ratio = float(cfg.alarm_residual_ratio)
+        self.residual_rounds = int(cfg.alarm_residual_rounds)
+        self.recovery_error = float(cfg.alarm_recovery_error)
+        self.telemetry = telemetry
+        self._consecutive = 0
+
+    def check(self, round_index: int, probes) -> list:
+        """Run every rule on one round's probes. Returns the fired
+        alarm dicts (empty for a healthy round); flags them on the
+        ledger record, then escalates per the configured action —
+        ``abort`` raises :class:`DivergenceAbort` AFTER flagging so
+        the record that reaches the sink carries its alarms."""
+        if not probes:
+            return []
+        fired = []
+
+        bad = (probes.get("agg_nan") or 0) + (probes.get("agg_inf")
+                                              or 0)
+        if bad > 0:
+            fired.append({"rule": "nan_inf", "value": float(bad),
+                          "threshold": 0.0})
+
+        growth = probes.get("residual_growth")
+        if growth is not None:
+            if not _finite(growth) or growth > self.residual_ratio:
+                self._consecutive += 1
+            else:
+                self._consecutive = 0
+            if self._consecutive >= self.residual_rounds:
+                fired.append({"rule": "residual_growth",
+                              "value": float(growth),
+                              "threshold": self.residual_ratio,
+                              "consecutive": self._consecutive})
+
+        rerr = probes.get("recovery_error")
+        if rerr is not None and (not _finite(rerr)
+                                 or rerr > self.recovery_error):
+            fired.append({"rule": "recovery_error",
+                          "value": float(rerr),
+                          "threshold": self.recovery_error})
+
+        if not fired:
+            return []
+        for alarm in fired:
+            alarm["round"] = int(round_index)
+            alarm["action"] = self.action
+            if self.telemetry is not None:
+                self.telemetry.flag_alarm(round_index, alarm)
+        if self.action != "ledger-flag":
+            for alarm in fired:
+                logger.warning(
+                    "probe alarm %s at round %d: value %.6g over "
+                    "threshold %.6g", alarm["rule"], round_index,
+                    alarm["value"], alarm["threshold"])
+        if self.action == "abort":
+            raise DivergenceAbort(round_index, fired)
+        return fired
+
+
+def build_alarm_engine(cfg, telemetry=None):
+    """An engine when probes are on, else None (no per-round call)."""
+    if getattr(cfg, "probe_period", 0):
+        return AlarmEngine(cfg, telemetry)
+    return None

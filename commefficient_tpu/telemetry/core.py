@@ -207,6 +207,28 @@ class Telemetry:
         rec["uplink_bytes"] = float(uplink)
         self._drain()
 
+    def merge_round_probes(self, index: int, probes: dict):
+        """Merge algorithm-probe values onto round ``index``'s record
+        (schema v2). Client-pass probes land inside ``metrics_host``;
+        server-pass probes merge during ``FedOptimizer.step`` while
+        the record is still current; pipelined rounds merge at flush
+        replay — all strictly before the record can emit (emission
+        waits on ``set_round_bytes``, which arrives last)."""
+        rec = self._records.get(index)
+        if rec is None or not probes:
+            return
+        if rec.get("probes") is None:
+            rec["probes"] = {}
+        rec["probes"].update(probes)
+
+    def flag_alarm(self, index: int, alarm: dict):
+        """Append an alarm dict to round ``index``'s record (schema
+        v2 ``alarms`` list). Safe any time before emission."""
+        rec = self._records.get(index)
+        if rec is None:
+            return
+        rec.setdefault("alarms", []).append(alarm)
+
     def _drain(self, force: bool = False):
         """Emit front records that are closed and byte-complete (or
         everything closed, when forced) — ledger order == round

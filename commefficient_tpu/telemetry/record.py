@@ -1,4 +1,4 @@
-"""Ledger record schema (version 1).
+"""Ledger record schema (version 2).
 
 A run ledger is a JSONL file: one self-describing record per line.
 Every record carries ``schema`` (this module's version) and ``kind``:
@@ -24,13 +24,30 @@ Span attribution note: the ``sampler`` span measures fetching the
 NEXT round's batch and is attributed to the round that is open while
 the fetch happens (the first fetch of a run precedes any round and is
 not recorded).
+
+Schema v2 (backward-readable — readers accept both versions) adds two
+keys to round records:
+
+``probes`` — None when probing is off, else the round's algorithm
+             diagnostics dict (core/rounds.py + core/server.py probe
+             outputs: update/residual/momentum norms, NaN/Inf counts,
+             mass coverage, sketch-recovery error, host-derived
+             residual growth ratio). Keys vary by mode and cadence.
+``alarms`` — list of alarm dicts appended by telemetry/alarms.py
+             ({"rule", "value", "threshold", "action"}); empty when
+             nothing fired. A round that triggered ``--on_divergence
+             abort`` is the flagged final record of the run.
 """
 
 from __future__ import annotations
 
 from commefficient_tpu.telemetry import clock
 
-LEDGER_SCHEMA_VERSION = 1
+LEDGER_SCHEMA_VERSION = 2
+
+# versions validate_record accepts: v1 ledgers (pre-probe) stay
+# readable by the report tooling
+READABLE_SCHEMA_VERSIONS = (1, 2)
 
 KINDS = ("meta", "round", "epoch", "bench", "summary")
 
@@ -40,6 +57,12 @@ ROUND_REQUIRED_KEYS = (
     "uplink_bytes", "downlink_bytes",      # None until accounted
     "host_rss_peak_bytes",                 # None off-Linux
     "hbm_peak_bytes",                      # None off-accelerator
+)
+
+# v2 additions (not required of v1 records)
+ROUND_V2_KEYS = (
+    "probes",                              # None with probing off
+    "alarms",                              # [] when nothing fired
 )
 
 
@@ -64,6 +87,8 @@ def make_round_record(round_index: int) -> dict:
         "downlink_bytes": None,
         "host_rss_peak_bytes": None,
         "hbm_peak_bytes": None,
+        "probes": None,
+        "alarms": [],
     })
     return rec
 
@@ -94,16 +119,20 @@ def validate_record(rec) -> list:
     problems = []
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not dict"]
-    if rec.get("schema") != LEDGER_SCHEMA_VERSION:
-        problems.append(f"schema {rec.get('schema')!r} != "
-                        f"{LEDGER_SCHEMA_VERSION}")
+    schema = rec.get("schema")
+    if schema not in READABLE_SCHEMA_VERSIONS:
+        problems.append(f"schema {schema!r} not in "
+                        f"{READABLE_SCHEMA_VERSIONS}")
     kind = rec.get("kind")
     if kind not in KINDS:
         problems.append(f"unknown kind {kind!r}")
     if not isinstance(rec.get("ts"), (int, float)):
         problems.append("ts missing or non-numeric")
     if kind == "round":
-        for key in ROUND_REQUIRED_KEYS:
+        required = ROUND_REQUIRED_KEYS
+        if schema == 2:
+            required = required + ROUND_V2_KEYS
+        for key in required:
             if key not in rec:
                 problems.append(f"round record missing {key!r}")
         if not isinstance(rec.get("spans"), dict):

@@ -30,6 +30,7 @@ from commefficient_tpu.models import get_model
 from commefficient_tpu.runtime import (FedModel, FedOptimizer, LambdaLR,
                                        drain_rounds)
 from commefficient_tpu.telemetry import clock
+from commefficient_tpu.telemetry.alarms import DivergenceAbort
 from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
                                      TSVLogger, Timer, steps_per_epoch)
 
@@ -218,42 +219,52 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
 
         tel = model.telemetry
         it = enumerate(loader)
-        while True:
-            # manual pull so the sampler/loader wait is a ledger span
-            # (lands on the previous round's record — it's the
-            # inter-round host gap)
-            with tel.span("sampler"):
-                nxt = next(it, None)
-            if nxt is None:
-                break
-            i, batch = nxt
-            if i >= max_batches:
-                break
-            if mixup_rng is not None:
-                batch = apply_mixup(batch, args.mixup_alpha, mixup_rng)
-            lr_scheduler.step()
-            if opt.param_groups[0]["lr"] == 0:
-                # "HACK STEP": keep FedAvg's schedule aligned when the
-                # triangular LR hits 0 (reference cv_train.py:198-203);
-                # every group — schedule zeros hit them all at once
-                for g in opt.param_groups:
-                    g["lr"] = 1e-10
-            metrics = model(batch)
-            opt.step()
-            w = np.asarray(batch["mask"]).sum(axis=1)
-            lr_now = float(opt.param_groups[0]["lr"])
-            if metrics is None:
-                # pipelined (--pipeline_depth > 1): results arrive in
-                # batches; the device runs ahead of this loop
-                pending.append((i, w, lr_now))
-                if not drain_rounds(model, pending, process,
-                                    force=False):
+        try:
+            while True:
+                # manual pull so the sampler/loader wait is a ledger
+                # span (lands on the previous round's record — it's
+                # the inter-round host gap)
+                with tel.span("sampler"):
+                    nxt = next(it, None)
+                if nxt is None:
+                    break
+                i, batch = nxt
+                if i >= max_batches:
+                    break
+                if mixup_rng is not None:
+                    batch = apply_mixup(batch, args.mixup_alpha,
+                                        mixup_rng)
+                lr_scheduler.step()
+                if opt.param_groups[0]["lr"] == 0:
+                    # "HACK STEP": keep FedAvg's schedule aligned when
+                    # the triangular LR hits 0 (reference cv_train.py:
+                    # 198-203); every group — schedule zeros hit them
+                    # all at once
+                    for g in opt.param_groups:
+                        g["lr"] = 1e-10
+                metrics = model(batch)
+                opt.step()
+                w = np.asarray(batch["mask"]).sum(axis=1)
+                lr_now = float(opt.param_groups[0]["lr"])
+                if metrics is None:
+                    # pipelined (--pipeline_depth > 1): results arrive
+                    # in batches; the device runs ahead of this loop
+                    pending.append((i, w, lr_now))
+                    if not drain_rounds(model, pending, process,
+                                        force=False):
+                        return None
+                elif not process(metrics, i, w, lr_now):
                     return None
-            elif not process(metrics, i, w, lr_now):
+                if args.do_test:
+                    break
+            if not drain_rounds(model, pending, process, force=True):
                 return None
-            if args.do_test:
-                break
-        if not drain_rounds(model, pending, process, force=True):
+        except DivergenceAbort as e:
+            # --on_divergence abort: a probe alarm fired (alarms are
+            # already flagged on the round's ledger record, which
+            # becomes the run's final record when telemetry closes)
+            print(f"Stopping at round {e.round_index}: {e}")
+            model.diverged = True
             return None
         if not losses:  # every round fully dropped
             return (float("nan"), float("nan"),

@@ -33,6 +33,7 @@ from commefficient_tpu.models.gpt2 import (GPT2Config, GPT2DoubleHeads,
                                            token_nll)
 from commefficient_tpu.runtime import (FedModel, FedOptimizer, LambdaLR,
                                        drain_rounds)
+from commefficient_tpu.telemetry.alarms import DivergenceAbort
 from commefficient_tpu.utils import (PiecewiseLinear, TableLogger,
                                      Timer, steps_per_epoch)
 
@@ -174,28 +175,37 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
 
         tel = model.telemetry
         it = enumerate(loader)
-        while True:
-            # manual pull so the loader wait is a ledger span (lands
-            # on the previous round's record — the inter-round gap)
-            with tel.span("sampler"):
-                nxt = next(it, None)
-            if nxt is None:
-                break
-            i, batch = nxt
-            lr_scheduler.step()
-            metrics = model(batch)
-            opt.step()
-            w = np.asarray(batch["mask"]).sum(axis=1)
-            if metrics is None:  # --pipeline_depth > 1
-                pending.append((i, w))
-                if not drain_rounds(model, pending, process,
-                                    force=False):
+        try:
+            while True:
+                # manual pull so the loader wait is a ledger span
+                # (lands on the previous round's record — the
+                # inter-round gap)
+                with tel.span("sampler"):
+                    nxt = next(it, None)
+                if nxt is None:
+                    break
+                i, batch = nxt
+                lr_scheduler.step()
+                metrics = model(batch)
+                opt.step()
+                w = np.asarray(batch["mask"]).sum(axis=1)
+                if metrics is None:  # --pipeline_depth > 1
+                    pending.append((i, w))
+                    if not drain_rounds(model, pending, process,
+                                        force=False):
+                        return None
+                elif not process(metrics, i, w):
                     return None
-            elif not process(metrics, i, w):
+                if args.do_test:
+                    break
+            if not drain_rounds(model, pending, process, force=True):
                 return None
-            if args.do_test:
-                break
-        if not drain_rounds(model, pending, process, force=True):
+        except DivergenceAbort as e:
+            # alarm engine (--on_divergence abort): the offending
+            # round is already ledger-flagged; tel.close() in
+            # train_gpt2's finally emits it
+            print(f"Stopping at round {e.round_index}: {e}")
+            model.diverged = True
             return None
         return float(np.mean(losses)) if losses else float("nan")
     else:

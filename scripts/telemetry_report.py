@@ -48,19 +48,39 @@ def load_ledger(path):
     return records, problems
 
 
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
 def summarize(records) -> dict:
-    """Aggregate a ledger's records into one summary dict."""
+    """Aggregate a ledger's records into one summary dict. Reads both
+    schema v1 (no probes/alarms) and v2 ledgers."""
     rounds = [r for r in records if r["kind"] == "round"]
-    spans, counters = {}, {}
+    span_vals, counters = {}, {}
+    probe_vals = {}          # probe key -> [(round, value), ...]
+    alarm_rounds = []        # [{"round": r, "alarms": [...]}, ...]
     uplink = downlink = 0.0
     rss_peak = hbm_peak = None
     for r in rounds:
         for name, secs in r["spans"].items():
-            spans[name] = spans.get(name, 0.0) + float(secs)
+            span_vals.setdefault(name, []).append(float(secs))
         for name, n in r["counters"].items():
             counters[name] = counters.get(name, 0) + n
         uplink += r.get("uplink_bytes") or 0.0
         downlink += r.get("downlink_bytes") or 0.0
+        # v2-only keys: absent on v1 records, hence .get
+        for key, val in (r.get("probes") or {}).items():
+            if isinstance(val, (int, float)):
+                probe_vals.setdefault(key, []).append(
+                    (r["round"], float(val)))
+        if r.get("alarms"):
+            alarm_rounds.append({"round": r["round"],
+                                 "alarms": r["alarms"]})
         for key, best in (("host_rss_peak_bytes", rss_peak),
                           ("hbm_peak_bytes", hbm_peak)):
             v = r.get(key)
@@ -70,15 +90,30 @@ def summarize(records) -> dict:
                 else:
                     hbm_peak = v
     n = max(len(rounds), 1)
+    spans = {}
+    for name, vals in sorted(span_vals.items()):
+        sv = sorted(vals)
+        spans[name] = {"total_s": round(sum(vals), 4),
+                       "mean_ms": round(1e3 * sum(vals) / n, 3),
+                       "p50_ms": round(1e3 * _pct(sv, 50), 3),
+                       "p95_ms": round(1e3 * _pct(sv, 95), 3),
+                       "max_ms": round(1e3 * sv[-1], 3)}
+    probes = {}
+    for key, pairs in sorted(probe_vals.items()):
+        vals = [v for _, v in pairs]
+        probes[key] = {"n": len(vals),
+                       "first": vals[0], "last": vals[-1],
+                       "mean": sum(vals) / len(vals),
+                       "max": max(vals)}
     return {
         "meta": next((r for r in records if r["kind"] == "meta"),
                      None),
         "rounds": len(rounds),
         "uplink_bytes": uplink,
         "downlink_bytes": downlink,
-        "spans": {k: {"total_s": round(v, 4),
-                      "mean_ms": round(1e3 * v / n, 3)}
-                  for k, v in sorted(spans.items())},
+        "spans": spans,
+        "probes": probes,
+        "alarm_rounds": alarm_rounds,
         "counters": dict(sorted(counters.items())),
         "host_rss_peak_bytes": rss_peak,
         "hbm_peak_bytes": hbm_peak,
@@ -115,7 +150,16 @@ def render_summary(s, label="") -> str:
                  f"down {_mib(s['downlink_bytes'])}")
     for name, v in s["spans"].items():
         lines.append(f"  span {name}: total {v['total_s']} s, "
-                     f"mean {v['mean_ms']} ms/round")
+                     f"mean {v['mean_ms']} ms/round"
+                     f" (p50 {v['p50_ms']}, p95 {v['p95_ms']}, "
+                     f"max {v['max_ms']})")
+    for name, p in s.get("probes", {}).items():
+        lines.append(f"  probe {name}: first {p['first']:.6g} -> "
+                     f"last {p['last']:.6g}, mean {p['mean']:.6g}, "
+                     f"max {p['max']:.6g} ({p['n']} rounds)")
+    for a in s.get("alarm_rounds", []):
+        names = ", ".join(al.get("rule", "?") for al in a["alarms"])
+        lines.append(f"  ALARM round {a['round']}: {names}")
     if s["counters"]:
         lines.append(f"  counters: {s['counters']}")
     if s["host_rss_peak_bytes"] is not None:
@@ -163,6 +207,20 @@ def diff_summaries(a: dict, b: dict) -> dict:
         bench_diff[r["metric"]] = entry
     if bench_diff:
         out["benches"] = bench_diff
+    probe_diff = {}
+    for name in sorted(set(a.get("probes", {}))
+                       & set(b.get("probes", {}))):
+        pa, pb = a["probes"][name], b["probes"][name]
+        entry = {"a_mean": pa["mean"], "b_mean": pb["mean"]}
+        if pa["mean"]:
+            entry["ratio"] = round(pb["mean"] / pa["mean"], 4)
+        probe_diff[name] = entry
+    if probe_diff:
+        out["probes"] = probe_diff
+    aa = [x["round"] for x in a.get("alarm_rounds", [])]
+    ab = [x["round"] for x in b.get("alarm_rounds", [])]
+    if aa or ab:
+        out["alarm_rounds"] = {"a": aa, "b": ab}
     return out
 
 
@@ -181,6 +239,13 @@ def render_diff(d, label_a, label_b) -> str:
     for name, e in d.get("benches", {}).items():
         r = f" ({e['ratio']}x)" if "ratio" in e else ""
         lines.append(f"  bench {name}: {e['a']} -> {e['b']}{r}")
+    for name, e in d.get("probes", {}).items():
+        r = f" ({e['ratio']}x)" if "ratio" in e else ""
+        lines.append(f"  probe {name}: mean {e['a_mean']:.6g} -> "
+                     f"{e['b_mean']:.6g}{r}")
+    if "alarm_rounds" in d:
+        e = d["alarm_rounds"]
+        lines.append(f"  ALARM rounds: {e['a']} -> {e['b']}")
     return "\n".join(lines)
 
 

@@ -95,6 +95,58 @@ def bf16_round_trains():
     return f"update nnz {nnz}"
 
 
+def probe_smoke():
+    """--probe_full program variant on a sketch round: the in-compile
+    diagnostics come back clean and the TRUE recovery error against
+    the dense gradient is finite and < 1 (heavy-hitter gradient, so
+    top-k recovery must capture most of the mass)."""
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.core.rounds import (ClientStates,
+                                               build_client_round,
+                                               build_server_round)
+    from commefficient_tpu.core.server import ServerState
+
+    W, B, d = 8, 4, 1 << 18
+    cfg = Config(mode="sketch", error_type="virtual",
+                 local_momentum=0.0, virtual_momentum=0.9,
+                 num_workers=W, local_batch_size=B,
+                 k=5000, num_rows=5, num_cols=65536, seed=21)
+    cfg.grad_size = d
+
+    def lin_loss(p, b):
+        # grad == the client's c vector exactly (masked batch mean of
+        # identical rows) — a known ground truth for the probes
+        n = jnp.maximum(jnp.sum(b["mask"]), 1.0)
+        loss = jnp.sum((b["c"] @ p) * b["mask"]) / n
+        return loss, (loss * 0.0,)
+
+    cr = jax.jit(build_client_round(cfg, lin_loss, B, probes=True,
+                                    probe_recovery=True))
+    sr = jax.jit(build_server_round(cfg, probes=True))
+    rng = np.random.RandomState(0)
+    # heavy-tailed coordinates: the top-k floor of the recovery error
+    # stays well below 1
+    c = rng.randn(W, 1, d).astype(np.float32)
+    c[:, :, :2000] *= 50.0
+    batch = {"c": jnp.asarray(np.broadcast_to(c, (W, B, d))),
+             "mask": jnp.ones((W, B), jnp.float32)}
+    flat = jnp.zeros((d,), jnp.float32)
+    res = cr(flat, ClientStates.init(cfg, 100, flat), batch,
+             jnp.arange(W, dtype=jnp.int32), jax.random.PRNGKey(0),
+             1.0)
+    pr = {k: float(v) for k, v in res.probes.items()}
+    out = sr(flat, ServerState.init(cfg), res.aggregated,
+             jnp.float32(0.1))
+    pr.update({k: float(v) for k, v in out[-1].items()})
+    assert pr["agg_nan"] == 0 and pr["agg_inf"] == 0, pr
+    rec = pr["recovery_error"]
+    assert np.isfinite(rec) and 0.0 <= rec < 1.0, pr
+    for key in ("update_norm", "residual_norm", "momentum_norm",
+                "mass_coverage"):
+        assert np.isfinite(pr[key]), pr
+    return f"recovery error {rec:.3f}"
+
+
 def flash_attention_parity():
     """attn_impl="flash" (Pallas flash-attention kernel) vs the XLA
     attention lowering on the same GPT-2 block — forward and gradient
@@ -155,6 +207,7 @@ def main():
     print(f"devices: {jax.devices()}")
     check("pallas_vs_xla_sketch_parity", pallas_parity)
     check("bf16_flagship_round", bf16_round_trains)
+    check("probe_smoke", probe_smoke)
     check("flash_attention_parity", flash_attention_parity)
     check("bench_vs_baseline", bench_throughput)
     if FAILED:

@@ -46,6 +46,14 @@ class MirrorFed:
                          if getattr(cfg, "do_topk_down", False)
                          else None)
         self.sketch = sketch
+        # schema-v2 probe oracle: round() fills this with the same
+        # keys the engine's --probe_every path computes (client
+        # aggregate/transmit norms + server state norms/coverage +
+        # sketch recovery error). Keys the engine's fast paths omit
+        # (e.g. client_norm_* on the fused path) are still computed
+        # here; tests compare only the engine's keys.
+        self.last_probes = None
+        self._dense_tt = []
 
     # client math ---------------------------------------------------------
 
@@ -92,6 +100,10 @@ class MirrorFed:
             if norm > cfg.l2_norm_clip:
                 g = g * (cfg.l2_norm_clip / norm)
         if cfg.mode == "sketch":
+            # dense pre-sketch transmit: ground truth for the
+            # recovery-error probe (valid when no table-space
+            # per-client state exists, matching the engine's gating)
+            self._dense_tt.append(np.asarray(g, np.float64) * len(y))
             g = np.asarray(self.sketch.sketch(
                 np.asarray(g, np.float32)), np.float64)
         g = g * len(y)  # sum-of-grads semantics (fed_worker.py:192)
@@ -115,16 +127,32 @@ class MirrorFed:
 
     # server math ---------------------------------------------------------
 
+    def _coverage(self, sel_mass, dense_mass):
+        return sel_mass / dense_mass if dense_mass > 0 else 1.0
+
+    def _record_server_probes(self, upd_scaled, extra=None):
+        """Same quantities as core/server.py's ``_state_probes``:
+        norms of the POST-masking state, plus the lr-scaled update."""
+        pr = {"update_norm": np.linalg.norm(upd_scaled),
+              "momentum_norm": np.linalg.norm(self.Vvel),
+              "residual_norm": np.linalg.norm(self.Verr)}
+        if extra:
+            pr.update(extra)
+        self.last_probes.update(pr)
+
     def _server(self, agg, lr, participating):
         cfg = self.cfg
         rho = cfg.virtual_momentum
         if cfg.mode in ("uncompressed", "fedavg", "local_topk"):
             self.Vvel = agg + rho * self.Vvel
             eff_lr = 1.0 if cfg.mode == "fedavg" else lr
-            return self.Vvel * eff_lr
+            upd = self.Vvel * eff_lr
+            self._record_server_probes(upd)
+            return upd
         if cfg.mode == "true_topk":
             self.Vvel = agg + rho * self.Vvel
             self.Verr = self.Verr + self.Vvel
+            dense_mass = float(np.sum(self.Verr ** 2))  # pre-masking
             upd = np_topk(self.Verr, cfg.k)
             nz = upd != 0
             self.Verr[nz] = 0
@@ -132,6 +160,10 @@ class MirrorFed:
             if cfg.local_momentum > 0:
                 for cid in participating:
                     self.vel[cid][nz] = 0
+            self._record_server_probes(
+                upd * lr,
+                {"mass_coverage": self._coverage(
+                    float(np.sum(upd ** 2)), dense_mass)})
             return upd * lr
         if cfg.mode == "sketch":
             self.Vvel = agg + rho * self.Vvel
@@ -139,6 +171,10 @@ class MirrorFed:
                 self.Verr = self.Vvel.copy()
             elif cfg.error_type == "virtual":
                 self.Verr = self.Verr + self.Vvel
+            # dense residual mass is unknowable in table space: the
+            # engine probes the table's own unbiased l2estimate
+            dense_mass = float(np.asarray(self.sketch.l2estimate(
+                np.asarray(self.Verr, np.float32)))) ** 2
             upd = np.asarray(self.sketch.unsketch(
                 np.asarray(self.Verr, np.float32), k=cfg.k), np.float64)
             su = np.asarray(self.sketch.sketch(
@@ -149,6 +185,10 @@ class MirrorFed:
             self.Vvel[nz] = 0
             if cfg.error_type == "local":
                 self.Verr = self.Vvel.copy()
+            self._record_server_probes(
+                upd * lr,
+                {"mass_coverage": self._coverage(
+                    float(np.sum(upd ** 2)), dense_mass)})
             return upd * lr
         raise ValueError(cfg.mode)
 
@@ -159,12 +199,40 @@ class MirrorFed:
         ``B``: the engine round's padded batch size (microbatch
         boundaries depend on it; None = no padding)."""
         total = sum(len(y) for _, _, y in clients)
+        self._dense_tt = []
         transmits = [self._client_transmit(cid, X, y, B)
                      for cid, X, y in clients]
         agg = np.sum(transmits, axis=0) / total
+        # sketch-late engine paths materialise DENSE per-client
+        # transmits (the table appears only after the local sum), so
+        # the transmit-norm probes are over the dense vectors there
+        norm_src = (self._dense_tt
+                    if (self.cfg.mode == "sketch" and self._dense_tt
+                        and self.cfg.max_grad_norm is None)
+                    else transmits)
+        self.last_probes = self._client_probes(agg, norm_src)
+        if self.cfg.mode == "sketch" and self._dense_tt:
+            dense_agg = np.sum(self._dense_tt, axis=0) / total
+            est = np.asarray(self.sketch.unsketch(
+                np.asarray(agg, np.float32), k=self.cfg.k), np.float64)
+            den = np.linalg.norm(dense_agg)
+            self.last_probes["recovery_error"] = (
+                np.linalg.norm(est - dense_agg) / den if den > 0
+                else 0.0)
         upd = self._server(agg, lr, [cid for cid, _, _ in clients])
         self.w = self.w - upd
         return self.w.copy()
+
+    def _client_probes(self, agg, transmits):
+        norms = np.array([np.linalg.norm(t) for t in transmits])
+        return {
+            "agg_norm": np.linalg.norm(agg),
+            "agg_nan": float(np.sum(np.isnan(agg))),
+            "agg_inf": float(np.sum(np.isinf(agg))),
+            "client_norm_mean": norms.mean(),
+            "client_norm_max": norms.max(),
+            "client_norm_std": norms.std(),
+        }
 
     def round_fedavg(self, clients, lr):
         """FedAvg local SGD (fed_worker.py:62-114): per client, split
@@ -189,6 +257,7 @@ class MirrorFed:
                     step += 1
             transmits.append((self.w - w) * n)
         agg = np.sum(transmits, axis=0) / total
+        self.last_probes = self._client_probes(agg, transmits)
         upd = self._server(agg, 1.0, [c for c, _, _ in clients])
         self.w = self.w - upd
         return self.w.copy()

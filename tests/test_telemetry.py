@@ -41,6 +41,37 @@ def test_no_raw_clocks_outside_telemetry():
         "(use telemetry.clock.wall/tick):\n" + "\n".join(offenders))
 
 
+def test_probe_host_transfers_only_inside_metrics_host_span():
+    """Probe values are materialised (``_host`` / ``jax.device_get``)
+    ONLY inside a ``span(\"metrics_host\")`` block: the sync point is
+    the probes' entire runtime cost, so it must be ledger-attributed —
+    an unspanned transfer would both hide that cost and add a second
+    blocking device round-trip per round."""
+    offenders = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        rel = path.relative_to(PKG_ROOT)
+        if rel.parts[0] == "telemetry":
+            continue
+        lines = path.read_text().splitlines()
+        for i, line in enumerate(lines):
+            if "_host(" not in line and "device_get(" not in line:
+                continue
+            stripped = line.lstrip()
+            if stripped.startswith("#") or stripped.startswith("def "):
+                continue
+            # only transfers of probe values are in scope: the call
+            # site or its immediate context names them
+            ctx = "\n".join(lines[max(0, i - 3):i + 2])
+            if "probe" not in ctx.lower() and "sprobes" not in ctx:
+                continue
+            back = "\n".join(lines[max(0, i - 10):i + 1])
+            if 'span("metrics_host")' not in back:
+                offenders.append(f"{rel}:{i + 1}: {line.strip()}")
+    assert not offenders, (
+        "probe values crossed to the host outside a "
+        'span("metrics_host") block:\n' + "\n".join(offenders))
+
+
 # --- disabled fast path -----------------------------------------------
 
 
