@@ -1,0 +1,20 @@
+"""Static-analysis subsystem: compiled-program audits + repo linter.
+
+Two passes, both run by ``scripts/audit.py`` and gated in tier-1 by
+``tests/test_audit.py`` against the committed ``audit_baseline.json``:
+
+* ``analysis.program`` lowers the jitted round step for every
+  (mode, path) pair on the CPU mesh and statically checks donation
+  coverage, the collective inventory (cross-checked against the
+  telemetry ledger's byte accounting), host-transfer freedom, bf16
+  dot/conv dtypes, and trace-cache fingerprints.
+* ``analysis.lint`` is an AST rule engine over the package source —
+  the grown-up form of the old grep guards — with
+  ``# audit: allow(<rule>)`` inline waivers.
+"""
+
+from commefficient_tpu.analysis.baseline import diff_against_baseline
+from commefficient_tpu.analysis.lint import run_lint
+from commefficient_tpu.analysis.program import run_program_audit
+
+__all__ = ["diff_against_baseline", "run_lint", "run_program_audit"]

@@ -1,0 +1,126 @@
+"""Audit report assembly + diff against the committed baseline.
+
+``audit_baseline.json`` (repo root) pins, per program: the trace
+fingerprint, the collective inventory, donation counts, and the
+transfer count — plus the repo's *waived* lint violations. The tier-1
+gate (tests/test_audit.py) and ``scripts/audit.py --baseline`` diff a
+fresh report against it, so any new collective, a dropped donation, a
+new host transfer, a retrace, or a new waiver is a visible failure
+until the change is intentional and the baseline is refreshed with
+``python scripts/audit.py --write-baseline``.
+
+Hard invariant failures (``report["failures"]``, unwaived lint hits)
+fail regardless of the baseline — they can never be baselined in.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+BASELINE_SCHEMA = 1
+
+_PINNED_ENTRY_KEYS = ("fingerprint", "collectives", "donation",
+                      "dot_dtypes")
+
+
+def build_report(program_report: Dict, lint_summary: Dict) -> Dict:
+    return {"schema": BASELINE_SCHEMA,
+            "jax_version": program_report.get("jax_version"),
+            "device_count": program_report.get("device_count"),
+            "lint": lint_summary,
+            "programs": program_report.get("programs", {}),
+            "failures": list(program_report.get("failures", []))
+            + [f"lint: {v}" for v in lint_summary.get("unwaived", [])]}
+
+
+def to_baseline(report: Dict) -> Dict:
+    """Strip a full report down to the pinned, committable subset."""
+    programs = {}
+    for name, entry in report["programs"].items():
+        pinned = {k: entry[k] for k in _PINNED_ENTRY_KEYS
+                  if k in entry}
+        pinned["transfers"] = len(entry.get("transfers", []))
+        programs[name] = pinned
+    return {"schema": BASELINE_SCHEMA,
+            "jax_version": report.get("jax_version"),
+            "device_count": report.get("device_count"),
+            "lint": {"waived": report["lint"].get("waived", [])},
+            "programs": programs}
+
+
+def diff_against_baseline(report: Dict, baseline: Dict) -> List[str]:
+    """Regressions of ``report`` vs ``baseline``. Empty = green."""
+    problems = list(report.get("failures", []))
+    if baseline.get("schema") != BASELINE_SCHEMA:
+        problems.append(f"baseline schema {baseline.get('schema')} != "
+                        f"{BASELINE_SCHEMA} — refresh the baseline")
+        return problems
+    if baseline.get("device_count") != report.get("device_count"):
+        problems.append(
+            f"device count {report.get('device_count')} != baseline "
+            f"{baseline.get('device_count')} — the audit mesh must "
+            "match the baseline's (8-device CPU mesh)")
+    if baseline.get("jax_version") != report.get("jax_version"):
+        problems.append(
+            f"jax {report.get('jax_version')} != baseline "
+            f"{baseline.get('jax_version')}: fingerprints are only "
+            "comparable within one jax version — refresh the baseline")
+
+    waived_now = set(report["lint"].get("waived", []))
+    waived_then = set(baseline.get("lint", {}).get("waived", []))
+    for v in sorted(waived_now - waived_then):
+        problems.append(f"new lint waiver (refresh baseline to "
+                        f"accept): {v}")
+    for v in sorted(waived_then - waived_now):
+        problems.append(f"stale baseline waiver (violation gone — "
+                        f"refresh baseline): {v}")
+
+    now = report.get("programs", {})
+    then = baseline.get("programs", {})
+    for name in sorted(set(then) - set(now)):
+        problems.append(f"{name}: program missing from audit (in "
+                        "baseline)")
+    for name in sorted(set(now) - set(then)):
+        problems.append(f"{name}: new program not in baseline")
+    for name in sorted(set(now) & set(then)):
+        fresh, pinned = now[name], then[name]
+        if fresh.get("fingerprint") != pinned.get("fingerprint"):
+            problems.append(
+                f"{name}: trace fingerprint changed "
+                f"({pinned.get('fingerprint', '')[:12]} -> "
+                f"{fresh.get('fingerprint', '')[:12]}) — program "
+                "drift or retrace; refresh the baseline if "
+                "intentional")
+        if fresh.get("collectives") != pinned.get("collectives"):
+            problems.append(
+                f"{name}: collective inventory changed: "
+                f"{pinned.get('collectives')} -> "
+                f"{fresh.get('collectives')}")
+        if fresh.get("donation") != pinned.get("donation"):
+            problems.append(
+                f"{name}: donation coverage changed: "
+                f"{pinned.get('donation')} -> {fresh.get('donation')}")
+        if len(fresh.get("transfers", [])) != pinned.get("transfers",
+                                                         0):
+            problems.append(
+                f"{name}: host transfer count changed "
+                f"({pinned.get('transfers', 0)} -> "
+                f"{len(fresh.get('transfers', []))})")
+        if fresh.get("dot_dtypes") != pinned.get("dot_dtypes"):
+            problems.append(
+                f"{name}: dot/conv dtype inventory changed: "
+                f"{pinned.get('dot_dtypes')} -> "
+                f"{fresh.get('dot_dtypes')}")
+    return problems
+
+
+def load_baseline(path) -> Dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_baseline(report: Dict, path) -> None:
+    with open(path, "w") as f:
+        json.dump(to_baseline(report), f, indent=1, sort_keys=True)
+        f.write("\n")

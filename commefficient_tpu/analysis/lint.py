@@ -1,0 +1,353 @@
+"""AST rule engine over the package source — the grown-up form of the
+old grep guards (raw-clock guard, metrics_host-span guard in
+tests/test_telemetry.py), which now delegate here so there is a single
+source of truth for each rule.
+
+Waivers: ``# audit: allow(<rule>[, <rule>...])`` on the offending line
+or the line directly above suppresses the hit. Waived violations are
+still reported (``waived=True``) and recorded in the audit baseline,
+so a *new* waiver is a visible diff, not a silent hole.
+
+Scoping is by path role relative to the package root:
+
+* ``telemetry/`` owns the raw clocks and the host transfer of ledger
+  scalars — exempt from ``raw-clock`` and the span rules.
+* ``core/`` and ``ops/`` are *compiled scope*: bodies there run under
+  jit tracing, so Python RNG is a frozen-constant bug and
+  ``np.asarray`` inside a traced closure is a tracer leak.
+* ``runtime/``, ``train/``, ``clientstore/`` are the host hot path:
+  device syncs (``.item()``, ``jax.device_get``, ``block_until_ready``,
+  ``_host``) must sit inside a telemetry ``span(...)`` block so the
+  ledger attributes their cost.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+WAIVER_RE = re.compile(r"#\s*audit:\s*allow\(([a-zA-Z0-9_\-, ]+)\)")
+
+COMPILED_SCOPE = ("core", "ops")
+HOST_HOT_PATH = ("runtime", "train", "clientstore")
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str          # relative to the scanned root
+    line: int
+    message: str
+    waived: bool = False
+
+    def __str__(self):
+        w = " [waived]" if self.waived else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{w}"
+
+
+@dataclass
+class Rule:
+    name: str
+    description: str
+    # (rel_path, source lines, parsed tree) -> [(line, message)]
+    check: Callable[[pathlib.PurePath, List[str], ast.AST],
+                    List[Tuple[int, str]]]
+
+
+def _top(rel: pathlib.PurePath) -> str:
+    return rel.parts[0] if rel.parts else ""
+
+
+# --- rule: raw-clock ---------------------------------------------------
+
+
+_CLOCK_ATTRS = {"time", "perf_counter", "perf_counter_ns",
+                "monotonic", "monotonic_ns"}
+
+
+def _check_raw_clock(rel, lines, tree):
+    """time.time()/perf_counter() outside telemetry/ — all host timing
+    must flow through telemetry.clock so spans, Timer and the ledger
+    agree on what a second is."""
+    if _top(rel) == "telemetry":
+        return []
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _CLOCK_ATTRS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"):
+            hits.append((node.lineno,
+                         f"raw clock time.{f.attr}() — use "
+                         "telemetry.clock.wall/tick"))
+        elif (isinstance(f, ast.Name)
+                and f.id in {"perf_counter", "perf_counter_ns",
+                             "monotonic", "monotonic_ns"}):
+            hits.append((node.lineno,
+                         f"raw clock {f.id}() — use "
+                         "telemetry.clock.wall/tick"))
+    return hits
+
+
+# --- rule: probe-transfer-span -----------------------------------------
+
+
+def _check_probe_transfer_span(rel, lines, tree):
+    """Probe values may be materialised (_host / jax.device_get) only
+    inside a span("metrics_host") block — the sync point IS the
+    probes' runtime cost, so it must be ledger-attributed. Line-based
+    on purpose: byte-for-byte the semantics of the original grep guard
+    it replaced (context naming probes within +-3 lines, span within
+    the previous 10)."""
+    if _top(rel) == "telemetry":
+        return []
+    hits = []
+    for i, line in enumerate(lines):
+        if "_host(" not in line and "device_get(" not in line:
+            continue
+        stripped = line.lstrip()
+        if stripped.startswith("#") or stripped.startswith("def "):
+            continue
+        ctx = "\n".join(lines[max(0, i - 3):i + 2])
+        if "probe" not in ctx.lower() and "sprobes" not in ctx:
+            continue
+        back = "\n".join(lines[max(0, i - 10):i + 1])
+        if 'span("metrics_host")' not in back:
+            hits.append((i + 1, "probe value crosses to the host "
+                         'outside a span("metrics_host") block'))
+    return hits
+
+
+# --- rule: host-sync ---------------------------------------------------
+
+
+def _span_guarded_calls(tree) -> Set[int]:
+    """Line numbers of Call nodes lexically inside a ``with
+    <x>.span(...)`` block (any span name: the requirement is that the
+    sync is *attributed*, which span the caller judges)."""
+    guarded: Set[int] = set()
+
+    def visit(node, in_span):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                c = item.context_expr
+                if (isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Attribute)
+                        and c.func.attr == "span"):
+                    in_span = True
+        if isinstance(node, ast.Call) and in_span:
+            guarded.add(node.lineno)
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_span)
+
+    visit(tree, False)
+    return guarded
+
+
+def _check_host_sync(rel, lines, tree):
+    """Device syncs on the host hot path outside any telemetry span:
+    each one is a hidden blocking round-trip the ledger cannot see."""
+    if _top(rel) not in HOST_HOT_PATH:
+        return []
+    guarded = _span_guarded_calls(tree)
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or node.lineno in guarded:
+            continue
+        f = node.func
+        name = None
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args and not node.keywords:
+                name = ".item()"
+            elif f.attr in {"device_get", "block_until_ready"}:
+                name = f.attr
+        elif isinstance(f, ast.Name):
+            if f.id in {"device_get", "block_until_ready", "_host"}:
+                name = f.id
+        if name:
+            hits.append((node.lineno,
+                         f"host sync {name} outside a telemetry "
+                         "span block"))
+    return hits
+
+
+# --- rule: np-on-tracer ------------------------------------------------
+
+
+def _nested_function_lines(tree) -> Set[int]:
+    """Line ranges of functions *defined inside other functions* — in
+    compiled-scope modules those closures are what jit traces."""
+    spans: List[Tuple[int, int]] = []
+
+    def visit(node, depth):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if depth >= 1:
+                spans.append((node.lineno, node.end_lineno or node.lineno))
+            depth += 1
+        for child in ast.iter_child_nodes(node):
+            visit(child, depth)
+
+    visit(tree, 0)
+    covered: Set[int] = set()
+    for a, b in spans:
+        covered.update(range(a, b + 1))
+    return covered
+
+
+def _check_np_on_tracer(rel, lines, tree):
+    """np.asarray / np.array inside a traced closure in compiled scope
+    forces the tracer to the host (ConcretizationTypeError at best, a
+    silent device->host sync via __array__ at worst). Module-level
+    numpy (hash-constant setup in ops/sketch.py and friends) is fine —
+    only *nested* function bodies are traced."""
+    if _top(rel) not in COMPILED_SCOPE:
+        return []
+    traced = _nested_function_lines(tree)
+    hits = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and node.lineno in traced
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in {"asarray", "array"}
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in {"np", "numpy"}):
+            hits.append((node.lineno,
+                         f"np.{node.func.attr}() inside a traced "
+                         "closure — use jnp, or hoist to setup"))
+    return hits
+
+
+# --- rule: python-rng --------------------------------------------------
+
+
+def _check_python_rng(rel, lines, tree):
+    """Stdlib/NumPy RNG in compiled scope: traced once, the draw
+    freezes into the program as a constant — every execution reuses
+    round 0's randomness. Use jax.random with threaded keys."""
+    if _top(rel) not in COMPILED_SCOPE:
+        return []
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        # np.random.<fn> / numpy.random.<fn>
+        v = node.value
+        if (isinstance(v, ast.Attribute) and v.attr == "random"
+                and isinstance(v.value, ast.Name)
+                and v.value.id in {"np", "numpy"}):
+            hits.append((node.lineno,
+                         f"np.random.{node.attr} in compiled scope — "
+                         "use jax.random"))
+        # random.<fn> on the stdlib module
+        elif (isinstance(v, ast.Name) and v.id == "random"):
+            hits.append((node.lineno,
+                         f"random.{node.attr} in compiled scope — "
+                         "use jax.random"))
+    return hits
+
+
+# --- rule: mutable-default-arg -----------------------------------------
+
+
+def _check_mutable_default(rel, lines, tree):
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in {"list", "dict", "set"}):
+                hits.append((default.lineno,
+                             f"mutable default argument in "
+                             f"{node.name}() — use None + init in body"))
+    return hits
+
+
+ALL_RULES = [
+    Rule("raw-clock",
+         "time.time()/perf_counter() outside telemetry/",
+         _check_raw_clock),
+    Rule("probe-transfer-span",
+         'probe host transfer outside span("metrics_host")',
+         _check_probe_transfer_span),
+    Rule("host-sync",
+         "device sync on the host hot path outside a telemetry span",
+         _check_host_sync),
+    Rule("np-on-tracer",
+         "np.asarray/np.array inside a traced closure",
+         _check_np_on_tracer),
+    Rule("python-rng",
+         "stdlib/NumPy RNG in compiled scope",
+         _check_python_rng),
+    Rule("mutable-default-arg",
+         "mutable default argument",
+         _check_mutable_default),
+]
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}
+
+
+def waived_rules_at(lines: List[str], line: int) -> Set[str]:
+    """Rules waived at 1-based ``line``: an ``# audit: allow(...)``
+    comment on the line itself or the line directly above."""
+    out: Set[str] = set()
+    for lno in (line, line - 1):
+        if 1 <= lno <= len(lines):
+            m = WAIVER_RE.search(lines[lno - 1])
+            if m:
+                out.update(x.strip() for x in m.group(1).split(","))
+    return out
+
+
+def lint_file(path: pathlib.Path, rel: pathlib.PurePath,
+              rules=None) -> List[Violation]:
+    rules = ALL_RULES if rules is None else rules
+    text = path.read_text()
+    lines = text.splitlines()
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        return [Violation("syntax", str(rel), e.lineno or 0,
+                          f"unparseable: {e.msg}")]
+    out = []
+    for rule in rules:
+        for line, msg in rule.check(rel, lines, tree):
+            waived = rule.name in waived_rules_at(lines, line)
+            out.append(Violation(rule.name, str(rel), line, msg,
+                                 waived=waived))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def run_lint(root: Optional[pathlib.Path] = None,
+             rules=None) -> List[Violation]:
+    """Lint every .py under ``root`` (default: the installed package).
+    Returns all violations, waived ones included — callers gate on
+    ``unwaived(...)``."""
+    root = PKG_ROOT if root is None else pathlib.Path(root)
+    out: List[Violation] = []
+    for path in sorted(root.rglob("*.py")):
+        out.extend(lint_file(path, path.relative_to(root), rules))
+    return out
+
+
+def unwaived(violations: List[Violation]) -> List[Violation]:
+    return [v for v in violations if not v.waived]
+
+
+def lint_report(violations: List[Violation]) -> Dict:
+    """JSON-able summary for scripts/audit.py and the baseline."""
+    return {
+        "rules": sorted(RULES_BY_NAME),
+        "unwaived": [str(v) for v in unwaived(violations)],
+        "waived": sorted(str(v) for v in violations if v.waived),
+    }

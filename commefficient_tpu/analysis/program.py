@@ -1,0 +1,360 @@
+"""Program audit: lower + compile the jitted round step for every
+(mode, path) pair on the mesh and statically check the invariants the
+FetchSGD line promises about the compiled program:
+
+* **donation coverage** — every ``donate_argnums`` leaf is actually
+  input-output aliased (a dropped donation doubles peak HBM for the
+  client-state buffers at scale, silently);
+* **collective inventory** — op counts and byte totals per collective
+  kind, with the transmit-aggregation all-reduce cross-checked against
+  the telemetry ledger's uplink accounting
+  (``4 * cfg.upload_floats_per_client`` per client) to exact integer
+  equality for sketch / true_topk / uncompressed / fedavg. local_topk
+  is the documented exception: the mesh reduces the DENSE masked
+  vector over the ICI (4·d bytes) while the logical uplink is 4·k —
+  the audit asserts the bound instead;
+* **no host transfers** — no infeed/outfeed/send/recv/host callbacks
+  anywhere in the round program (the only device→host crossing is the
+  ``metrics_host`` scalar fetch, which lives OUTSIDE the compiled
+  step and is policed by the linter, not here);
+* **bf16 dtype discipline** — a bf16 canary model lowers with zero
+  f32 dot/conv ops (silent widening = 2x FLOPs + traffic);
+* **trace-cache fingerprint** — SHA-256 of the loc-stripped StableHLO
+  per (mode, path, probes); double-lowering must agree, and the
+  committed ``audit_baseline.json`` pins it so accidental program
+  drift / retraces fail visibly.
+
+Geometry is deliberately tiny (d=64, B=2, sketch 2x16): the audit
+checks program *shape*, not numerics, and must stay tier-1 fast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.analysis import hlo
+from commefficient_tpu.config import Config
+from commefficient_tpu.core.rounds import (ClientStates,
+                                           build_client_round,
+                                           build_server_round)
+from commefficient_tpu.core.server import ServerState
+from commefficient_tpu.parallel.mesh import (client_sharding, make_mesh,
+                                             replicated, shard_batch)
+
+D = 64            # grad_size
+B = 2             # padded batch per client
+NUM_CLIENTS = 16  # divisible by the 8-device mesh
+MESH_W = 8        # round fan-out on the mesh
+CHUNK_W = 4       # fan-out for the single-device chunked path
+CHUNK = 2
+
+BASE_CFG = dict(local_momentum=0.0, virtual_momentum=0.0,
+                weight_decay=0.0, error_type="none", k=3,
+                num_rows=2, num_cols=16, num_blocks=1,
+                local_batch_size=B, microbatch_size=-1, seed=21)
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    name: str
+    mode: str
+    path: str               # "fused" | "per_client" | "chunked"
+    cfg_kw: Dict
+    probes: bool = False
+    probe_recovery: bool = False
+
+    @property
+    def use_mesh(self) -> bool:
+        return self.path != "chunked"
+
+
+def build_specs() -> List[ProgramSpec]:
+    """The mode x path matrix. Path forcing mirrors how the runtime
+    actually lands on each builder branch (core/rounds.py):
+
+    * fused needs no per-client gradient transform — sketch /
+      true_topk / uncompressed with zero local momentum/error;
+    * per_client is forced by a per-client op: microbatching for the
+      fused-eligible modes, local momentum/error for the rest; fedavg
+      is inherently per-client (local SGD);
+    * chunked engages only single-device with 0 < client_chunk < W.
+    """
+    fused = [
+        ProgramSpec("sketch/fused", "sketch", "fused",
+                    dict(error_type="virtual", virtual_momentum=0.9)),
+        ProgramSpec("true_topk/fused", "true_topk", "fused",
+                    dict(error_type="virtual", virtual_momentum=0.9)),
+        ProgramSpec("uncompressed/fused", "uncompressed", "fused",
+                    dict(virtual_momentum=0.9)),
+        # the --probe_every cadence variant: table + dense ground
+        # truth both cross the ICI on probed rounds
+        ProgramSpec("sketch/fused+probes", "sketch", "fused",
+                    dict(error_type="virtual", virtual_momentum=0.9),
+                    probes=True, probe_recovery=True),
+    ]
+    per_client_kw = {
+        "sketch": dict(error_type="virtual", virtual_momentum=0.9,
+                       microbatch_size=1),
+        "true_topk": dict(error_type="virtual", virtual_momentum=0.9,
+                          local_momentum=0.9),
+        "local_topk": dict(error_type="local", local_momentum=0.9,
+                           virtual_momentum=0.9),
+        "uncompressed": dict(virtual_momentum=0.9, local_momentum=0.9),
+        "fedavg": dict(local_batch_size=-1),
+    }
+    per_client = [ProgramSpec(f"{m}/per_client", m, "per_client", kw)
+                  for m, kw in per_client_kw.items()]
+    chunked = [ProgramSpec(f"{m}/chunked", m, "chunked",
+                           dict(kw, client_chunk=CHUNK))
+               for m, kw in per_client_kw.items()]
+    return fused + per_client + chunked
+
+
+SERVER_CFG_KW = {
+    # aligned with tests/test_accounting.py MODES so the ledger
+    # cross-check and the server audit see the same configs
+    "uncompressed": dict(virtual_momentum=0.9),
+    "sketch": dict(error_type="virtual", virtual_momentum=0.9),
+    "true_topk": dict(error_type="virtual", virtual_momentum=0.9),
+    "local_topk": dict(error_type="local", local_momentum=0.9,
+                       virtual_momentum=0.9),
+    "fedavg": dict(local_batch_size=-1),
+}
+
+
+def make_cfg(mode: str, num_workers: int, **kw) -> Config:
+    merged = dict(BASE_CFG)
+    merged.update(kw)
+    cfg = Config(mode=mode, num_workers=num_workers, **merged)
+    cfg.grad_size = D
+    return cfg
+
+
+def _toy_loss(params_flat, batch):
+    pred = batch["x"] @ params_flat
+    sq = (pred - batch["y"]) ** 2
+    n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+    loss = jnp.sum(sq * batch["mask"]) / n
+    return loss, (loss * 0.0 + 1.0,)
+
+
+def _client_inputs(cfg: Config, mesh):
+    W = cfg.num_workers
+    rng = np.random.RandomState(0)
+    ps = jnp.zeros((D,), jnp.float32)
+    sharding = client_sharding(mesh) if mesh is not None else None
+    cs = ClientStates.init(cfg, NUM_CLIENTS, ps, sharding=sharding)
+    batch = {"x": jnp.asarray(rng.randn(W, B, D).astype(np.float32)),
+             "y": jnp.asarray(rng.randn(W, B).astype(np.float32)),
+             "mask": jnp.ones((W, B), jnp.float32)}
+    ids = jnp.arange(W, dtype=jnp.int32)
+    if mesh is not None:
+        batch = shard_batch(mesh, batch)
+        ps = jax.device_put(ps, replicated(mesh))
+        ids = jax.device_put(ids, replicated(mesh))
+    return ps, cs, batch, ids, jax.random.PRNGKey(0), jnp.float32(0.1)
+
+
+def _donated_leaves(tree) -> int:
+    return len(jax.tree_util.tree_leaves(tree))
+
+
+def _audit_texts(jitted, args) -> Dict:
+    """Lower twice (retrace determinism), compile once; return the
+    parsed common report skeleton."""
+    lowered = jitted.lower(*args)
+    text = lowered.as_text()
+    fp = hlo.fingerprint(text)
+    fp2 = hlo.fingerprint(jitted.lower(*args).as_text())
+    ctext = lowered.compile().as_text()
+    ops = hlo.collective_inventory(ctext)
+    transfers = (hlo.host_transfer_lines(text)
+                 + hlo.host_transfer_lines(ctext))
+    marks = hlo.donation_marks(text)
+    return {
+        "fingerprint": fp,
+        "retrace_stable": fp == fp2,
+        "collectives": hlo.collective_summary(ops),
+        "_ops": ops,
+        "transfers": transfers,
+        "marked": marks["aliased"] + marks["donors"],
+        "compiled_aliases": hlo.compiled_alias_count(ctext),
+    }
+
+
+def audit_client_program(spec: ProgramSpec, mesh=None,
+                         donate: bool = True) -> Dict:
+    """Audit one client-round program. ``donate=False`` exists for the
+    regression test: dropping donation must fail the coverage check."""
+    W = MESH_W if spec.use_mesh else CHUNK_W
+    cfg = make_cfg(spec.mode, W, **spec.cfg_kw)
+    if spec.use_mesh and mesh is None:
+        mesh = make_mesh(jax.devices())
+    fn = build_client_round(cfg, _toy_loss, B,
+                            mesh=mesh if spec.use_mesh else None,
+                            probes=spec.probes,
+                            probe_recovery=spec.probe_recovery)
+    jitted = jax.jit(fn, donate_argnums=(1,) if donate else ())
+    args = _client_inputs(cfg, mesh if spec.use_mesh else None)
+    entry = _audit_texts(jitted, args)
+    ops = entry.pop("_ops")
+
+    expected = _donated_leaves(args[1])
+    entry["donation"] = {"expected": expected,
+                         "marked": entry.pop("marked"),
+                         "compiled_aliases":
+                             entry.pop("compiled_aliases")}
+
+    ledger = 4 * cfg.upload_floats_per_client
+    static = hlo.matching_reduce_bytes(ops, "f32", cfg.transmit_shape)
+    entry["uplink"] = {
+        "ledger_bytes_per_client": ledger,
+        "aggregate_allreduce_bytes": static,
+        # local_topk sends the dense masked vector over the ICI: the
+        # 4·k ledger figure is the logical uplink, bounded by the
+        # 4·d wire bytes. Everything else must match exactly.
+        "relation": ("bound" if spec.mode == "local_topk"
+                     else "exact"),
+    }
+
+    failures = []
+    don = entry["donation"]
+    if don["marked"] < don["expected"]:
+        failures.append(
+            f"donation: {don['marked']}/{don['expected']} donated "
+            "state leaves marked in the lowered module — the "
+            "donation was dropped")
+    elif don["compiled_aliases"] < don["expected"]:
+        failures.append(
+            f"donation: XLA aliased {don['compiled_aliases']}/"
+            f"{don['expected']} donated state leaves — a donated "
+            "buffer is being copied instead of reused")
+    if entry["transfers"]:
+        failures.append(
+            f"host transfers in the round program: "
+            f"{entry['transfers'][:3]}")
+    if not entry["retrace_stable"]:
+        failures.append("fingerprint differs across two lowerings of "
+                        "the same builder (nondeterministic trace)")
+    if spec.path == "chunked":
+        if entry["collectives"]["counts"]:
+            failures.append(
+                "single-device chunked program emits collectives: "
+                f"{entry['collectives']['counts']}")
+    elif spec.mode == "local_topk":
+        if not (static >= ledger):
+            failures.append(
+                f"uplink: dense wire bytes {static} < logical ledger "
+                f"bytes {ledger}")
+    elif static != ledger:
+        failures.append(
+            f"uplink: aggregation all-reduce bytes {static} != ledger "
+            f"bytes/client {ledger} "
+            f"(shape {cfg.transmit_shape})")
+    entry.update(mode=spec.mode, path=spec.path, probes=spec.probes,
+                 failures=failures)
+    return entry
+
+
+def audit_server_program(mode: str, donate: bool = True) -> Dict:
+    """Audit the server round: ``donate_argnums=(0, 1)`` covers
+    ps_weights + both ServerState tables; the server step is
+    replicated, so the program must be collective- and transfer-free.
+
+    All three donated leaves (ps_weights, Vvelocity, Verror) alias in
+    every mode — non-virtual-error modes thread Verror through
+    unchanged and XLA still reuses the buffer — so the check is
+    exact."""
+    cfg = make_cfg(mode, MESH_W, **SERVER_CFG_KW[mode])
+    fn = build_server_round(cfg)
+    jitted = jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+    args = (jnp.zeros((D,), jnp.float32), ServerState.init(cfg),
+            jnp.ones(cfg.transmit_shape, jnp.float32),
+            jnp.float32(0.1))
+    entry = _audit_texts(jitted, args)
+    entry.pop("_ops")
+    entry["donation"] = {"expected": 1 + _donated_leaves(args[1]),
+                         "marked": entry.pop("marked"),
+                         "compiled_aliases":
+                             entry.pop("compiled_aliases")}
+    failures = []
+    don = entry["donation"]
+    if min(don["marked"], don["compiled_aliases"]) < don["expected"]:
+        failures.append(
+            f"donation: {don['marked']} marked / "
+            f"{don['compiled_aliases']} compiled-aliased of "
+            f"{don['expected']} donated server leaves — ps_weights "
+            "and both ServerState tables must reuse their buffers")
+    if entry["transfers"]:
+        failures.append(f"host transfers: {entry['transfers'][:3]}")
+    if entry["collectives"]["counts"]:
+        failures.append("replicated server step emits collectives: "
+                        f"{entry['collectives']['counts']}")
+    if not entry["retrace_stable"]:
+        failures.append("nondeterministic server trace")
+    entry.update(mode=mode, path="server", probes=False,
+                 failures=failures)
+    return entry
+
+
+def audit_bf16_canary() -> Dict:
+    """bf16 dtype discipline on a conv+dot canary: value_and_grad of a
+    small bf16 model must lower with every contraction in bf16 —
+    an f32 dot/conv means an operand was silently widened."""
+
+    def model_loss(params, x, y):
+        h = jax.lax.conv_general_dilated(
+            x, params["conv"], window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h = jnp.maximum(h, 0).reshape(x.shape[0], -1)
+        logits = h @ params["dense"]
+        return jnp.sum((logits.astype(jnp.float32) - y) ** 2)
+
+    bf16 = jnp.bfloat16
+    params = {"conv": jax.ShapeDtypeStruct((3, 3, 2, 4), bf16),
+              "dense": jax.ShapeDtypeStruct((8 * 8 * 4, 8), bf16)}
+    x = jax.ShapeDtypeStruct((2, 8, 8, 2), bf16)
+    y = jax.ShapeDtypeStruct((2, 8), jnp.float32)
+    jitted = jax.jit(jax.value_and_grad(model_loss))
+    text = jitted.lower(params, x, y).as_text()
+    dots = hlo.dot_dtype_inventory(text)
+    failures = []
+    if dots.get("f32", 0):
+        failures.append(
+            f"{dots['f32']} f32 dot/conv op(s) in the bf16 model "
+            f"path (inventory: {dots}) — silent widening")
+    if not dots.get("bf16", 0):
+        failures.append(f"no bf16 contractions found at all ({dots})"
+                        " — parser or model drift")
+    return {"mode": "bf16_canary", "path": "lowered-only",
+            "probes": False, "dot_dtypes": dots,
+            "fingerprint": hlo.fingerprint(text),
+            "retrace_stable": True, "failures": failures}
+
+
+def run_program_audit(server: bool = True) -> Dict:
+    """The full matrix. Returns a JSON-able report:
+    ``{"programs": {name: entry}, "failures": [str]}`` — ``failures``
+    flattens every entry's failed invariant checks."""
+    report: Dict = {"jax_version": jax.__version__,
+                    "device_count": jax.device_count(),
+                    "programs": {}}
+    mesh = make_mesh(jax.devices())
+    for spec in build_specs():
+        report["programs"][spec.name] = audit_client_program(
+            spec, mesh=mesh)
+    if server:
+        for mode in SERVER_CFG_KW:
+            report["programs"][f"{mode}/server"] = \
+                audit_server_program(mode)
+    report["programs"]["bf16_canary"] = audit_bf16_canary()
+    report["failures"] = [
+        f"{name}: {msg}"
+        for name, entry in report["programs"].items()
+        for msg in entry["failures"]]
+    return report

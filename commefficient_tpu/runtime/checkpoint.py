@@ -81,16 +81,19 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         # so the store snapshot below is complete
         model._store_writeback()
 
-    arrays = {"ps_weights": _host(model.ps_weights)}
+    # checkpoint save is a deliberate full sync OFF the round hot
+    # path (epoch cadence): materialising state here is the point,
+    # and no telemetry round record is open to attribute it to
+    arrays = {"ps_weights": _host(model.ps_weights)}  # audit: allow(host-sync)
     cs = model.client_states
     for name, val in (("cs_velocities", cs.velocities),
                       ("cs_errors", cs.errors),
                       ("cs_weights", cs.weights)):
         if val is not None:
-            arrays[name] = _host(val)
+            arrays[name] = _host(val)  # audit: allow(host-sync)
     ss = opt.server_state
-    arrays["ss_Vvelocity"] = _host(ss.Vvelocity)
-    arrays["ss_Verror"] = _host(ss.Verror)
+    arrays["ss_Vvelocity"] = _host(ss.Vvelocity)  # audit: allow(host-sync)
+    arrays["ss_Verror"] = _host(ss.Verror)  # audit: allow(host-sync)
     arrays["last_updated"] = model.last_updated
     arrays["client_last_seen"] = model.client_last_seen
     if getattr(model, "model_state", None) is not None:
@@ -99,6 +102,7 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         from jax.tree_util import keystr, tree_flatten_with_path
         leaves, _ = tree_flatten_with_path(model.model_state)
         for leaf_path, leaf in leaves:
+            # audit: allow(host-sync) — same checkpoint-save sync
             arrays["bnstats:" + keystr(leaf_path)] = _host(leaf)
 
     meta = {

@@ -291,6 +291,7 @@ class FedModel:
         """Shutdown protocol parity (fed_aggregator.py:197-204): a
         device barrier, plus host client-store teardown (prefetch
         thread join, final write-back, spill-file removal)."""
+        # audit: allow(host-sync) — the shutdown barrier IS the sync
         jax.block_until_ready(self.ps_weights)
         if self._prefetcher is not None:
             self._prefetcher.close()
@@ -544,8 +545,14 @@ class FedModel:
             return []
         if not force and len(self._inflight) < self.pipeline_depth:
             return []
-        rounds = iter([[_host(m) for m in ms]
-                       for ms in self._inflight])
+        # the pipelined path's big blocking sync: every buffered
+        # round's metrics materialise here, so ledger-attribute it
+        # like the synchronous path does (the span lands on the
+        # current record — the flush boundary — which is where the
+        # wall-clock actually goes)
+        with self.telemetry.span("metrics_host"):
+            rounds = iter([[_host(m) for m in ms]
+                           for ms in self._inflight])
         self._inflight = []
         oplog, self._oplog = self._oplog, []
         results = []
@@ -621,11 +628,14 @@ class FedModel:
     def _call_val(self, batch):
         dev_batch = shard_batch(self.mesh, jax.tree_util.tree_map(
             jnp.asarray, batch))
-        if self.stats_fn is not None:
-            out = _host(self._val_fn(self.ps_weights,
-                                       self.model_state, dev_batch))
-        else:
-            out = _host(self._val_fn(self.ps_weights, dev_batch))
+        # eval metrics cross to the host like train metrics do —
+        # attribute the sync (a no-op span when no round is open)
+        with self.telemetry.span("metrics_host"):
+            if self.stats_fn is not None:
+                out = _host(self._val_fn(self.ps_weights,
+                                         self.model_state, dev_batch))
+            else:
+                out = _host(self._val_fn(self.ps_weights, dev_batch))
         # (S, n_metrics) -> per-shard metric arrays, like the
         # reference's split_results (fed_aggregator.py:617-618), plus
         # per-shard real-sample counts so callers can weight out the

@@ -577,6 +577,7 @@ def main(argv=None):
         import pickle
         os.makedirs(args.checkpoint_path, exist_ok=True)
         path = os.path.join(args.checkpoint_path, args.model + ".pkl")
+        # audit: allow(host-sync) — end-of-run checkpoint write
         params = jax.device_get(model.params())
         with open(path, "wb") as f:
             pickle.dump(params, f)

@@ -39,6 +39,7 @@ def devices():
 FAST_MODULES = {
     "test_ops",
     "test_accounting",
+    "test_audit",
     "test_sharding",
     "test_data_breadth",
     "test_telemetry",

@@ -1,0 +1,319 @@
+"""Static-analysis subsystem (analysis/): HLO text parsers, the AST
+lint rules (each must trip on a seeded violation and respect waivers),
+program-audit regressions (dropping donate_argnums must FAIL the
+donation check), the collective-inventory <-> ledger byte cross-check
+for all five modes, and the tier-1 baseline gate against the
+committed audit_baseline.json."""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from commefficient_tpu.analysis import baseline as base_mod
+from commefficient_tpu.analysis import hlo
+from commefficient_tpu.analysis.lint import (RULES_BY_NAME, lint_report,
+                                             run_lint, unwaived)
+from commefficient_tpu.analysis.program import (SERVER_CFG_KW,
+                                                ProgramSpec,
+                                                audit_client_program,
+                                                audit_server_program,
+                                                make_cfg,
+                                                run_program_audit)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def audit_report():
+    """One full program audit per test module — every entry below
+    reads from it instead of re-lowering the matrix."""
+    return run_program_audit()
+
+
+# --- HLO text parsers --------------------------------------------------
+
+
+COMPILED_SNIPPET = """\
+HloModule jit_f, input_output_alias={ {1}: (1, {}, may-alias), {3}: (2, {}, may-alias) }, entry_computation_layout=...
+  %all-reduce.7 = f32[64]{0} all-reduce(f32[64]{0} %add.3), replica_groups={{0,1}}
+  %ar2 = (f32[2,16]{1,0}, f32[]) all-reduce(%a, %b), channel_id=1
+  %ag = bf16[8,64]{1,0} all-gather(bf16[1,64]{1,0} %x), dimensions={0}
+  %ars = f32[8]{0} all-reduce-start(f32[8]{0} %y)
+  %ard = f32[8]{0} all-reduce-done(f32[8]{0} %ars)
+"""
+
+
+def test_collective_inventory_parses_shapes_and_async():
+    ops = hlo.collective_inventory(COMPILED_SNIPPET)
+    kinds = sorted(o.kind for o in ops)
+    # -done retires the -start; counting both would double the bytes
+    assert kinds == ["all-gather", "all-reduce", "all-reduce",
+                     "all-reduce"]
+    summary = hlo.collective_summary(ops)
+    assert summary["counts"] == {"all-reduce": 3, "all-gather": 1}
+    # 64*4 + (2*16*4 + 4) + 8*4 for the reduces; 8*64*2 for the gather
+    assert summary["bytes"]["all-reduce"] == 256 + 132 + 32
+    assert summary["bytes"]["all-gather"] == 1024
+    # variadic components match individually, scalars excluded
+    assert hlo.matching_reduce_bytes(ops, "f32", (2, 16)) == 128
+    assert hlo.matching_reduce_bytes(ops, "f32", (64,)) == 256
+
+
+def test_compiled_alias_count_handles_nested_braces():
+    assert hlo.compiled_alias_count(COMPILED_SNIPPET) == 2
+    assert hlo.compiled_alias_count("HloModule jit_g, entry=...") == 0
+
+
+def test_transfer_scan_flags_outfeed_not_substrings():
+    text = ("  %o = token[] outfeed(f32[2]{0} %v, token[] %t)\n"
+            "  %s = f32[2]{0} sort(%v), dimensions={0} "
+            "is_stable=true descending\n")
+    hits = hlo.host_transfer_lines(text)
+    assert len(hits) == 1 and "outfeed" in hits[0]
+
+
+def test_fingerprint_ignores_locations():
+    a = 'module @jit_f {\n  %0 = stablehlo.add %a, %b loc("x.py":1:2)\n}'
+    b = 'module @jit_f {\n  %0 = stablehlo.add %a, %b loc("y.py":9:9)\n}'
+    c = 'module @jit_f {\n  %0 = stablehlo.mul %a, %b\n}'
+    assert hlo.fingerprint(a) == hlo.fingerprint(b)
+    assert hlo.fingerprint(a) != hlo.fingerprint(c)
+
+
+# --- lint rules: each fires on a seeded violation ----------------------
+
+
+SEEDED = {
+    # path (under a fake package root) -> (source, rule that must fire)
+    "runtime/clocky.py": ("""
+        import time
+        def f():
+            t0 = time.perf_counter()
+            return time.time() - t0
+        """, "raw-clock"),
+    "runtime/probey.py": ("""
+        def flush(res):
+            # probe scalars
+            vals = [_host(v) for v in res.probes]
+            return vals
+        """, "probe-transfer-span"),
+    "runtime/syncy.py": ("""
+        import jax
+        def step(x):
+            jax.block_until_ready(x)
+            return x.item()
+        """, "host-sync"),
+    "core/tracer_leak.py": ("""
+        import numpy as np
+        def build(cfg):
+            def traced(x):
+                return np.asarray(x) * 2
+            return traced
+        """, "np-on-tracer"),
+    "ops/rngy.py": ("""
+        import random
+        import numpy as np
+        def noise():
+            return random.random() + np.random.randn()
+        """, "python-rng"),
+    "core/defaulty.py": ("""
+        def accumulate(x, out=[]):
+            out.append(x)
+            return out
+        """, "mutable-default-arg"),
+}
+
+
+@pytest.fixture()
+def seeded_root(tmp_path):
+    for rel, (src, _rule) in SEEDED.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+@pytest.mark.parametrize("rel", sorted(SEEDED))
+def test_each_rule_fires(seeded_root, rel):
+    rule = SEEDED[rel][1]
+    hits = unwaived(run_lint(root=seeded_root,
+                             rules=[RULES_BY_NAME[rule]]))
+    assert any(v.path == rel for v in hits), \
+        f"rule {rule} did not fire on {rel}: {hits}"
+
+
+def test_waiver_suppresses_and_is_recorded(tmp_path):
+    p = tmp_path / "runtime" / "waived.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import time\n"
+                 "def f():\n"
+                 "    # audit: allow(raw-clock) — test fixture\n"
+                 "    return time.time()\n")
+    vs = run_lint(root=tmp_path, rules=[RULES_BY_NAME["raw-clock"]])
+    assert len(vs) == 1 and vs[0].waived
+    assert unwaived(vs) == []
+    # a waiver for a DIFFERENT rule does not suppress
+    p.write_text("import time\n"
+                 "def f():\n"
+                 "    # audit: allow(host-sync)\n"
+                 "    return time.time()\n")
+    vs = run_lint(root=tmp_path, rules=[RULES_BY_NAME["raw-clock"]])
+    assert len(unwaived(vs)) == 1
+
+
+def test_span_scoped_host_sync_passes(tmp_path):
+    p = tmp_path / "runtime" / "ok.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("def f(tel, x):\n"
+                 '    with tel.span("metrics_host"):\n'
+                 "        return _host(x)\n")
+    assert run_lint(root=tmp_path,
+                    rules=[RULES_BY_NAME["host-sync"]]) == []
+
+
+def test_module_level_numpy_in_ops_is_fine(tmp_path):
+    # hash-constant setup (ops/sketch.py idiom) must NOT be flagged:
+    # only nested (traced) closures are in scope for np-on-tracer
+    p = tmp_path / "ops" / "setup.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import numpy as np\n"
+                 "TABLE = np.asarray([1, 2, 3])\n"
+                 "def make(x):\n"
+                 "    return np.asarray(x, np.uint32)\n")
+    assert run_lint(root=tmp_path,
+                    rules=[RULES_BY_NAME["np-on-tracer"]]) == []
+
+
+def test_repo_lint_is_clean():
+    assert unwaived(run_lint()) == [], \
+        "unwaived lint violations in the package"
+
+
+# --- program audit: regression fixtures --------------------------------
+
+
+def test_dropping_donation_fails_the_check():
+    """The audit's reason to exist: remove donate_argnums from a
+    state-carrying round and the donation check must go red."""
+    spec = ProgramSpec("uncompressed/per_client", "uncompressed",
+                       "per_client",
+                       dict(virtual_momentum=0.9, local_momentum=0.9))
+    entry = audit_client_program(spec, donate=False)
+    assert any("donation" in f for f in entry["failures"]), entry
+
+
+def test_dropping_server_donation_fails_the_check():
+    entry = audit_server_program("sketch", donate=False)
+    assert any("donation" in f for f in entry["failures"]), entry
+
+
+def test_program_audit_is_clean(audit_report):
+    assert audit_report["failures"] == []
+
+
+def test_fingerprints_are_retrace_stable(audit_report):
+    unstable = [n for n, e in audit_report["programs"].items()
+                if not e["retrace_stable"]]
+    assert unstable == []
+
+
+def test_round_programs_are_transfer_free(audit_report):
+    leaky = {n: e["transfers"]
+             for n, e in audit_report["programs"].items()
+             if e.get("transfers")}
+    assert leaky == {}
+
+
+# --- collective inventory <-> ledger cross-check -----------------------
+
+
+# same shapes as tests/test_accounting.py MODES: the static wire bytes
+# must agree with the brute-force ledger accounting's
+# 4 * upload_floats_per_client per participating client
+@pytest.mark.parametrize("name", [
+    "sketch/fused", "true_topk/fused", "uncompressed/fused",
+    "sketch/per_client", "true_topk/per_client",
+    "uncompressed/per_client", "fedavg/per_client",
+])
+def test_static_uplink_bytes_match_ledger_exactly(audit_report, name):
+    up = audit_report["programs"][name]["uplink"]
+    assert up["relation"] == "exact"
+    assert up["aggregate_allreduce_bytes"] == \
+        up["ledger_bytes_per_client"], up
+
+
+def test_ledger_bytes_agree_with_accounting_formula(audit_report):
+    """Anchor the cross-check to the same source of truth
+    tests/test_accounting.py brute-forces: uplink bytes per client are
+    4 * cfg.upload_floats_per_client."""
+    for name, entry in audit_report["programs"].items():
+        if "uplink" not in entry:
+            continue
+        cfg = make_cfg(entry["mode"], 8,
+                       **SERVER_CFG_KW[entry["mode"]])
+        if entry["mode"] == "sketch":
+            assert entry["uplink"]["ledger_bytes_per_client"] == \
+                4 * cfg.num_rows * cfg.num_cols
+        elif entry["mode"] == "local_topk":
+            assert entry["uplink"]["ledger_bytes_per_client"] == \
+                4 * cfg.k
+        else:
+            assert entry["uplink"]["ledger_bytes_per_client"] == \
+                4 * cfg.grad_size
+
+
+def test_local_topk_wire_bytes_bound_ledger(audit_report):
+    """local_topk reduces the DENSE masked vector over the ICI: the
+    4k logical uplink is a lower bound on the 4d wire bytes, not an
+    equality — the documented exception."""
+    up = audit_report["programs"]["local_topk/per_client"]["uplink"]
+    assert up["relation"] == "bound"
+    assert up["aggregate_allreduce_bytes"] >= \
+        up["ledger_bytes_per_client"]
+    assert up["aggregate_allreduce_bytes"] > 0
+
+
+def test_chunked_and_server_programs_are_collective_free(audit_report):
+    for name, entry in audit_report["programs"].items():
+        if entry["path"] in ("chunked", "server"):
+            assert entry["collectives"]["counts"] == {}, (name, entry)
+
+
+# --- tier-1 baseline gate ----------------------------------------------
+
+
+def test_report_matches_committed_baseline(audit_report):
+    """The CI gate: a fresh audit must diff clean against the
+    committed audit_baseline.json. Any new collective, lost donation,
+    host transfer, fingerprint drift, or new lint waiver fails here
+    until `python scripts/audit.py --write-baseline` re-pins it (and
+    the diff is reviewed)."""
+    baseline_path = REPO_ROOT / "audit_baseline.json"
+    assert baseline_path.exists(), \
+        "audit_baseline.json missing — run scripts/audit.py " \
+        "--write-baseline"
+    baseline = base_mod.load_baseline(baseline_path)
+    report = base_mod.build_report(audit_report,
+                                   lint_report(run_lint()))
+    problems = base_mod.diff_against_baseline(report, baseline)
+    assert problems == [], "\n".join(problems)
+
+
+def test_baseline_roundtrip_and_diff_detects_drift(audit_report):
+    report = base_mod.build_report(audit_report,
+                                   lint_report(run_lint()))
+    pinned = json.loads(json.dumps(base_mod.to_baseline(report)))
+    assert base_mod.diff_against_baseline(report, pinned) == []
+    # fingerprint drift is a visible failure
+    name = next(iter(pinned["programs"]))
+    pinned["programs"][name]["fingerprint"] = "0" * 64
+    problems = base_mod.diff_against_baseline(report, pinned)
+    assert any("fingerprint changed" in p for p in problems)
+    # a fresh waiver is a visible failure too
+    pinned2 = json.loads(json.dumps(base_mod.to_baseline(report)))
+    report2 = json.loads(json.dumps(report))
+    report2["lint"]["waived"].append("x.py:1: host-sync: new [waived]")
+    problems = base_mod.diff_against_baseline(report2, pinned2)
+    assert any("new lint waiver" in p for p in problems)

@@ -6,7 +6,6 @@ raw-clock grep guard (all host-side timing flows through
 import importlib.util
 import json
 import os
-import pathlib
 
 import numpy as np
 
@@ -15,11 +14,16 @@ from commefficient_tpu.telemetry import (NULL_TELEMETRY, Telemetry,
 from commefficient_tpu.telemetry.core import NULL_SPAN
 from commefficient_tpu.telemetry.sinks import ConsoleSink, JSONLSink
 
-PKG_ROOT = pathlib.Path(__file__).resolve().parents[1] \
-    / "commefficient_tpu"
+# --- clock + probe-span guards (now linter rules) ---------------------
+# The original grep guards were promoted to first-class rules in the
+# analysis/lint.py AST engine (PR 4); these thin wrappers keep the
+# guards in tier-1 while leaving one source of truth for each rule.
 
 
-# --- raw-clock grep guard ---------------------------------------------
+def _run_rule(name):
+    from commefficient_tpu.analysis.lint import (RULES_BY_NAME,
+                                                 run_lint, unwaived)
+    return unwaived(run_lint(rules=[RULES_BY_NAME[name]]))
 
 
 def test_no_raw_clocks_outside_telemetry():
@@ -27,18 +31,11 @@ def test_no_raw_clocks_outside_telemetry():
     telemetry/ (clock.py is the one place raw clocks live); everything
     else must go through ``telemetry.clock`` so spans, Timer and the
     ledger agree on what a second is."""
-    offenders = []
-    for path in sorted(PKG_ROOT.rglob("*.py")):
-        rel = path.relative_to(PKG_ROOT)
-        if rel.parts[0] == "telemetry":
-            continue
-        text = path.read_text()
-        for lineno, line in enumerate(text.splitlines(), 1):
-            if "time.time(" in line or "perf_counter" in line:
-                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    offenders = _run_rule("raw-clock")
     assert not offenders, (
         "raw clock calls outside commefficient_tpu/telemetry/ "
-        "(use telemetry.clock.wall/tick):\n" + "\n".join(offenders))
+        "(use telemetry.clock.wall/tick):\n"
+        + "\n".join(map(str, offenders)))
 
 
 def test_probe_host_transfers_only_inside_metrics_host_span():
@@ -47,29 +44,11 @@ def test_probe_host_transfers_only_inside_metrics_host_span():
     the probes' entire runtime cost, so it must be ledger-attributed —
     an unspanned transfer would both hide that cost and add a second
     blocking device round-trip per round."""
-    offenders = []
-    for path in sorted(PKG_ROOT.rglob("*.py")):
-        rel = path.relative_to(PKG_ROOT)
-        if rel.parts[0] == "telemetry":
-            continue
-        lines = path.read_text().splitlines()
-        for i, line in enumerate(lines):
-            if "_host(" not in line and "device_get(" not in line:
-                continue
-            stripped = line.lstrip()
-            if stripped.startswith("#") or stripped.startswith("def "):
-                continue
-            # only transfers of probe values are in scope: the call
-            # site or its immediate context names them
-            ctx = "\n".join(lines[max(0, i - 3):i + 2])
-            if "probe" not in ctx.lower() and "sprobes" not in ctx:
-                continue
-            back = "\n".join(lines[max(0, i - 10):i + 1])
-            if 'span("metrics_host")' not in back:
-                offenders.append(f"{rel}:{i + 1}: {line.strip()}")
+    offenders = _run_rule("probe-transfer-span")
     assert not offenders, (
         "probe values crossed to the host outside a "
-        'span("metrics_host") block:\n' + "\n".join(offenders))
+        'span("metrics_host") block:\n'
+        + "\n".join(map(str, offenders)))
 
 
 # --- disabled fast path -----------------------------------------------
