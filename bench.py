@@ -142,6 +142,13 @@ def main(argv=None):
             round_times_s=[round(t, 4) for t in times],
             backend=jax.default_backend()))
         sink.close()
+        # run manifest: makes this bench discoverable by
+        # scripts/perf_gate.py --runs_dir / telemetry_report --runs_dir
+        from commefficient_tpu.telemetry import registry
+        registry.maybe_write_manifest(
+            bench_args, bench={line["metric"]: line},
+            extra={"bench_config": registry.config_dict(cfg),
+                   "rounds": ROUNDS, "workers": W})
 
 
 if __name__ == "__main__":

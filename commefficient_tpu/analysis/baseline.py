@@ -31,7 +31,9 @@ def build_report(program_report: Dict, lint_summary: Dict) -> Dict:
             "lint": lint_summary,
             "programs": program_report.get("programs", {}),
             "failures": list(program_report.get("failures", []))
-            + [f"lint: {v}" for v in lint_summary.get("unwaived", [])]}
+            + [f"lint: {v}" for v in lint_summary.get("unwaived", [])]
+            + [f"lint: {v}" for v in lint_summary.get("stale_waivers",
+                                                      [])]}
 
 
 def to_baseline(report: Dict) -> Dict:

@@ -344,10 +344,50 @@ def unwaived(violations: List[Violation]) -> List[Violation]:
     return [v for v in violations if not v.waived]
 
 
-def lint_report(violations: List[Violation]) -> Dict:
+def stale_waivers(root: Optional[pathlib.Path] = None,
+                  violations: Optional[List[Violation]] = None
+                  ) -> List[str]:
+    """Waiver comments that no longer suppress anything. An
+    ``allow(R)`` waiver comment at line L covers an R violation at L
+    or L + 1 (the inverse of ``waived_rules_at``); when the code it
+    excused was fixed or moved, the waiver outlives it and silently
+    licenses future regressions on that line — so the audit flags it
+    for deletion. Also flags waivers naming unknown rules (typo'd
+    waivers waive nothing)."""
+    root = PKG_ROOT if root is None else pathlib.Path(root)
+    if violations is None:
+        violations = run_lint(root)
+    waived_by_path: Dict[str, List[Violation]] = {}
+    for v in violations:
+        if v.waived:
+            waived_by_path.setdefault(v.path, []).append(v)
+    out: List[str] = []
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        vs = waived_by_path.get(rel, [])
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            m = WAIVER_RE.search(line)
+            if not m:
+                continue
+            for rule in sorted(x.strip()
+                               for x in m.group(1).split(",")):
+                if rule not in RULES_BY_NAME:
+                    out.append(f"{rel}:{i}: waiver names unknown "
+                               f"rule '{rule}'")
+                elif not any(v.rule == rule and v.line in (i, i + 1)
+                             for v in vs):
+                    out.append(f"{rel}:{i}: stale waiver "
+                               f"allow({rule}) — no {rule} violation "
+                               "on this or the next line")
+    return out
+
+
+def lint_report(violations: List[Violation],
+                stale: Optional[List[str]] = None) -> Dict:
     """JSON-able summary for scripts/audit.py and the baseline."""
     return {
         "rules": sorted(RULES_BY_NAME),
         "unwaived": [str(v) for v in unwaived(violations)],
         "waived": sorted(str(v) for v in violations if v.waived),
+        "stale_waivers": list(stale if stale is not None else ()),
     }

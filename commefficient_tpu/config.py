@@ -288,6 +288,12 @@ class Config:
     # recovery-error rule: ‖unsketch(S(g)) − g‖/‖g‖ above this (1.0 =
     # the recovered update is no better than sending nothing)
     alarm_recovery_error: float = 1.0
+    # step-time regression rule (telemetry/alarms.py): fire when a
+    # round's wall step time exceeds this ratio x the rolling median
+    # of the last --alarm_step_time_window rounds. 0 = off. Works
+    # without probes; shares the --on_divergence action.
+    alarm_step_time_ratio: float = 0.0
+    alarm_step_time_window: int = 16
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -321,6 +327,10 @@ class Config:
             "--on_divergence must be log|ledger-flag|abort"
         assert self.alarm_residual_rounds >= 1, \
             "--alarm_residual_rounds must be >= 1"
+        assert self.alarm_step_time_ratio >= 0, \
+            "--alarm_step_time_ratio must be >= 0 (0 = rule off)"
+        assert self.alarm_step_time_window >= 2, \
+            "--alarm_step_time_window must be >= 2"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -599,6 +609,16 @@ def build_parser(default_lr: Optional[float] = None,
                         default=1.0,
                         help="fire when relative sketch-recovery "
                         "error exceeds this")
+    parser.add_argument("--alarm_step_time_ratio", type=float,
+                        default=0.0,
+                        help="step_time_regression rule: fire when a "
+                        "round's wall step time exceeds this ratio x "
+                        "the rolling median (0 = off; action from "
+                        "--on_divergence)")
+    parser.add_argument("--alarm_step_time_window", type=int,
+                        default=16,
+                        help="rolling-median window (rounds) for "
+                        "--alarm_step_time_ratio")
 
     return parser
 

@@ -45,7 +45,7 @@ from commefficient_tpu.core.rounds import (ClientStates,
                                            build_server_round,
                                            build_val_fn, round_plan)
 from commefficient_tpu.core.server import ServerState
-from commefficient_tpu.telemetry import build_telemetry
+from commefficient_tpu.telemetry import build_telemetry, clock, trace
 from commefficient_tpu.ops.vec import flatten_params
 from commefficient_tpu.parallel import make_mesh
 from commefficient_tpu.parallel.mesh import client_sharding, shard_batch
@@ -270,6 +270,9 @@ class FedModel:
         self._prev_residual = None
         from commefficient_tpu.telemetry.alarms import build_alarm_engine
         self.alarm_engine = build_alarm_engine(args, self.telemetry)
+        # roofline cost model (analysis/cost.py), computed lazily at
+        # the first --profile'd round from the lowered round program
+        self._cost_model = None
         self.telemetry.emit_meta(
             num_clients=num_clients,
             num_devices=int(np.prod(self.mesh.devices.shape)),
@@ -291,6 +294,7 @@ class FedModel:
         """Shutdown protocol parity (fed_aggregator.py:197-204): a
         device barrier, plus host client-store teardown (prefetch
         thread join, final write-back, spill-file removal)."""
+        trace.end_round_marker()
         # audit: allow(host-sync) — the shutdown barrier IS the sync
         jax.block_until_ready(self.ps_weights)
         if self._prefetcher is not None:
@@ -450,10 +454,18 @@ class FedModel:
         tel = self.telemetry
         ridx = self.round_index
         tel.begin_round(ridx)
+        # device-timeline marker, same lifecycle as the ledger record
+        # (closed by the next round's begin): a flag check when no
+        # profiler trace window is open
+        trace.begin_round_marker(ridx)
+        eng = self.alarm_engine
+        step_t0 = (clock.tick()
+                   if eng is not None and eng.step_time_ratio > 0
+                   and self.pipeline_depth <= 1 else None)
         ids_np = np.asarray(batch["client_ids"])
         dev_batch = {k: v for k, v in batch.items()
                      if k != "client_ids"}
-        with tel.span("h2d"):
+        with tel.span("h2d"), trace.phase("h2d"):
             dev_batch = shard_batch(self.mesh, jax.tree_util.tree_map(
                 jnp.asarray, dev_batch))
             ids = jax.device_put(jnp.asarray(ids_np, jnp.int32))
@@ -472,7 +484,14 @@ class FedModel:
         if (self._client_round_probed is not None
                 and ridx % self.probe_period == 0):
             round_fn = self._client_round_probed
-        with tel.span("round_dispatch"):
+        if (self._cost_model is None and tel.enabled
+                and getattr(args, "do_profile", False)):
+            # roofline expectation from this round's lowered program —
+            # once per run, text-only (no second compile)
+            self._emit_cost_model(
+                round_fn, (self.ps_weights, cs_in, dev_batch, ids,
+                           rng, jnp.float32(self.fedavg_lr)))
+        with tel.span("round_dispatch"), trace.phase("round_dispatch"):
             res = round_fn(self.ps_weights, cs_in,
                            dev_batch, ids, rng,
                            jnp.float32(self.fedavg_lr))
@@ -520,7 +539,7 @@ class FedModel:
             if res.probes is not None:
                 self._probe_log.setdefault(ridx, {}).update(res.probes)
             return None
-        with tel.span("metrics_host"):
+        with tel.span("metrics_host"), trace.phase("metrics_host"):
             metrics = [_host(m) for m in res.metrics]
             probe_vals = (None if res.probes is None else
                           {k: float(_host(v))
@@ -531,6 +550,11 @@ class FedModel:
             # alarms via _finish_probes
             tel.merge_round_probes(ridx, probe_vals)
             self._probe_host[ridx] = probe_vals
+        if step_t0 is not None:
+            # wall step time through the metrics sync — evaluated
+            # before set_round_bytes so an aborting alarm still lands
+            # on the record telemetry.close() will flush
+            eng.check_step_time(ridx, clock.tick() - step_t0)
         down, up = self._account_bytes(ids_np, batch["mask"])
         tel.set_round_bytes(ridx, float(down.sum()), float(up.sum()))
         return metrics + [down, up]
@@ -593,6 +617,38 @@ class FedModel:
         self.telemetry.merge_round_probes(ridx, full)
         if self.alarm_engine is not None:
             self.alarm_engine.check(ridx, full)
+
+    def _emit_cost_model(self, round_fn, round_args):
+        """Roofline expectation for this run's round program
+        (analysis/cost.py): lower the jitted round with the first
+        profiled round's concrete arguments — text only, the XLA
+        compile is NOT repeated — count its dot/conv FLOPs and emit
+        the cost model as a ledger meta record. Registers
+        ``expected_round_s`` on the telemetry so the trace window's
+        device-time buckets carry ``roofline_utilization``. Any
+        failure degrades to a warning; the marker stays set so it is
+        not retried every round."""
+        self._cost_model = {}
+        try:
+            from commefficient_tpu.analysis.cost import build_cost_model
+            text = round_fn.lower(*round_args).as_text()
+            n_dev = int(np.prod(self.mesh.devices.shape))
+            dev0 = self.mesh.devices.flat[0]
+            cost = build_cost_model(
+                text,
+                backend=jax.default_backend(),
+                device_kind=getattr(dev0, "device_kind", ""),
+                n_devices=n_dev,
+                allreduce_payload_bytes=(
+                    4.0 * self.args.upload_floats_per_client),
+                label=(f"{self.args.mode}/{self.clientstore}/"
+                       f"{n_dev}dev"))
+            self._cost_model = cost
+            self.telemetry.expected_round_s = cost["expected_round_s"]
+            self.telemetry.emit_meta(cost_model=cost)
+        except Exception as e:  # noqa: BLE001 — observability only
+            print(f"WARNING: roofline cost model skipped "
+                  f"({type(e).__name__}: {e})")
 
     def _rebuild_round_counts(self):
         """Histogram of ``last_updated`` by round (index = round + 1).
@@ -785,7 +841,7 @@ class FedOptimizer:
         # round ridx's ledger record is still current (the next
         # _call_train's begin_round closes it), so the server span
         # lands on the round whose aggregate it consumes
-        with m.telemetry.span("server"):
+        with m.telemetry.span("server"), trace.phase("server"):
             out = self._server_round(
                 m.ps_weights, self.server_state,
                 m.pending_aggregated,

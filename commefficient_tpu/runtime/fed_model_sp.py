@@ -42,6 +42,7 @@ from commefficient_tpu.core.rounds_sp import (build_sp_gpt2_round,
                                               make_sp_mesh,
                                               shift_lm_labels)
 from commefficient_tpu.runtime.fed_model import FedModel
+from commefficient_tpu.telemetry import clock, trace
 
 
 class SeqParallelFedModel(FedModel):
@@ -117,13 +118,18 @@ class SeqParallelFedModel(FedModel):
         tel = self.telemetry
         ridx = self.round_index
         tel.begin_round(ridx)
+        trace.begin_round_marker(ridx)
+        eng = self.alarm_engine
+        step_t0 = (clock.tick()
+                   if eng is not None and eng.step_time_ratio > 0
+                   else None)
         ids_np = np.asarray(batch["client_ids"])
         W = ids_np.shape[0]
         if W % self._sp_mesh.shape["clients"] != 0:
             raise ValueError(
                 f"num_workers {W} must be divisible by the client "
                 f"axis {self._sp_mesh.shape['clients']}")
-        with tel.span("h2d"):
+        with tel.span("h2d"), trace.phase("h2d"):
             sp_batch = {
                 "input_ids": jnp.asarray(batch["input_ids"]),
                 "token_type_ids": jnp.asarray(batch["token_type_ids"]),
@@ -137,7 +143,11 @@ class SeqParallelFedModel(FedModel):
         if (self._sp_round_probed is not None
                 and ridx % self.probe_period == 0):
             round_fn = self._sp_round_probed
-        with tel.span("round_dispatch"):
+        if (self._cost_model is None and tel.enabled
+                and getattr(self.args, "do_profile", False)):
+            self._emit_cost_model(round_fn,
+                                  (self.ps_weights, sp_batch))
+        with tel.span("round_dispatch"), trace.phase("round_dispatch"):
             agg, per_client_loss, probes = round_fn(self.ps_weights,
                                                     sp_batch)
         self.pending_aggregated = agg
@@ -149,7 +159,7 @@ class SeqParallelFedModel(FedModel):
         # device_get: the (W,) vector is client-axis sharded and not
         # fully addressable on a multi-process mesh
         from commefficient_tpu.runtime.fed_model import _host
-        with tel.span("metrics_host"):
+        with tel.span("metrics_host"), trace.phase("metrics_host"):
             metrics = [np.asarray(_host(per_client_loss), np.float64)]
             probe_vals = (None if probes is None else
                           {k: float(_host(v))
@@ -157,6 +167,8 @@ class SeqParallelFedModel(FedModel):
         if probe_vals is not None:
             tel.merge_round_probes(ridx, probe_vals)
             self._probe_host[ridx] = probe_vals
+        if step_t0 is not None:
+            eng.check_step_time(ridx, clock.tick() - step_t0)
         down, up = self._account_bytes(ids_np, batch["mask"])
         tel.set_round_bytes(ridx, float(down.sum()), float(up.sum()))
         return metrics + [down, up]

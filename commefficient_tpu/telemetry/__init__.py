@@ -12,7 +12,7 @@ lazily by its users, not here: it reaches back into ``utils`` for
 logdir naming and must not cycle through this package import.
 """
 
-from commefficient_tpu.telemetry import clock
+from commefficient_tpu.telemetry import clock, trace
 from commefficient_tpu.telemetry.core import (NULL_TELEMETRY, Telemetry,
                                               build_telemetry,
                                               hbm_peak_bytes,
@@ -28,6 +28,7 @@ from commefficient_tpu.telemetry.sinks import (ConsoleSink, JSONLSink,
 
 __all__ = [
     "clock",
+    "trace",
     "NULL_TELEMETRY",
     "Telemetry",
     "build_telemetry",

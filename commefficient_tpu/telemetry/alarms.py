@@ -17,6 +17,18 @@ round instead of silently training on garbage. Three rules:
                        ``--alarm_recovery_error`` (or non-finite);
                        1.0 means the recovered top-k is no better
                        than applying nothing.
+``step_time_regression`` — the round's wall step time drifted more
+                       than ``--alarm_step_time_ratio`` x above the
+                       run's rolling median (window
+                       ``--alarm_step_time_window``, after a short
+                       warmup that skips compile rounds). A
+                       *performance* alarm, not an algorithmic one:
+                       it catches the slow bleed (fragmentation, a
+                       background compile storm, thermal throttle)
+                       that end-of-run means average away. Evaluated
+                       on synchronous rounds only — pipelined
+                       dispatch times measure the host, not the
+                       round.
 
 Every fired rule is appended to the round record's ``alarms`` list
 (when a ledger is attached) regardless of action. The action then
@@ -30,6 +42,8 @@ from __future__ import annotations
 
 import logging
 import math
+from collections import deque
+from statistics import median
 
 logger = logging.getLogger("commefficient_tpu.telemetry.alarms")
 
@@ -59,14 +73,23 @@ class AlarmEngine:
     Telemetry (alarms still evaluate and can still abort — the
     ledger flag is just unrecorded)."""
 
+    #: step-time samples required before the regression rule arms —
+    #: the first rounds carry compile/warmup time and are not signal
+    STEP_TIME_WARMUP = 5
+
     def __init__(self, cfg, telemetry=None):
         assert cfg.on_divergence in ACTIONS, cfg.on_divergence
         self.action = cfg.on_divergence
         self.residual_ratio = float(cfg.alarm_residual_ratio)
         self.residual_rounds = int(cfg.alarm_residual_rounds)
         self.recovery_error = float(cfg.alarm_recovery_error)
+        self.step_time_ratio = float(
+            getattr(cfg, "alarm_step_time_ratio", 0.0) or 0.0)
+        self.step_time_window = int(
+            getattr(cfg, "alarm_step_time_window", 16) or 16)
         self.telemetry = telemetry
         self._consecutive = 0
+        self._step_times = deque(maxlen=self.step_time_window)
 
     def check(self, round_index: int, probes) -> list:
         """Run every rule on one round's probes. Returns the fired
@@ -103,6 +126,35 @@ class AlarmEngine:
                           "value": float(rerr),
                           "threshold": self.recovery_error})
 
+        return self._escalate(round_index, fired)
+
+    def check_step_time(self, round_index: int, step_s: float) -> list:
+        """``step_time_regression``: fires when this round's wall
+        step time exceeds ``step_time_ratio`` x the rolling median of
+        the last ``step_time_window`` rounds (after warmup). The
+        offending sample is NOT folded into the window — a sustained
+        regression keeps firing instead of re-normalising itself.
+        Same flag/log/abort escalation as the probe rules."""
+        if self.step_time_ratio <= 0:
+            return []
+        step_s = float(step_s)
+        if len(self._step_times) < self.STEP_TIME_WARMUP:
+            self._step_times.append(step_s)
+            return []
+        med = median(self._step_times)
+        threshold = self.step_time_ratio * med
+        if med <= 0 or step_s <= threshold:
+            self._step_times.append(step_s)
+            return []
+        fired = [{"rule": "step_time_regression",
+                  "value": step_s, "threshold": threshold,
+                  "rolling_median": med}]
+        return self._escalate(round_index, fired)
+
+    def _escalate(self, round_index: int, fired: list) -> list:
+        """Shared escalation tail: flag the ledger record, then act —
+        ``abort`` raises AFTER flagging so the record that reaches the
+        sink carries its alarms."""
         if not fired:
             return []
         for alarm in fired:
@@ -122,7 +174,9 @@ class AlarmEngine:
 
 
 def build_alarm_engine(cfg, telemetry=None):
-    """An engine when probes are on, else None (no per-round call)."""
-    if getattr(cfg, "probe_period", 0):
+    """An engine when probes are on or the step-time rule is armed,
+    else None (no per-round call)."""
+    if getattr(cfg, "probe_period", 0) or float(
+            getattr(cfg, "alarm_step_time_ratio", 0.0) or 0.0) > 0:
         return AlarmEngine(cfg, telemetry)
     return None

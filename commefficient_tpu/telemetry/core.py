@@ -134,6 +134,16 @@ class Telemetry:
         self._current = None            # the open round record
         self._compile_mark = (0, 0.0)
         self._shut = False
+        # emission hold: a profiler trace window buffers closed
+        # records until its trace is parsed, so per-round device-time
+        # buckets (schema v3) can merge before the record reaches the
+        # sinks. Round ORDER is unchanged — the hold only delays the
+        # drain.
+        self._hold = False
+        # expected lower-bound round seconds (analysis/cost.py),
+        # registered by FedModel under --profile; merged device-time
+        # buckets derive roofline_utilization from it
+        self.expected_round_s = None
         if self._sinks:
             _ensure_compile_listener()
 
@@ -221,6 +231,32 @@ class Telemetry:
             rec["probes"] = {}
         rec["probes"].update(probes)
 
+    def hold_emission(self, on: bool):
+        """Buffer record emission while a profiler trace window is
+        open (``on=True``); releasing the hold drains whatever became
+        eligible meanwhile. ``close()`` overrides any hold."""
+        self._hold = bool(on)
+        if not self._hold:
+            self._drain()
+
+    def merge_round_device_time(self, index: int, buckets: dict):
+        """Attach trace-derived device-time buckets (schema v3) to
+        round ``index``'s record — called by the trace window at exit,
+        while ``hold_emission`` keeps the records buffered. Derives
+        ``roofline_utilization`` when a cost model registered
+        ``expected_round_s``."""
+        rec = self._records.get(index)
+        if rec is None or not buckets:
+            return
+        buckets = dict(buckets)
+        exp = self.expected_round_s
+        busy = buckets.get("busy_s")
+        if exp and busy:
+            # 6 dp: CPU-scale utilizations sit at 1e-6..1e-3 and must
+            # not round to zero
+            buckets["roofline_utilization"] = round(exp / busy, 6)
+        rec["device_time"] = buckets
+
     def flag_alarm(self, index: int, alarm: dict):
         """Append an alarm dict to round ``index``'s record (schema
         v2 ``alarms`` list). Safe any time before emission."""
@@ -232,7 +268,10 @@ class Telemetry:
     def _drain(self, force: bool = False):
         """Emit front records that are closed and byte-complete (or
         everything closed, when forced) — ledger order == round
-        order."""
+        order. A trace-window hold defers everything (except forced
+        close) until the trace is parsed and merged."""
+        if self._hold and not force:
+            return
         while self._records:
             idx, rec = next(iter(self._records.items()))
             if idx not in self._closed_rounds:

@@ -1,0 +1,211 @@
+"""Perf-gate math: noise-aware comparison of run metrics vs a
+committed baseline.
+
+The regression gate is the wall-clock sibling of
+``audit_baseline.json``: ``perf_baseline.json`` pins, per metric, the
+median and MAD (median absolute deviation) of the samples a reference
+run produced, and ``compare()`` fails a fresh run only when it lands
+outside BOTH a relative tolerance and a ``k x MAD`` noise band:
+
+    lower-is-better:  fail when median_now > median_base
+                                + max(rel_tol x median_base,
+                                      mad_k x MAD_base)
+    higher-is-better: symmetric, below the baseline
+
+Median-of-N + MAD instead of mean + stddev because bench samples are
+dispatch-latency contaminated (the relay adds rare 2-3x outliers):
+one bad draw must move neither the baseline nor the verdict.
+
+Metrics extracted from a ledger (``metrics_from_records``):
+
+* ``span:<name>:ms`` — per-round host span samples (p50/p95 reported,
+  the gate runs on the full sample set);
+* ``device:<bucket>_s`` — schema-v3 per-round device-time buckets
+  (compute/collective/transfer/host_gap/busy);
+* ``bench:<metric>`` — bench-record headline values
+  (clients/s — higher is better); a bench record's ``round_times_s``
+  list also yields ``bench:<metric>:round_s`` samples.
+
+Pure stdlib, no jax — importable by tier-1 unit tests and by
+``scripts/perf_gate.py``.
+"""
+
+from __future__ import annotations
+
+import json
+from statistics import median
+from typing import Dict, List
+
+from commefficient_tpu.telemetry import clock
+
+BASELINE_SCHEMA = 1
+
+#: default gate knobs (CLI-overridable): generous enough for CI-class
+#: noise, tight enough that a 2x regression can never pass
+REL_TOL = 0.25
+MAD_K = 5.0
+#: a metric whose baseline median is under this (seconds-type metrics)
+#: is below timer resolution/scheduler noise — never gated hard
+MIN_GATED_SECONDS = 1e-4
+
+
+def mad(samples: List[float]) -> float:
+    """Median absolute deviation — the robust sigma."""
+    if not samples:
+        return 0.0
+    m = median(samples)
+    return median([abs(x - m) for x in samples])
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def summarize_samples(samples: List[float], better: str) -> Dict:
+    sv = sorted(samples)
+    return {"median": median(sv), "mad": mad(sv), "n": len(sv),
+            "p50": _pct(sv, 50), "p95": _pct(sv, 95),
+            "better": better}
+
+
+def metrics_from_records(records) -> Dict[str, Dict]:
+    """Gateable metrics from one ledger's records (see module doc).
+    Every metric value is a summarized sample set."""
+    spans: Dict[str, List[float]] = {}
+    device: Dict[str, List[float]] = {}
+    bench: Dict[str, Dict] = {}
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "round":
+            for name, secs in (rec.get("spans") or {}).items():
+                spans.setdefault(name, []).append(1e3 * float(secs))
+            for bname, val in (rec.get("device_time") or {}).items():
+                if isinstance(val, (int, float)):
+                    device.setdefault(bname, []).append(float(val))
+        elif kind == "bench":
+            metric = rec.get("metric")
+            if metric is None:
+                continue
+            val = rec.get("value")
+            if isinstance(val, (int, float)):
+                bench.setdefault(f"bench:{metric}", {
+                    "samples": [], "better": "higher"})[
+                        "samples"].append(float(val))
+            times = rec.get("round_times_s")
+            if isinstance(times, list) and times:
+                bench.setdefault(f"bench:{metric}:round_s", {
+                    "samples": [], "better": "lower"})[
+                        "samples"].extend(float(t) for t in times)
+    out: Dict[str, Dict] = {}
+    for name, vals in sorted(spans.items()):
+        out[f"span:{name}:ms"] = summarize_samples(vals, "lower")
+    for name, vals in sorted(device.items()):
+        better = "higher" if name == "roofline_utilization" else "lower"
+        out[f"device:{name}"] = summarize_samples(vals, better)
+    for name, entry in sorted(bench.items()):
+        out[name] = summarize_samples(entry["samples"],
+                                      entry["better"])
+    return out
+
+
+def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
+                  extra: Dict = None) -> Dict:
+    base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
+            "source": source, "metrics": metrics}
+    if extra:
+        base.update(extra)
+    return base
+
+
+def _threshold(base_entry: Dict, rel_tol: float, mad_k: float):
+    m = base_entry["median"]
+    return max(rel_tol * abs(m), mad_k * base_entry.get("mad", 0.0))
+
+
+def compare(baseline: Dict, metrics: Dict[str, Dict],
+            rel_tol: float = REL_TOL,
+            mad_k: float = MAD_K) -> Dict:
+    """Gate ``metrics`` against ``baseline``. Returns::
+
+        {"regressions": [...], "improvements": [...],
+         "skipped": [...], "checked": N}
+
+    Only metrics present on BOTH sides are gated (a new span or a
+    trace-less run is a skip, not a failure). Sub-resolution timing
+    metrics are never hard failures (MIN_GATED_SECONDS-equivalent:
+    0.1 ms for ms-metrics, 100 µs for s-metrics)."""
+    if baseline.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"baseline schema {baseline.get('schema')!r} != "
+            f"{BASELINE_SCHEMA} — re-capture the baseline")
+    base_metrics = baseline.get("metrics", {})
+    regressions, improvements, skipped = [], [], []
+    checked = 0
+    for name in sorted(set(base_metrics) | set(metrics)):
+        b, c = base_metrics.get(name), metrics.get(name)
+        if b is None or c is None:
+            skipped.append({"metric": name,
+                            "reason": ("not in baseline" if b is None
+                                       else "not in current run")})
+            continue
+        floor = (MIN_GATED_SECONDS * 1e3 if name.endswith(":ms")
+                 else MIN_GATED_SECONDS)
+        if name.startswith(("span:", "device:", "bench:")) and \
+                name != "device:roofline_utilization" and \
+                b["better"] == "lower" and abs(b["median"]) < floor:
+            skipped.append({"metric": name,
+                            "reason": "below timing resolution"})
+            continue
+        checked += 1
+        tol = _threshold(b, rel_tol, mad_k)
+        delta = c["median"] - b["median"]
+        entry = {"metric": name, "baseline": b["median"],
+                 "current": c["median"],
+                 "delta": delta, "tolerance": tol,
+                 "better": b["better"]}
+        if b["better"] == "lower":
+            if delta > tol:
+                regressions.append(entry)
+            elif delta < -tol:
+                improvements.append(entry)
+        else:
+            if delta < -tol:
+                regressions.append(entry)
+            elif delta > tol:
+                improvements.append(entry)
+    return {"regressions": regressions,
+            "improvements": improvements,
+            "skipped": skipped, "checked": checked}
+
+
+def render_verdict(verdict: Dict) -> str:
+    lines = [f"perf gate: {verdict['checked']} metric(s) checked, "
+             f"{len(verdict['regressions'])} regression(s), "
+             f"{len(verdict['improvements'])} improvement(s), "
+             f"{len(verdict['skipped'])} skipped"]
+    for r in verdict["regressions"]:
+        lines.append(
+            f"  REGRESSION {r['metric']}: {r['baseline']:.6g} -> "
+            f"{r['current']:.6g} ({'+' if r['delta'] >= 0 else ''}"
+            f"{r['delta']:.6g}, tolerance {r['tolerance']:.6g}, "
+            f"{r['better']} is better)")
+    for r in verdict["improvements"]:
+        lines.append(
+            f"  improvement {r['metric']}: {r['baseline']:.6g} -> "
+            f"{r['current']:.6g}")
+    return "\n".join(lines)
+
+
+def load_baseline(path: str) -> Dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_baseline(baseline: Dict, path: str):
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=1, sort_keys=True)
+        f.write("\n")

@@ -37,17 +37,29 @@ keys to round records:
              ({"rule", "value", "threshold", "action"}); empty when
              nothing fired. A round that triggered ``--on_divergence
              abort`` is the flagged final record of the run.
+
+Schema v3 adds one key to round records:
+
+``device_time`` — None unless the round ran inside a profiler trace
+             window (``--profile``), else the parsed device-timeline
+             buckets (telemetry/trace.py attribute_rounds): window_s /
+             busy_s / compute_s / collective_s / transfer_s /
+             host_gap_s, plus ``roofline_utilization`` (expected
+             lower-bound round time over measured busy time,
+             analysis/cost.py) when a cost model was registered.
+             compute + collective + transfer + host_gap == window by
+             construction.
 """
 
 from __future__ import annotations
 
 from commefficient_tpu.telemetry import clock
 
-LEDGER_SCHEMA_VERSION = 2
+LEDGER_SCHEMA_VERSION = 3
 
-# versions validate_record accepts: v1 ledgers (pre-probe) stay
-# readable by the report tooling
-READABLE_SCHEMA_VERSIONS = (1, 2)
+# versions validate_record accepts: v1 (pre-probe) and v2 (pre-trace)
+# ledgers stay readable by the report tooling
+READABLE_SCHEMA_VERSIONS = (1, 2, 3)
 
 KINDS = ("meta", "round", "epoch", "bench", "summary")
 
@@ -63,6 +75,11 @@ ROUND_REQUIRED_KEYS = (
 ROUND_V2_KEYS = (
     "probes",                              # None with probing off
     "alarms",                              # [] when nothing fired
+)
+
+# v3 additions (not required of v1/v2 records)
+ROUND_V3_KEYS = (
+    "device_time",                         # None outside --profile
 )
 
 
@@ -89,6 +106,7 @@ def make_round_record(round_index: int) -> dict:
         "hbm_peak_bytes": None,
         "probes": None,
         "alarms": [],
+        "device_time": None,
     })
     return rec
 
@@ -130,8 +148,10 @@ def validate_record(rec) -> list:
         problems.append("ts missing or non-numeric")
     if kind == "round":
         required = ROUND_REQUIRED_KEYS
-        if schema == 2:
+        if isinstance(schema, int) and schema >= 2:
             required = required + ROUND_V2_KEYS
+        if isinstance(schema, int) and schema >= 3:
+            required = required + ROUND_V3_KEYS
         for key in required:
             if key not in rec:
                 problems.append(f"round record missing {key!r}")
@@ -146,6 +166,13 @@ def validate_record(rec) -> list:
             v = rec.get(key)
             if v is not None and not isinstance(v, (int, float)):
                 problems.append(f"{key} is non-numeric")
+        dt = rec.get("device_time")
+        if dt is not None:
+            if not isinstance(dt, dict):
+                problems.append("device_time is not a dict")
+            elif any(not isinstance(v, (int, float))
+                     for v in dt.values()):
+                problems.append("non-numeric device_time bucket")
     if kind == "bench":
         for key in ("metric", "value", "unit"):
             if key not in rec:

@@ -1,0 +1,179 @@
+"""Run registry: self-describing manifests under ``runs/``.
+
+Every train/bench run that writes a ledger also drops one small JSON
+manifest — git sha, config hash, jax version, backend/topology, the
+ledger path, and any headline bench metrics — so a directory of runs
+is navigable without the launching shell history:
+
+    runs/manifests/run_<utc-seconds>_<confighash8>.json
+
+``scripts/telemetry_report.py --runs_dir`` discovers ledgers through
+these, and ``scripts/perf_gate.py`` uses them to pick "latest vs
+baseline" without hand-typed paths. Manifests are written by process 0
+only and never on bare smoke invocations (no ``--ledger``) — ``runs/``
+stays free of junk from every pytest run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+from commefficient_tpu.telemetry import clock
+
+MANIFEST_SCHEMA = 1
+MANIFEST_DIR = "manifests"
+MANIFEST_PREFIX = "run_"
+
+#: Config fields that never change what the program computes — they
+#: must not perturb the config hash (two reruns of one experiment
+#: with different ledger paths are the SAME configuration)
+_HASH_EXCLUDE = ("ledger", "telemetry_console", "use_tensorboard",
+                 "do_profile", "clientstore_dir")
+
+
+def config_dict(args) -> dict:
+    """JSON-able view of a Config (or argparse namespace): scalar
+    fields only, hash-excluded knobs dropped."""
+    if dataclasses.is_dataclass(args):
+        src = dataclasses.asdict(args)
+    else:
+        src = dict(getattr(args, "__dict__", {}) or {})
+    return {k: v for k, v in sorted(src.items())
+            if k not in _HASH_EXCLUDE
+            and isinstance(v, (int, float, str, bool, type(None)))}
+
+
+def config_hash(args) -> str:
+    """SHA-256 of the sorted scalar config — the identity under which
+    runs are comparable."""
+    blob = json.dumps(config_dict(args), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def git_sha(cwd=None) -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=cwd,
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return ""
+
+
+def _environment() -> dict:
+    env = {"python": sys.version.split()[0]}
+    try:
+        import jax
+        env["jax_version"] = jax.__version__
+        env["backend"] = jax.default_backend()
+        env["device_count"] = jax.device_count()
+        env["process_count"] = jax.process_count()
+        devs = jax.devices()
+        env["device_kind"] = devs[0].device_kind if devs else ""
+    except Exception:
+        pass
+    return env
+
+
+def write_manifest(runs_dir: str = "runs", *, args=None,
+                   ledger: str = "", bench: dict = None,
+                   mesh_shape=None, extra: dict = None) -> str:
+    """Write one run manifest; returns its path. ``bench`` is a dict
+    of headline metrics ({metric: {"value", "unit", ...}} or any
+    JSON-able shape); ``extra`` merges into the top level last."""
+    chash = config_hash(args) if args is not None else ""
+    rec = {
+        "schema": MANIFEST_SCHEMA,
+        "kind": "run_manifest",
+        "ts": clock.wall(),
+        "git_sha": git_sha(),
+        "config_hash": chash,
+        "config": config_dict(args) if args is not None else {},
+        "argv": list(sys.argv),
+        "ledger": os.path.abspath(ledger) if ledger else "",
+        "bench": bench or {},
+        "mesh_shape": (dict(mesh_shape)
+                       if isinstance(mesh_shape, dict) else mesh_shape),
+    }
+    rec.update(_environment())
+    if extra:
+        rec.update(extra)
+    out_dir = os.path.join(runs_dir, MANIFEST_DIR)
+    os.makedirs(out_dir, exist_ok=True)
+    name = f"{MANIFEST_PREFIX}{int(rec['ts'])}_{chash[:8] or 'nocfg'}"
+    path = os.path.join(out_dir, name + ".json")
+    # same-second rerun of the same config: keep both manifests
+    n = 1
+    while os.path.exists(path):
+        path = os.path.join(out_dir, f"{name}.{n}.json")
+        n += 1
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def maybe_write_manifest(args, **kw):
+    """Trainer/bench entry point: a manifest when (and only when) the
+    run wrote a ledger, from process 0, never under ``--test`` smoke.
+    Failures degrade to a warning — observability must not fail the
+    run it observes."""
+    ledger = str(getattr(args, "ledger", "") or "")
+    if not ledger or getattr(args, "do_test", False):
+        return None
+    try:
+        import jax
+        if jax.process_index() != 0:
+            return None
+    except Exception:
+        pass
+    try:
+        return write_manifest(args=args, ledger=ledger, **kw)
+    except Exception as e:  # noqa: BLE001 — observability only
+        print(f"WARNING: run manifest not written "
+              f"({type(e).__name__}: {e})")
+        return None
+
+
+def list_manifests(runs_dir: str = "runs") -> list:
+    """All readable manifests under ``runs_dir``, oldest first.
+    Returns [(path, manifest_dict), ...]; unparseable files are
+    skipped."""
+    out_dir = os.path.join(runs_dir, MANIFEST_DIR)
+    if not os.path.isdir(out_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(out_dir)):
+        if not (name.startswith(MANIFEST_PREFIX)
+                and name.endswith(".json")):
+            continue
+        path = os.path.join(out_dir, name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if rec.get("kind") == "run_manifest":
+            out.append((path, rec))
+    out.sort(key=lambda pr: pr[1].get("ts", 0.0))
+    return out
+
+
+def latest_ledgers(runs_dir: str = "runs", n: int = 2) -> list:
+    """The newest ``n`` manifests whose ledger file still exists,
+    newest FIRST: [(manifest_path, manifest, ledger_path), ...]."""
+    hits = []
+    for path, rec in reversed(list_manifests(runs_dir)):
+        ledger = rec.get("ledger") or ""
+        if ledger and os.path.exists(ledger):
+            hits.append((path, rec, ledger))
+            if len(hits) >= n:
+                break
+    return hits

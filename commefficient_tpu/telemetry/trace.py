@@ -1,0 +1,303 @@
+"""Device-time attribution: profiler round markers + trace parsing.
+
+The round ledger (core.py) measures *host* phases; this module closes
+the gap to the device timeline. Two halves:
+
+**Markers** — while a ``trace_window`` is open, ``FedModel`` brackets
+each round in a ``jax.profiler.StepTraceAnnotation`` (name
+``fed_round``, ``step_num`` = the ledger round index) and the
+device-relevant phases (h2d / round_dispatch / server) in
+``TraceAnnotation``s. The round annotation is opened at
+``begin_round`` and closed at the NEXT round's begin — mirroring the
+ledger record lifecycle, so the server step (dispatched after
+``_call_train`` returns) lands inside its own round's window. State is
+module-level (one live FedModel per process, like
+``fed_model._CURRENT_MODEL``); every call is a single flag check when
+no trace is active, so the round hot loop pays nothing.
+
+**Parser** — ``jax.profiler.stop_trace`` writes a Chrome trace-event
+dump (``plugins/profile/<ts>/<host>.trace.json.gz``): ``ph:"X"``
+complete events with µs ``ts``/``dur`` and ``ph:"M"`` metadata naming
+each pid/tid lane. Device lanes are the ``/device:*`` processes (TPU,
+GPU) or the ``tf_XLA*`` client threads (CPU backend).
+``attribute_rounds`` buckets every device event into its round's
+window: {compute, collective, h2d/d2h transfer, host-gap}, by interval
+union so nested/overlapping op events never double-count. Buckets sum
+to the round window by construction — the acceptance bar for the
+schema-v3 ``device_time`` ledger field.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+
+ROUND_MARKER = "fed_round"
+PHASE_PREFIX = "fed_phase"
+
+#: substrings (lowercase) classifying a device-lane event
+COLLECTIVE_TOKENS = (
+    "all-reduce", "allreduce", "all-gather", "allgather",
+    "reduce-scatter", "reducescatter", "all-to-all", "alltoall",
+    "collective-permute", "collectivepermute", "collective-broadcast",
+)
+TRANSFER_TOKENS = (
+    "infeed", "outfeed", "copy", "memcpy", "transfer",
+    "h2d", "d2h", "send", "recv",
+)
+
+# one live FedModel per process (fed_model._CURRENT_MODEL) -> one
+# module-level marker state; "ann" is the currently-open round
+# StepTraceAnnotation, closed at the next begin or at window exit
+_STATE = {"tracing": False, "ann": None, "round": None}
+
+
+def tracing() -> bool:
+    return _STATE["tracing"]
+
+
+def set_tracing(on: bool):
+    """Flipped by ``profiler.trace_window`` enter/exit. Turning
+    tracing off force-closes any open round marker first, so its end
+    timestamp lands inside the trace."""
+    if not on:
+        end_round_marker()
+    _STATE["tracing"] = bool(on)
+
+
+def begin_round_marker(round_index: int):
+    """Open round ``round_index``'s StepTraceAnnotation (closing the
+    previous round's). No-op unless a trace window is active."""
+    if not _STATE["tracing"]:
+        return
+    end_round_marker()
+    import jax
+    ann = jax.profiler.StepTraceAnnotation(ROUND_MARKER,
+                                           step_num=int(round_index))
+    ann.__enter__()
+    _STATE["ann"] = ann
+    _STATE["round"] = int(round_index)
+
+
+def end_round_marker():
+    ann, _STATE["ann"] = _STATE["ann"], None
+    _STATE["round"] = None
+    if ann is not None:
+        ann.__exit__(None, None, None)
+
+
+class _NullPhase:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_PHASE = _NullPhase()
+
+
+def phase(name: str):
+    """Context manager: a ``TraceAnnotation`` named
+    ``fed_phase::<name>`` when tracing, the shared no-op otherwise.
+    Used alongside (not instead of) the telemetry host spans."""
+    if not _STATE["tracing"]:
+        return _NULL_PHASE
+    import jax
+    return jax.profiler.TraceAnnotation(f"{PHASE_PREFIX}::{name}")
+
+
+# --- trace file discovery + loading ------------------------------------
+
+
+def find_trace_file(logdir: str):
+    """Newest ``*.trace.json.gz`` under ``logdir`` (searched at any
+    depth: jax writes ``plugins/profile/<timestamp>/<host>.trace.
+    json.gz``). None when the profiler wrote nothing."""
+    pats = (os.path.join(logdir, "**", "*.trace.json.gz"),
+            os.path.join(logdir, "**", "*.trace.json"))
+    hits = []
+    for pat in pats:
+        hits.extend(glob.glob(pat, recursive=True))
+    if not hits:
+        return None
+    return max(hits, key=os.path.getmtime)
+
+
+def load_trace_events(path_or_logdir: str):
+    """Chrome trace-event list from a ``.trace.json(.gz)`` file, or
+    from the newest one under a directory."""
+    path = path_or_logdir
+    if os.path.isdir(path):
+        path = find_trace_file(path)
+        if path is None:
+            raise FileNotFoundError(
+                f"no .trace.json(.gz) under {path_or_logdir}")
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) \
+        else doc
+    return [e for e in events if isinstance(e, dict)]
+
+
+# --- lane classification -----------------------------------------------
+
+
+def _lane_names(events):
+    """(pid -> process_name, (pid, tid) -> thread_name) from the
+    ``ph:"M"`` metadata events."""
+    procs, threads = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        name = (e.get("args") or {}).get("name", "")
+        if e.get("name") == "process_name":
+            procs[e.get("pid")] = name
+        elif e.get("name") == "thread_name":
+            threads[(e.get("pid"), e.get("tid"))] = name
+    return procs, threads
+
+
+def device_lanes(events):
+    """(pid, tid) pairs whose events are device-side execution:
+    ``/device:*`` processes (TPU/GPU xplanes) or ``tf_XLA*`` runtime
+    threads (the CPU backend's per-device execution threads)."""
+    procs, threads = _lane_names(events)
+    lanes = set()
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        pname = procs.get(key[0], "")
+        tname = threads.get(key, "")
+        if pname.startswith("/device:") or tname.startswith("tf_XLA"):
+            lanes.add(key)
+    return lanes
+
+
+# --- interval math -----------------------------------------------------
+
+
+def _union(intervals):
+    """Merged, sorted interval list — nested/overlapping device events
+    (module > fusion > op) collapse to their covering span."""
+    out = []
+    for a, b in sorted(intervals):
+        if out and a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return out
+
+
+def _measure(merged):
+    return sum(b - a for a, b in merged)
+
+
+def _clip(intervals, lo, hi):
+    out = []
+    for a, b in intervals:
+        a, b = max(a, lo), min(b, hi)
+        if b > a:
+            out.append((a, b))
+    return out
+
+
+# --- per-round attribution ---------------------------------------------
+
+
+def round_windows(events):
+    """[(round_index, ts_us, end_us), ...] from the ``fed_round``
+    StepTraceAnnotations, in timeline order. Each window is the
+    annotation's own extent (begin_round -> next begin_round /
+    trace-window exit)."""
+    wins = []
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") != ROUND_MARKER:
+            continue
+        args = e.get("args") or {}
+        step = args.get("step_num", args.get("round"))
+        if step is None:
+            continue
+        ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
+        wins.append((int(step), ts, ts + dur))
+    wins.sort(key=lambda w: w[1])
+    return wins
+
+
+def _classify(name: str) -> str:
+    low = name.lower()
+    if any(t in low for t in COLLECTIVE_TOKENS):
+        return "collective"
+    if any(t in low for t in TRANSFER_TOKENS):
+        return "transfer"
+    return "compute"
+
+
+def attribute_rounds(events) -> dict:
+    """Per-round device-time buckets from one trace's events:
+
+        {round_index: {"window_s", "busy_s", "compute_s",
+                       "collective_s", "transfer_s", "host_gap_s"}}
+
+    ``busy`` is the union of all device-lane events clipped to the
+    round window (parallel lanes don't double-count wall time);
+    collective/transfer are the unions of the matching-named events;
+    ``compute = busy - collective - transfer`` and ``host_gap =
+    window - busy``, so the four buckets sum to the window exactly.
+    """
+    wins = round_windows(events)
+    if not wins:
+        return {}
+    lanes = device_lanes(events)
+    dev, coll, xfer = [], [], []
+    for e in events:
+        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in lanes:
+            continue
+        name = e.get("name", "")
+        if name == ROUND_MARKER or name.startswith(PHASE_PREFIX):
+            continue
+        ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
+        iv = (ts, ts + dur)
+        dev.append(iv)
+        kind = _classify(name)
+        if kind == "collective":
+            coll.append(iv)
+        elif kind == "transfer":
+            xfer.append(iv)
+    dev, coll, xfer = _union(dev), _union(coll), _union(xfer)
+
+    out = {}
+    for ridx, lo, hi in wins:
+        busy = _union(_clip(dev, lo, hi))
+        c = _union(_clip(coll, lo, hi))
+        t = _union(_clip(xfer, lo, hi))
+        busy_us = _measure(busy)
+        coll_us = _measure(c)
+        # transfer time that isn't already counted as collective
+        # (disjoint buckets: the four sum to the window)
+        xfer_us = _measure(_union(t + c)) - coll_us
+        win_us = hi - lo
+        out[ridx] = {
+            "window_s": round(win_us / 1e6, 6),
+            "busy_s": round(busy_us / 1e6, 6),
+            "compute_s": round((busy_us - coll_us - xfer_us) / 1e6, 6),
+            "collective_s": round(coll_us / 1e6, 6),
+            "transfer_s": round(xfer_us / 1e6, 6),
+            "host_gap_s": round((win_us - busy_us) / 1e6, 6),
+        }
+    return out
+
+
+def attribute_logdir(logdir: str) -> dict:
+    """``attribute_rounds`` over the newest trace under ``logdir``;
+    empty dict when no trace file exists."""
+    path = find_trace_file(logdir)
+    if path is None:
+        return {}
+    return attribute_rounds(load_trace_events(path))

@@ -311,7 +311,8 @@ def train(model, opt, lr_scheduler, train_loader, val_loader, args,
     try:
         for epoch in range(start_epoch, math.ceil(num_epochs)):
             epoch_fraction = min(1.0, num_epochs - epoch)
-            with profile_epoch(args, epoch, start_epoch, logdir):
+            with profile_epoch(args, epoch, start_epoch, logdir,
+                               telemetry=tel):
                 out = run_batches(model, opt, lr_scheduler,
                                   train_loader, args, training=True,
                                   epoch_fraction=epoch_fraction,
@@ -570,6 +571,11 @@ def main(argv=None):
                     args, start_epoch=start_epoch,
                     epoch_hook=epoch_hook)
     model.finalize()
+    from commefficient_tpu.telemetry import registry
+    registry.maybe_write_manifest(
+        args, mesh_shape=dict(model.mesh.shape),
+        extra={"trainer": "cv_train", "epochs": len(results),
+               "diverged": bool(getattr(model, "diverged", False))})
 
     if args.do_checkpoint and jax.process_index() == 0:
         # params are replicated — one writer on a shared filesystem

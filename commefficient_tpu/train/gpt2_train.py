@@ -244,7 +244,8 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
     results = []
     try:
         for epoch in range(start_epoch, math.ceil(args.num_epochs)):
-            with profile_epoch(args, epoch, start_epoch, logdir):
+            with profile_epoch(args, epoch, start_epoch, logdir,
+                               telemetry=tel):
                 train_loss = run_batches(model, opt, lr_scheduler,
                                          train_loader, args,
                                          training=True)
@@ -461,6 +462,11 @@ def main(argv=None):
                          val_loader, args, start_epoch=start_epoch,
                          epoch_hook=epoch_hook, logdir=logdir)
     model.finalize()
+    from commefficient_tpu.telemetry import registry
+    registry.maybe_write_manifest(
+        args, mesh_shape=dict(model.mesh.shape),
+        extra={"trainer": "gpt2_train", "epochs": len(results),
+               "diverged": bool(getattr(model, "diverged", False))})
     if logdir is not None and not getattr(model, "diverged", False) \
             and jax.process_index() == 0:
         # reference gpt2_train.py:146, 278-283: final model + tokenizer

@@ -47,14 +47,18 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from commefficient_tpu.analysis import lint as lint_mod
-    lint_summary = {"unwaived": [], "waived": []}
+    lint_summary = {"unwaived": [], "waived": [], "stale_waivers": []}
     if not args.program_only:
         violations = lint_mod.run_lint()
-        lint_summary = lint_mod.lint_report(violations)
+        stale = lint_mod.stale_waivers(violations=violations)
+        lint_summary = lint_mod.lint_report(violations, stale=stale)
         for v in lint_summary["unwaived"]:
             print(f"LINT  {v}")
+        for v in stale:
+            print(f"STALE {v}")
         print(f"lint: {len(lint_summary['unwaived'])} unwaived, "
-              f"{len(lint_summary['waived'])} waived")
+              f"{len(lint_summary['waived'])} waived, "
+              f"{len(stale)} stale waiver(s)")
 
     program_report = {"programs": {}, "failures": []}
     if not args.lint_only:

@@ -1,0 +1,152 @@
+"""Cross-run performance regression gate (perf_baseline.json).
+
+    python scripts/perf_gate.py --ledger runs/a.jsonl \
+        --write-baseline perf_baseline.json      # capture a baseline
+    python scripts/perf_gate.py --ledger runs/b.jsonl \
+        --baseline perf_baseline.json --check    # gate a fresh run
+    python scripts/perf_gate.py --runs_dir runs --check \
+        --baseline perf_baseline.json            # gate the newest
+                                                 # manifest-registered
+                                                 # run
+
+The committed baseline pins median + MAD per metric (host-span times,
+schema-v3 device-time buckets, bench clients/s); ``--check`` fails —
+exit 1 — only outside a noise band of ``max(rel_tol x median, k x
+MAD)`` (telemetry/gate.py), so relay jitter passes and a real
+regression cannot. ``--write-baseline`` over an existing baseline
+first gates the new run against it and REFUSES to re-baseline over a
+hard regression (``--force`` overrides, for intentional trade-offs —
+the diff of perf_baseline.json is then the reviewable artifact).
+
+Pure host-side JSON work: no jax import, safe as a tier-1 CPU smoke.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+from commefficient_tpu.telemetry import gate, registry  # noqa: E402
+from commefficient_tpu.telemetry.record import validate_record  # noqa: E402
+
+
+def load_ledger_records(path):
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"WARNING {path}:{lineno}: not JSON, skipped",
+                      file=sys.stderr)
+                continue
+            if validate_record(rec):
+                print(f"WARNING {path}:{lineno}: invalid record, "
+                      "skipped", file=sys.stderr)
+                continue
+            records.append(rec)
+    return records
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="perf regression gate over telemetry ledgers")
+    ap.add_argument("--ledger", default=None,
+                    help="run ledger (JSONL) to gate / baseline")
+    ap.add_argument("--runs_dir", default=None,
+                    help="discover the newest manifest-registered "
+                         "ledger under this directory instead of "
+                         "--ledger")
+    ap.add_argument("--baseline", default="perf_baseline.json",
+                    help="committed baseline JSON (default "
+                         "perf_baseline.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="gate the run against --baseline; exit 1 on "
+                         "any hard regression")
+    ap.add_argument("--write-baseline", metavar="PATH", nargs="?",
+                    const="perf_baseline.json", default=None,
+                    help="write the run's metrics as the new baseline "
+                         "(refused over a hard regression vs the "
+                         "existing one unless --force)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-baseline even over a regression")
+    ap.add_argument("--rel_tol", type=float, default=gate.REL_TOL,
+                    help="relative tolerance component of the noise "
+                         f"band (default {gate.REL_TOL})")
+    ap.add_argument("--mad_k", type=float, default=gate.MAD_K,
+                    help="MAD multiples component of the noise band "
+                         f"(default {gate.MAD_K})")
+    ap.add_argument("--json", default=None,
+                    help="dump the verdict (or captured metrics) to "
+                         "this path")
+    args = ap.parse_args(argv)
+
+    ledger = args.ledger
+    if ledger is None and args.runs_dir:
+        hits = registry.latest_ledgers(args.runs_dir, n=1)
+        if not hits:
+            print(f"no manifest-registered ledgers under "
+                  f"{args.runs_dir}")
+            return 1
+        mpath, manifest, ledger = hits[0]
+        print(f"run: {mpath} (config {manifest.get('config_hash', '')[:8]}, "
+              f"git {manifest.get('git_sha', '')[:8]}) -> {ledger}")
+    if ledger is None:
+        ap.error("one of --ledger / --runs_dir is required")
+
+    records = load_ledger_records(ledger)
+    metrics = gate.metrics_from_records(records)
+    if not metrics:
+        print(f"{ledger}: no gateable metrics (empty ledger?)")
+        return 1
+    print(f"{ledger}: {len(metrics)} metric(s) extracted")
+
+    verdict = None
+    if args.check or (args.write_baseline
+                      and os.path.exists(args.baseline)
+                      and not args.force):
+        if not os.path.exists(args.baseline):
+            print(f"baseline {args.baseline} missing — capture one "
+                  "with --write-baseline first")
+            return 1
+        baseline = gate.load_baseline(args.baseline)
+        verdict = gate.compare(baseline, metrics,
+                               rel_tol=args.rel_tol,
+                               mad_k=args.mad_k)
+        print(gate.render_verdict(verdict))
+
+    if args.write_baseline:
+        if verdict and verdict["regressions"] and not args.force:
+            print(f"\nNOT writing {args.write_baseline}: "
+                  f"{len(verdict['regressions'])} hard regression(s) "
+                  "vs the existing baseline — fix them or pass "
+                  "--force for an intentional trade-off")
+            return 1
+        gate.save_baseline(
+            gate.make_baseline(metrics, source=os.path.abspath(ledger)),
+            args.write_baseline)
+        print(f"baseline -> {args.write_baseline}")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(verdict if verdict is not None else metrics, f,
+                      indent=1, sort_keys=True)
+        print(f"verdict -> {args.json}")
+
+    if args.check and verdict and verdict["regressions"]:
+        print(f"\nperf gate: FAIL "
+              f"({len(verdict['regressions'])} regression(s))")
+        return 1
+    if args.check:
+        print("\nperf gate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

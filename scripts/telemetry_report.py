@@ -9,6 +9,14 @@
         diff two ledgers: per-span mean deltas, comm/byte deltas,
         bench metric ratios — the "did my change help" view
 
+    python scripts/telemetry_report.py --runs_dir runs
+        registry mode: list recent manifest-registered runs
+        (telemetry/registry.py), summarize the latest run's ledger and
+        diff it against the previous one — no hand-typed paths
+
+Schema-v3 ledgers additionally render the trace-derived device-time
+breakdown (compute / collective / transfer / host-gap per round) and
+the roofline expectation next to the host-span percentiles.
 ``--json`` prints the summary (or diff) as one JSON object instead of
 text. Invalid records are reported but don't abort the render.
 """
@@ -64,11 +72,16 @@ def summarize(records) -> dict:
     span_vals, counters = {}, {}
     probe_vals = {}          # probe key -> [(round, value), ...]
     alarm_rounds = []        # [{"round": r, "alarms": [...]}, ...]
+    device_vals = {}         # v3 device-time bucket -> [seconds, ...]
     uplink = downlink = 0.0
     rss_peak = hbm_peak = None
     for r in rounds:
         for name, secs in r["spans"].items():
             span_vals.setdefault(name, []).append(float(secs))
+        # v3-only: trace-derived device-time buckets
+        for name, val in (r.get("device_time") or {}).items():
+            if isinstance(val, (int, float)):
+                device_vals.setdefault(name, []).append(float(val))
         for name, n in r["counters"].items():
             counters[name] = counters.get(name, 0) + n
         uplink += r.get("uplink_bytes") or 0.0
@@ -105,6 +118,21 @@ def summarize(records) -> dict:
                        "first": vals[0], "last": vals[-1],
                        "mean": sum(vals) / len(vals),
                        "max": max(vals)}
+    device_time = {}
+    for name, vals in sorted(device_vals.items()):
+        sv = sorted(vals)
+        if name == "roofline_utilization":
+            device_time[name] = {"n": len(sv),
+                                 "mean": round(sum(sv) / len(sv), 4),
+                                 "min": round(sv[0], 4),
+                                 "max": round(sv[-1], 4)}
+        else:
+            device_time[name] = {
+                "n": len(sv),
+                "total_s": round(sum(sv), 4),
+                "mean_ms": round(1e3 * sum(sv) / len(sv), 3),
+                "p50_ms": round(1e3 * _pct(sv, 50), 3),
+                "p95_ms": round(1e3 * _pct(sv, 95), 3)}
     return {
         "meta": next((r for r in records if r["kind"] == "meta"),
                      None),
@@ -112,6 +140,10 @@ def summarize(records) -> dict:
         "uplink_bytes": uplink,
         "downlink_bytes": downlink,
         "spans": spans,
+        "device_time": device_time,
+        "cost_model": next(
+            (r.get("cost_model") for r in records
+             if r["kind"] == "meta" and r.get("cost_model")), None),
         "probes": probes,
         "alarm_rounds": alarm_rounds,
         "counters": dict(sorted(counters.items())),
@@ -153,6 +185,26 @@ def render_summary(s, label="") -> str:
                      f"mean {v['mean_ms']} ms/round"
                      f" (p50 {v['p50_ms']}, p95 {v['p95_ms']}, "
                      f"max {v['max_ms']})")
+    # device-time breakdown (schema v3, --profile runs) next to the
+    # host-span percentiles above
+    for name, v in s.get("device_time", {}).items():
+        if name == "roofline_utilization":
+            lines.append(f"  device {name}: mean {v['mean']} "
+                         f"(min {v['min']}, max {v['max']}, "
+                         f"{v['n']} rounds)")
+        else:
+            lines.append(f"  device {name}: mean {v['mean_ms']} "
+                         f"ms/round (p50 {v['p50_ms']}, "
+                         f"p95 {v['p95_ms']}, {v['n']} rounds)")
+    cm = s.get("cost_model")
+    if cm:
+        lines.append(
+            f"  roofline: {cm.get('label', '')} on {cm.get('chip')}"
+            f" x{cm.get('n_devices')}, "
+            f"{cm.get('total_flops', 0):.4g} FLOPs, expected "
+            f"{cm.get('expected_round_s', 0):.6g} s/round "
+            f"(compute {cm.get('compute_floor_s', 0):.6g}, "
+            f"collective {cm.get('collective_floor_s', 0):.6g})")
     for name, p in s.get("probes", {}).items():
         lines.append(f"  probe {name}: first {p['first']:.6g} -> "
                      f"last {p['last']:.6g}, mean {p['mean']:.6g}, "
@@ -187,6 +239,17 @@ def diff_summaries(a: dict, b: dict) -> dict:
             entry["ratio"] = round(mb / ma, 3)
         span_diff[name] = entry
     out["spans"] = span_diff
+    dev_diff = {}
+    for name in sorted(set(a.get("device_time", {}))
+                       & set(b.get("device_time", {}))):
+        da, db = a["device_time"][name], b["device_time"][name]
+        ka = "mean" if name == "roofline_utilization" else "mean_ms"
+        entry = {"a": da[ka], "b": db[ka]}
+        if da[ka]:
+            entry["ratio"] = round(db[ka] / da[ka], 4)
+        dev_diff[name] = entry
+    if dev_diff:
+        out["device_time"] = dev_diff
     for key in ("uplink_bytes", "downlink_bytes"):
         entry = {"a": a[key], "b": b[key],
                  "delta": b[key] - a[key]}
@@ -231,6 +294,10 @@ def render_diff(d, label_a, label_b) -> str:
         r = f" ({e['ratio']}x)" if "ratio" in e else ""
         lines.append(f"  span {name}: {e['a_mean_ms']} -> "
                      f"{e['b_mean_ms']} ms/round{r}")
+    for name, e in d.get("device_time", {}).items():
+        r = f" ({e['ratio']}x)" if "ratio" in e else ""
+        unit = "" if name == "roofline_utilization" else " ms/round"
+        lines.append(f"  device {name}: {e['a']} -> {e['b']}{unit}{r}")
     for key in ("uplink_bytes", "downlink_bytes"):
         e = d[key]
         r = f" ({e['ratio']}x)" if "ratio" in e else ""
@@ -249,15 +316,76 @@ def render_diff(d, label_a, label_b) -> str:
     return "\n".join(lines)
 
 
+def runs_dir_report(runs_dir: str, as_json: bool) -> int:
+    """Registry mode: list the recent manifest-registered runs, render
+    the latest run's ledger, and diff it against the previous one."""
+    from commefficient_tpu.telemetry import registry
+
+    manifests = registry.list_manifests(runs_dir)
+    if not manifests:
+        print(f"no run manifests under {runs_dir} "
+              f"(runs write them when --ledger is set)")
+        return 1
+    if not as_json:
+        print(f"== runs under {runs_dir} ({len(manifests)}) ==")
+        for path, rec in manifests[-10:]:
+            bench = rec.get("bench") or {}
+            headline = next(
+                (f"{m}: {v.get('value')} {v.get('unit', '')}"
+                 for m, v in bench.items()
+                 if isinstance(v, dict)), "")
+            print(f"  {os.path.basename(path)}: "
+                  f"git {rec.get('git_sha', '')[:8]}, "
+                  f"config {rec.get('config_hash', '')[:8]}, "
+                  f"backend {rec.get('backend', '?')}"
+                  + (f", {headline}" if headline else ""))
+    hits = registry.latest_ledgers(runs_dir, n=2)
+    if not hits:
+        print("no manifest points at an existing ledger file")
+        return 1
+    _, _, latest = hits[0]
+    records, problems = load_ledger(latest)
+    for p in problems:
+        print(f"WARNING {latest}: {p}", file=sys.stderr)
+    summ = summarize(records)
+    if len(hits) < 2:
+        if as_json:
+            print(json.dumps(summ))
+        else:
+            print(render_summary(summ, label=latest))
+        return 0
+    _, _, prev = hits[1]
+    records_p, problems_p = load_ledger(prev)
+    for p in problems_p:
+        print(f"WARNING {prev}: {p}", file=sys.stderr)
+    d = diff_summaries(summarize(records_p), summ)
+    if as_json:
+        print(json.dumps({"latest": summ, "diff_vs_previous": d}))
+    else:
+        print(render_summary(summ, label=latest))
+        print(render_diff(d, prev, latest))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="render or diff telemetry run ledgers")
-    ap.add_argument("ledger", help="run ledger (JSONL)")
+    ap.add_argument("ledger", nargs="?", default=None,
+                    help="run ledger (JSONL)")
     ap.add_argument("other", nargs="?", default=None,
                     help="second ledger: diff mode (other vs first)")
+    ap.add_argument("--runs_dir", default=None,
+                    help="registry mode: list recent runs (via their "
+                         "manifests), summarize the latest ledger and "
+                         "diff it against the previous run")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
+
+    if args.runs_dir is not None:
+        return runs_dir_report(args.runs_dir, args.json)
+    if args.ledger is None:
+        ap.error("a ledger path (or --runs_dir) is required")
 
     records, problems = load_ledger(args.ledger)
     for p in problems:

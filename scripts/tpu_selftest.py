@@ -219,6 +219,44 @@ def flash_attention_parity():
     return "; ".join(details)
 
 
+def trace_smoke():
+    """Device-time attribution round-trip on the REAL backend: a
+    trace_window around a few marked rounds of device work must
+    produce round windows whose buckets are internally consistent
+    (disjoint buckets summing to the window) with nonzero device busy
+    time — TPU xplanes name their lanes differently from the CPU
+    backend the pytest fixture covers, so the lane detection is what
+    this check actually exercises."""
+    import shutil
+    import tempfile
+
+    from commefficient_tpu.telemetry import trace
+    from commefficient_tpu.telemetry.profiler import trace_window
+
+    logdir = tempfile.mkdtemp(prefix="trace_smoke_")
+    try:
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2048, 2048).astype(np.float32))
+        f = jax.jit(lambda a: a @ a.T + 1.0)
+        f(x).block_until_ready()  # compile outside the window
+        with trace_window(logdir):
+            for r in range(3):
+                trace.begin_round_marker(r)
+                f(x).block_until_ready()
+        buckets = trace.attribute_logdir(logdir)
+        assert len(buckets) == 3, sorted(buckets)
+        busy = sum(b["busy_s"] for b in buckets.values())
+        assert busy > 0, buckets
+        for r, b in buckets.items():
+            parts = (b["compute_s"] + b["collective_s"]
+                     + b["transfer_s"] + b["host_gap_s"])
+            assert abs(parts - b["window_s"]) <= 1e-5, (r, b)
+        return (f"3 rounds attributed, busy {busy * 1e3:.1f} ms, "
+                f"compute {sum(b['compute_s'] for b in buckets.values()) * 1e3:.1f} ms")
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
 def bench_throughput():
     """Headline bench must clear the BASELINE north-star (>= 8x)."""
     import json
@@ -238,6 +276,7 @@ def main():
     check("bf16_flagship_round", bf16_round_trains)
     check("probe_smoke", probe_smoke)
     check("audit_smoke", audit_smoke)
+    check("trace_smoke", trace_smoke)
     check("flash_attention_parity", flash_attention_parity)
     check("bench_vs_baseline", bench_throughput)
     if FAILED:

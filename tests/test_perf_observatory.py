@@ -1,0 +1,675 @@
+"""Performance-observatory tests: device-time trace attribution,
+roofline FLOP counting, the noise-aware perf gate, the run registry,
+the step-time alarm, stale-waiver detection, and the end-to-end
+``--profile`` path on a real CPU mesh.
+
+The golden-trace test runs against ``tests/fixtures/mini.trace.json.gz``
+— a hand-authored Chrome trace-event dump with two ``fed_round``
+markers, overlapping compute/collective device events, a transfer that
+straddles the round boundary, and events that attribution must ignore
+(phase annotations, host-lane python frames, out-of-window ops). Its
+bucket values are computed by hand and asserted exactly.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.telemetry import gate, registry, trace
+from commefficient_tpu.telemetry.alarms import (AlarmEngine,
+                                                DivergenceAbort)
+from commefficient_tpu.telemetry.core import Telemetry
+from commefficient_tpu.telemetry.record import (make_bench_record,
+                                                make_round_record,
+                                                validate_record)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "mini.trace.json.gz")
+
+
+# --- golden trace parser ----------------------------------------------
+
+
+class TestTraceAttribution:
+    def test_fixture_golden_buckets(self):
+        """Hand-computed buckets for the checked-in mini trace.
+
+        Round 0 window [1000, 2000) us: device busy = fusion.1 union
+        all-reduce.2 (1100-1400) + copy.3 clipped (1900-2000) = 400 us;
+        collective 150, transfer 100 (copy minus collective overlap:
+        none), compute 150, host gap 600. Round 1 window [2000, 3500):
+        copy.3 tail (2000-2100) + fusion.4 (2200-2500) = 400 busy,
+        no collective, transfer 100, compute 300, gap 1100."""
+        events = trace.load_trace_events(FIXTURE)
+        buckets = trace.attribute_rounds(events)
+        assert sorted(buckets) == [0, 1]
+        assert buckets[0] == {
+            "window_s": 0.001, "busy_s": 0.0004,
+            "compute_s": 0.00015, "collective_s": 0.00015,
+            "transfer_s": 0.0001, "host_gap_s": 0.0006}
+        assert buckets[1] == {
+            "window_s": 0.0015, "busy_s": 0.0004,
+            "compute_s": 0.0003, "collective_s": 0.0,
+            "transfer_s": 0.0001, "host_gap_s": 0.0011}
+
+    def test_buckets_partition_each_window(self):
+        buckets = trace.attribute_rounds(
+            trace.load_trace_events(FIXTURE))
+        for b in buckets.values():
+            parts = (b["compute_s"] + b["collective_s"]
+                     + b["transfer_s"] + b["host_gap_s"])
+            assert abs(parts - b["window_s"]) < 1e-9
+            assert abs((b["busy_s"] + b["host_gap_s"])
+                       - b["window_s"]) < 1e-9
+
+    def test_device_lanes_exclude_host_python(self):
+        events = trace.load_trace_events(FIXTURE)
+        lanes = trace.device_lanes(events)
+        # pid 2 is a /device: process, pid 3 hosts a tf_XLA* thread;
+        # pid 1 (host python, where the round markers live) is not a
+        # device lane
+        assert (2, 20) in lanes and (3, 30) in lanes
+        assert all(pid != 1 for pid, _tid in lanes)
+
+    def test_round_windows_from_markers(self):
+        events = trace.load_trace_events(FIXTURE)
+        windows = trace.round_windows(events)
+        assert windows == [(0, 1000.0, 2000.0),
+                           (1, 2000.0, 3500.0)]
+
+    def test_attribute_logdir_finds_gz(self, tmp_path):
+        sub = tmp_path / "plugins" / "profile" / "x"
+        sub.mkdir(parents=True)
+        with open(FIXTURE, "rb") as f:
+            (sub / "host.trace.json.gz").write_bytes(f.read())
+        buckets = trace.attribute_logdir(str(tmp_path))
+        assert sorted(buckets) == [0, 1]
+
+    def test_no_markers_no_rounds(self):
+        events = [{"ph": "M", "pid": 2, "name": "process_name",
+                   "args": {"name": "/device:TPU:0"}},
+                  {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.1",
+                   "ts": 10, "dur": 5, "args": {}}]
+        assert trace.attribute_rounds(events) == {}
+
+
+# --- roofline FLOP inventory ------------------------------------------
+
+
+CANNED_STABLEHLO = """
+module @round {
+  func.func public @main(%arg0: tensor<8x32xf32>, %arg1: tensor<32x16xf32>) -> tensor<8x16xf32> {
+    %0 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0] : (tensor<8x32xf32>, tensor<32x16xf32>) -> tensor<8x16xf32>
+    %1 = stablehlo.convolution(%arg2, %arg3) dim_numbers = [b, 0, 1, f]x[0, 1, i, o]->[b, 0, 1, f], window = {stride = [1, 1]} : (tensor<1x8x8x3xf32>, tensor<3x3x3x16xf32>) -> tensor<1x8x8x16xf32>
+    return %0 : tensor<8x16xf32>
+  }
+}
+"""
+
+
+class TestFlopInventory:
+    def test_dot_and_conv_macs(self):
+        from commefficient_tpu.analysis.hlo import flop_inventory
+        inv = flop_inventory(CANNED_STABLEHLO)
+        # dot: 2 x numel(8x16) x K=32; conv: 2 x numel(1x8x8x16) x
+        # (numel(3x3x3x16) / O=16) = 2 x 1024 x 27
+        assert inv["dot_flops"] == 2 * 8 * 16 * 32
+        assert inv["conv_flops"] == 2 * (8 * 8 * 16) * (3 * 3 * 3)
+        assert inv["total_flops"] == inv["dot_flops"] + inv["conv_flops"]
+        assert inv["dot_count"] == 1 and inv["conv_count"] == 1
+        assert inv["by_dtype"] == {"f32": inv["total_flops"]}
+
+    def test_cost_model_floors(self):
+        from commefficient_tpu.analysis.cost import build_cost_model
+        cost = build_cost_model(
+            CANNED_STABLEHLO, backend="cpu", device_kind="cpu",
+            n_devices=8, allreduce_payload_bytes=4.0 * 50_000,
+            label="test/8dev")
+        assert cost["total_flops"] == 2 * 8 * 16 * 32 + 2 * 1024 * 27
+        assert cost["expected_round_s"] > 0
+        assert cost["expected_round_s"] >= cost["compute_floor_s"]
+        assert cost["expected_round_s"] >= cost["collective_floor_s"]
+
+
+# --- perf-gate math ---------------------------------------------------
+
+
+def _metric(median, mad=0.0, better="lower", n=8):
+    return {"median": median, "mad": mad, "n": n, "p50": median,
+            "p95": median, "better": better}
+
+
+class TestGateMath:
+    def test_noise_within_band_passes(self):
+        base = gate.make_baseline(
+            {"span:round_dispatch:ms": _metric(10.0, mad=0.5)})
+        verdict = gate.compare(
+            base, {"span:round_dispatch:ms": _metric(12.0)})
+        assert verdict["checked"] == 1
+        assert verdict["regressions"] == []
+
+    def test_regression_beyond_band_fails(self):
+        base = gate.make_baseline(
+            {"span:round_dispatch:ms": _metric(10.0, mad=0.5)})
+        verdict = gate.compare(
+            base, {"span:round_dispatch:ms": _metric(20.0)})
+        assert len(verdict["regressions"]) == 1
+        r = verdict["regressions"][0]
+        assert r["metric"] == "span:round_dispatch:ms"
+        # band = max(0.25 * 10, 5 * 0.5) = 2.5ms; delta = 10ms
+        assert r["tolerance"] == pytest.approx(2.5)
+
+    def test_mad_band_dominates_when_noisy(self):
+        # mad 2ms -> band 10ms: a 9ms jump is still noise
+        base = gate.make_baseline(
+            {"span:h2d:ms": _metric(10.0, mad=2.0)})
+        verdict = gate.compare(base, {"span:h2d:ms": _metric(19.0)})
+        assert verdict["regressions"] == []
+
+    def test_higher_is_better_metrics_gate_downward(self):
+        base = gate.make_baseline(
+            {"bench:clients_per_s": _metric(100.0, better="higher")})
+        bad = gate.compare(
+            base, {"bench:clients_per_s": _metric(50.0,
+                                                  better="higher")})
+        good = gate.compare(
+            base, {"bench:clients_per_s": _metric(200.0,
+                                                  better="higher")})
+        assert len(bad["regressions"]) == 1
+        assert bad["improvements"] == []
+        assert good["regressions"] == []
+        assert len(good["improvements"]) == 1
+
+    def test_one_sided_metrics_skip(self):
+        base = gate.make_baseline({"span:a:ms": _metric(1.0)})
+        verdict = gate.compare(base, {"span:b:ms": _metric(1.0)})
+        assert verdict["checked"] == 0
+        reasons = {s["metric"]: s["reason"]
+                   for s in verdict["skipped"]}
+        assert reasons == {"span:a:ms": "not in current run",
+                           "span:b:ms": "not in baseline"}
+
+    def test_sub_resolution_baseline_skipped(self):
+        # 0.01 ms median is below scheduler resolution: a 100x blowup
+        # is not gateable signal
+        base = gate.make_baseline({"span:tiny:ms": _metric(0.01)})
+        verdict = gate.compare(base, {"span:tiny:ms": _metric(1.0)})
+        assert verdict["checked"] == 0
+        assert verdict["skipped"][0]["reason"] == \
+            "below timing resolution"
+
+    def test_roofline_utilization_never_floored(self):
+        base = gate.make_baseline(
+            {"device:roofline_utilization": _metric(0.0005,
+                                                    better="higher")})
+        verdict = gate.compare(
+            base, {"device:roofline_utilization": _metric(
+                0.0001, better="higher")})
+        assert verdict["checked"] == 1
+        assert len(verdict["regressions"]) == 1
+
+    def test_schema_mismatch_raises(self):
+        with pytest.raises(ValueError, match="schema"):
+            gate.compare({"schema": 99, "metrics": {}}, {})
+
+    def test_metrics_from_records_shapes(self):
+        rec = make_round_record(0)
+        rec["spans"] = {"h2d": 0.002, "server": 0.001}
+        rec["device_time"] = {"busy_s": 0.5, "compute_s": 0.4,
+                              "roofline_utilization": 0.31}
+        bench = make_bench_record("clients_per_s", 120.0, "1/s",
+                                  round_times_s=[0.1, 0.11, 0.09])
+        metrics = gate.metrics_from_records([rec, bench])
+        assert metrics["span:h2d:ms"]["median"] == \
+            pytest.approx(2.0)
+        assert metrics["span:h2d:ms"]["better"] == "lower"
+        assert metrics["device:busy_s"]["better"] == "lower"
+        assert metrics["device:roofline_utilization"]["better"] == \
+            "higher"
+        assert metrics["bench:clients_per_s"]["median"] == 120.0
+        assert metrics["bench:clients_per_s"]["better"] == "higher"
+        assert metrics["bench:clients_per_s:round_s"]["n"] == 3
+        assert metrics["bench:clients_per_s:round_s"]["better"] == \
+            "lower"
+
+
+# --- perf_gate CLI ----------------------------------------------------
+
+
+def _load_perf_gate():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "perf_gate.py")
+    spec = importlib.util.spec_from_file_location("_perf_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_ledger(path, round_s):
+    """A synthetic ledger whose round_dispatch span is ``round_s``."""
+    with open(path, "w") as f:
+        for r in range(8):
+            rec = make_round_record(r)
+            rec["spans"] = {"round_dispatch": round_s}
+            rec["uplink_bytes"] = rec["downlink_bytes"] = 1024.0
+            rec["device_time"] = {"window_s": round_s,
+                                  "busy_s": 0.8 * round_s,
+                                  "compute_s": 0.7 * round_s,
+                                  "collective_s": 0.1 * round_s,
+                                  "transfer_s": 0.0,
+                                  "host_gap_s": 0.2 * round_s}
+            f.write(json.dumps(rec) + "\n")
+
+
+class TestPerfGateCLI:
+    def test_baseline_check_regress_refuse_cycle(self, tmp_path):
+        pg = _load_perf_gate()
+        good = str(tmp_path / "good.jsonl")
+        slow = str(tmp_path / "slow.jsonl")
+        baseline = str(tmp_path / "perf_baseline.json")
+        _write_ledger(good, 0.050)
+        _write_ledger(slow, 0.200)  # 4x: far outside any noise band
+
+        assert pg.main(["--ledger", good,
+                        "--write-baseline", baseline]) == 0
+        assert os.path.exists(baseline)
+        base = gate.load_baseline(baseline)
+        assert base["schema"] == gate.BASELINE_SCHEMA
+        assert "span:round_dispatch:ms" in base["metrics"]
+
+        # same run gates green against its own baseline
+        assert pg.main(["--ledger", good, "--baseline", baseline,
+                        "--check"]) == 0
+        # the synthetically slowed ledger fails
+        assert pg.main(["--ledger", slow, "--baseline", baseline,
+                        "--check"]) == 1
+        # re-baselining over a regression is refused without --force
+        assert pg.main(["--ledger", slow, "--baseline", baseline,
+                        "--write-baseline", baseline]) == 1
+        assert gate.load_baseline(baseline)["metrics"][
+            "span:round_dispatch:ms"]["median"] == pytest.approx(50.0)
+        # --force is the explicit trade-off escape hatch
+        assert pg.main(["--ledger", slow, "--baseline", baseline,
+                        "--write-baseline", baseline,
+                        "--force"]) == 0
+        assert gate.load_baseline(baseline)["metrics"][
+            "span:round_dispatch:ms"]["median"] == pytest.approx(200.0)
+
+    def test_empty_ledger_is_an_error(self, tmp_path):
+        pg = _load_perf_gate()
+        empty = str(tmp_path / "empty.jsonl")
+        open(empty, "w").close()
+        assert pg.main(["--ledger", empty, "--check"]) == 1
+
+    def test_runs_dir_discovery(self, tmp_path):
+        pg = _load_perf_gate()
+        ledger = str(tmp_path / "run.jsonl")
+        _write_ledger(ledger, 0.050)
+        registry.write_manifest(str(tmp_path / "runs"), args=None,
+                                ledger=ledger)
+        baseline = str(tmp_path / "perf_baseline.json")
+        assert pg.main(["--runs_dir", str(tmp_path / "runs"),
+                        "--write-baseline", baseline]) == 0
+        assert pg.main(["--runs_dir", str(tmp_path / "runs"),
+                        "--baseline", baseline, "--check"]) == 0
+
+    def test_runs_dir_without_manifests_errors(self, tmp_path):
+        pg = _load_perf_gate()
+        assert pg.main(["--runs_dir", str(tmp_path),
+                        "--check"]) == 1
+
+
+# --- run registry -----------------------------------------------------
+
+
+class _Cfg:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class TestRunRegistry:
+    def test_manifest_round_trip(self, tmp_path):
+        ledger = str(tmp_path / "a.jsonl")
+        open(ledger, "w").close()
+        args = _Cfg(mode="sketch", k=16, ledger=ledger,
+                    do_profile=True)
+        path = registry.write_manifest(
+            str(tmp_path / "runs"), args=args, ledger=ledger,
+            bench={"clients_per_s": {"value": 10.0}},
+            mesh_shape={"data": 8}, extra={"trainer": "test"})
+        manifests = registry.list_manifests(str(tmp_path / "runs"))
+        assert [p for p, _ in manifests] == [path]
+        rec = manifests[0][1]
+        assert rec["kind"] == "run_manifest"
+        assert rec["schema"] == registry.MANIFEST_SCHEMA
+        assert rec["config_hash"] == registry.config_hash(args)
+        assert rec["ledger"] == os.path.abspath(ledger)
+        assert rec["trainer"] == "test"
+        assert rec["mesh_shape"] == {"data": 8}
+        hits = registry.latest_ledgers(str(tmp_path / "runs"))
+        assert hits == [(path, rec, os.path.abspath(ledger))]
+
+    def test_config_hash_ignores_observability_knobs(self):
+        a = _Cfg(mode="sketch", k=16, ledger="x.jsonl",
+                 do_profile=True, telemetry_console=True)
+        b = _Cfg(mode="sketch", k=16, ledger="y.jsonl",
+                 do_profile=False, telemetry_console=False)
+        c = _Cfg(mode="sketch", k=32, ledger="x.jsonl",
+                 do_profile=True, telemetry_console=True)
+        assert registry.config_hash(a) == registry.config_hash(b)
+        assert registry.config_hash(a) != registry.config_hash(c)
+
+    def test_latest_ledgers_skips_deleted(self, tmp_path):
+        runs = str(tmp_path / "runs")
+        led1 = str(tmp_path / "old.jsonl")
+        led2 = str(tmp_path / "gone.jsonl")
+        open(led1, "w").close()
+        open(led2, "w").close()
+        registry.write_manifest(runs, args=_Cfg(x=1), ledger=led1)
+        registry.write_manifest(runs, args=_Cfg(x=2), ledger=led2)
+        os.remove(led2)
+        hits = registry.latest_ledgers(runs, n=2)
+        assert [h[2] for h in hits] == [os.path.abspath(led1)]
+
+    def test_maybe_write_manifest_gates(self, tmp_path):
+        # no ledger -> no manifest; --test smoke -> no manifest
+        assert registry.maybe_write_manifest(
+            _Cfg(ledger=""), runs_dir=str(tmp_path)) is None
+        assert registry.maybe_write_manifest(
+            _Cfg(ledger="x.jsonl", do_test=True),
+            runs_dir=str(tmp_path)) is None
+        assert registry.list_manifests(str(tmp_path)) == []
+
+
+# --- step-time alarm --------------------------------------------------
+
+
+class _AlarmCfg:
+    on_divergence = "ledger-flag"
+    alarm_residual_ratio = 10.0
+    alarm_residual_rounds = 3
+    alarm_recovery_error = 1.0
+    alarm_step_time_ratio = 2.0
+    alarm_step_time_window = 8
+
+
+class TestStepTimeAlarm:
+    def test_warmup_then_fire_then_keep_firing(self):
+        eng = AlarmEngine(_AlarmCfg())
+        for r in range(AlarmEngine.STEP_TIME_WARMUP):
+            assert eng.check_step_time(r, 0.1) == []
+        # healthy round within ratio x median: no alarm
+        assert eng.check_step_time(5, 0.15) == []
+        fired = eng.check_step_time(6, 0.5)
+        assert fired and fired[0]["rule"] == "step_time_regression"
+        assert fired[0]["threshold"] == pytest.approx(0.2)
+        assert fired[0]["rolling_median"] == pytest.approx(0.1)
+        # firing samples are NOT folded into the window, so a
+        # sustained regression keeps firing instead of becoming the
+        # new normal
+        assert eng.check_step_time(7, 0.5)
+        assert eng.check_step_time(8, 0.5)
+
+    def test_flags_ledger_record(self):
+        tel = Telemetry(sinks=[_ListSink()])
+        tel.begin_round(0)
+        eng = AlarmEngine(_AlarmCfg(), telemetry=tel)
+        for r in range(AlarmEngine.STEP_TIME_WARMUP):
+            eng.check_step_time(r, 0.1)
+        eng.check_step_time(0, 0.9)
+        rec = tel._records[0]
+        assert rec["alarms"] and \
+            rec["alarms"][0]["rule"] == "step_time_regression"
+
+    def test_abort_action_raises(self):
+        class Abort(_AlarmCfg):
+            on_divergence = "abort"
+        eng = AlarmEngine(Abort())
+        for r in range(AlarmEngine.STEP_TIME_WARMUP):
+            eng.check_step_time(r, 0.1)
+        with pytest.raises(DivergenceAbort):
+            eng.check_step_time(5, 0.9)
+
+    def test_disarmed_when_ratio_zero(self):
+        class Off(_AlarmCfg):
+            alarm_step_time_ratio = 0.0
+        eng = AlarmEngine(Off())
+        for r in range(20):
+            assert eng.check_step_time(r, 100.0) == []
+
+    def test_build_alarm_engine_arms_on_step_time_alone(self):
+        from commefficient_tpu.telemetry.alarms import \
+            build_alarm_engine
+
+        class NoProbes(_AlarmCfg):
+            probe_period = 0
+        assert build_alarm_engine(NoProbes()) is not None
+
+        class Nothing(_AlarmCfg):
+            probe_period = 0
+            alarm_step_time_ratio = 0.0
+        assert build_alarm_engine(Nothing()) is None
+
+
+# --- stale waivers ----------------------------------------------------
+
+
+class TestStaleWaivers:
+    def test_live_orphan_and_unknown(self, tmp_path):
+        from commefficient_tpu.analysis import lint
+        (tmp_path / "a.py").write_text(
+            "# audit: allow(mutable-default-arg)\n"   # live: covers L2
+            "def f(a=[]):\n"
+            "    return a\n"
+            "\n"
+            "# audit: allow(mutable-default-arg)\n"   # orphan
+            "x = 1\n"
+            "\n"
+            "# audit: allow(no-such-rule)\n"          # typo'd rule
+            "y = 2\n")
+        violations = lint.run_lint(root=tmp_path)
+        assert [v.waived for v in violations] == [True]
+        stale = lint.stale_waivers(root=tmp_path,
+                                   violations=violations)
+        assert len(stale) == 2
+        assert any("a.py:5" in s and "stale waiver" in s
+                   for s in stale)
+        assert any("a.py:8" in s and "unknown rule" in s
+                   for s in stale)
+
+    def test_repo_has_no_stale_waivers(self):
+        from commefficient_tpu.analysis import lint
+        assert lint.stale_waivers() == []
+
+    def test_stale_waivers_are_hard_failures(self):
+        from commefficient_tpu.analysis import baseline as base_mod
+        from commefficient_tpu.analysis import lint
+        summary = lint.lint_report(
+            [], stale=["a.py:5: stale waiver allow(host-sync) — ..."])
+        report = base_mod.build_report(
+            {"programs": {}, "failures": []}, summary)
+        assert any("stale waiver" in f for f in report["failures"])
+        # ...and can never be baselined in: the pinned subset keeps
+        # only the waived list
+        base = base_mod.to_baseline(
+            {"programs": {}, "jax_version": "x", "device_count": 8,
+             "lint": summary, "failures": []})
+        assert "stale_waivers" not in base["lint"]
+
+
+# --- telemetry emission hold ------------------------------------------
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+    def close(self):
+        pass
+
+
+class TestEmissionHold:
+    def test_hold_buffers_then_merges_device_time(self):
+        sink = _ListSink()
+        tel = Telemetry(sinks=[sink])
+        tel.hold_emission(True)
+        for r in range(2):
+            tel.begin_round(r)
+            tel.set_round_bytes(r, 10.0, 20.0)
+        tel.begin_round(2)        # closes round 1
+        tel.set_round_bytes(2, 10.0, 20.0)
+        assert sink.records == []  # everything buffered by the hold
+        buckets = {"window_s": 1.0, "busy_s": 0.5, "compute_s": 0.4,
+                   "collective_s": 0.1, "transfer_s": 0.0,
+                   "host_gap_s": 0.5}
+        tel.merge_round_device_time(0, buckets)
+        tel.merge_round_device_time(1, buckets)
+        tel.hold_emission(False)
+        emitted = [r["round"] for r in sink.records
+                   if r["kind"] == "round"]
+        assert emitted == [0, 1]   # round order preserved
+        assert all(r["device_time"] == buckets for r in sink.records
+                   if r["kind"] == "round")
+        tel.close()
+        assert [r["round"] for r in sink.records
+                if r["kind"] == "round"] == [0, 1, 2]
+
+    def test_roofline_utilization_derived_from_cost_model(self):
+        sink = _ListSink()
+        tel = Telemetry(sinks=[sink])
+        tel.expected_round_s = 0.25
+        tel.begin_round(0)
+        tel.merge_round_device_time(0, {"window_s": 1.0,
+                                        "busy_s": 0.5})
+        rec = tel._records[0]
+        assert rec["device_time"]["roofline_utilization"] == \
+            pytest.approx(0.5)
+
+    def test_close_overrides_hold(self):
+        sink = _ListSink()
+        tel = Telemetry(sinks=[sink])
+        tel.hold_emission(True)
+        tel.begin_round(0)
+        tel.close()
+        assert [r["round"] for r in sink.records
+                if r["kind"] == "round"] == [0]
+
+
+# --- end-to-end: --profile on the CPU mesh ----------------------------
+
+
+class TestProfileIntegration:
+    def test_profiled_run_attributes_device_time(self, tmp_path):
+        """The acceptance criterion: a ``--profile``'d CPU run
+        produces a schema-v3 ledger whose per-round device-time
+        buckets sum to the round window exactly, and whose windows
+        together cover the in-trace wall time to within 10% (+ a
+        small absolute epsilon for trace start/stop edges)."""
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from commefficient_tpu.config import Config
+        from commefficient_tpu.runtime import FedModel, FedOptimizer
+        from commefficient_tpu.telemetry import clock
+        from commefficient_tpu.telemetry.profiler import trace_window
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(64, use_bias=False)(x)
+
+        module = Lin()
+        params = module.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 32)))["params"]
+        ledger = str(tmp_path / "ledger.jsonl")
+        args = Config(mode="sketch", error_type="virtual",
+                      local_momentum=0.0, virtual_momentum=0.9,
+                      num_workers=2, local_batch_size=4,
+                      num_clients=4, dataset_name="CIFAR10", seed=0,
+                      k=16, num_rows=3, num_cols=256)
+        args.ledger = ledger
+        args.do_profile = True
+
+        def loss(p, batch, cfg):
+            pred = module.apply({"params": p}, batch["x"])
+            n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+            return (jnp.sum(pred ** 2 * batch["mask"][..., None])
+                    / n, ())
+
+        model = FedModel(module, params, loss, args,
+                         padded_batch_size=4)
+        opt = FedOptimizer([{"lr": 0.1}], args)
+        rng = np.random.RandomState(0)
+
+        def mk(r):
+            return {"x": rng.randn(2, 4, 32).astype(np.float32),
+                    "y": rng.randn(2, 4).astype(np.float32),
+                    "mask": np.ones((2, 4), np.float32),
+                    "client_ids": np.array([r % 4, (r + 1) % 4],
+                                           np.int32)}
+
+        # round 0 outside the window carries compile/warmup
+        model(mk(0))
+        opt.step()
+        logdir = str(tmp_path / "trace")
+        with trace_window(logdir, telemetry=model.telemetry):
+            t0 = clock.tick()
+            for r in range(1, 5):
+                model(mk(r))
+                opt.step()
+            jax.block_until_ready(model.ps_weights)
+            loop_wall = clock.tick() - t0
+        model.finalize()
+
+        recs = [json.loads(line) for line in open(ledger)]
+        assert all(not validate_record(r) for r in recs)
+        rounds = [r for r in recs if r["kind"] == "round"]
+        assert len(rounds) == 5
+        assert all(r["schema"] == 3 for r in rounds)
+
+        traced = [r for r in rounds if r.get("device_time")]
+        assert [r["round"] for r in traced] == [1, 2, 3, 4]
+        total_window = 0.0
+        for r in traced:
+            dt = r["device_time"]
+            parts = (dt["compute_s"] + dt["collective_s"]
+                     + dt["transfer_s"] + dt["host_gap_s"])
+            assert abs(parts - dt["window_s"]) < 1e-5
+            assert dt["busy_s"] > 0
+            # the --profile cost model registered expected_round_s,
+            # so every traced round carries a utilization
+            assert 0 < dt["roofline_utilization"] <= 1.0
+            total_window += dt["window_s"]
+        # windows tile the in-trace loop: round 1's window absorbs
+        # the one-off cost-model lowering, the last window extends to
+        # the trace stop — 10% relative + 50ms absolute covers both
+        assert abs(total_window - loop_wall) <= \
+            0.1 * loop_wall + 0.05
+
+        cost_meta = [r for r in recs if r["kind"] == "meta"
+                     and r.get("cost_model")]
+        assert len(cost_meta) == 1
+        cm = cost_meta[0]["cost_model"]
+        assert cm["expected_round_s"] > 0
+        assert cm["total_flops"] > 0
+
+        trace_meta = [r for r in recs if r["kind"] == "meta"
+                      and r.get("trace_rounds")]
+        assert len(trace_meta) == 1
+        assert trace_meta[0]["trace_rounds"] == 4
+        assert trace_meta[0]["trace_busy_s"] > 0
+
+        # the ledger gates end-to-end through the perf-gate CLI
+        pg = _load_perf_gate()
+        baseline = str(tmp_path / "perf_baseline.json")
+        assert pg.main(["--ledger", ledger,
+                        "--write-baseline", baseline]) == 0
+        assert pg.main(["--ledger", ledger, "--baseline", baseline,
+                        "--check"]) == 0

@@ -328,13 +328,19 @@ def _cv_args(**kw):
 
 
 def test_probed_run_emits_v2_ledger(tmp_path):
+    """Probe fields (introduced in schema v2) on a live ledger —
+    records are stamped with the current schema (v3 since the
+    device-time fields landed)."""
+    from commefficient_tpu.telemetry.record import \
+        LEDGER_SCHEMA_VERSION
     from commefficient_tpu.train import cv_train
     path = str(tmp_path / "run.jsonl")
     cv_train.main(_cv_args(probe_every=1, ledger=path))
     rounds = _probe_rounds(path)
     assert rounds
     for r in rounds:
-        assert r["schema"] == 2
+        assert r["schema"] == LEDGER_SCHEMA_VERSION
+        assert r["schema"] >= 2
         pr = r["probes"]
         for key in ("agg_norm", "agg_nan", "agg_inf", "update_norm",
                     "momentum_norm", "residual_norm", "mass_coverage",

@@ -154,6 +154,54 @@ def test_close_flushes_byteless_rounds(tmp_path):
     assert validate_record(recs[0]) == []
 
 
+def test_schema_v3_device_time_round_trip(tmp_path):
+    """A fresh round record is schema v3 with ``device_time: None``;
+    a populated numeric bucket dict validates and survives the JSONL
+    sink; malformed device_time is caught; v1/v2 ledgers (no
+    device_time key) stay readable."""
+    from commefficient_tpu.telemetry.record import (
+        READABLE_SCHEMA_VERSIONS, make_round_record)
+
+    assert READABLE_SCHEMA_VERSIONS == (1, 2, 3)
+    rec = make_round_record(0)
+    assert rec["schema"] == 3 and rec["device_time"] is None
+    assert validate_record(rec) == []
+
+    rec["device_time"] = {"window_s": 0.01, "busy_s": 0.004,
+                          "compute_s": 0.003, "collective_s": 0.0005,
+                          "transfer_s": 0.0005, "host_gap_s": 0.006,
+                          "roofline_utilization": 0.2}
+    assert validate_record(rec) == []
+    path = str(tmp_path / "v3.jsonl")
+    sink = JSONLSink(path)
+    sink.write(rec)
+    sink.close()
+    with open(path) as f:
+        back = json.loads(f.read())
+    assert validate_record(back) == []
+    assert back["device_time"] == rec["device_time"]
+
+    bad = dict(rec, device_time=[1, 2])
+    assert any("device_time" in p for p in validate_record(bad))
+    bad = dict(rec, device_time={"busy_s": "fast"})
+    assert any("device_time" in p for p in validate_record(bad))
+
+    # pre-v3 records never carried the key — still valid
+    v2 = {k: v for k, v in make_round_record(1).items()
+          if k != "device_time"}
+    v2["schema"] = 2
+    assert validate_record(v2) == []
+    v1 = {k: v for k, v in v2.items()
+          if k not in ("probes", "alarms")}
+    v1["schema"] = 1
+    assert validate_record(v1) == []
+    # ...but a v3 record MUST carry it
+    v3_missing = {k: v for k, v in make_round_record(2).items()
+                  if k != "device_time"}
+    assert any("device_time" in p
+               for p in validate_record(v3_missing))
+
+
 def test_console_sink_aggregates(capsys):
     tel = Telemetry([ConsoleSink()])
     for r in range(2):
