@@ -252,6 +252,33 @@ def _check_python_rng(rel, lines, tree):
     return hits
 
 
+# --- rule: raw-devices -------------------------------------------------
+
+
+def _check_raw_devices(rel, lines, tree):
+    """jax.devices()/jax.local_devices() inside telemetry/: the
+    observatory must see the fleet through parallel/mesh.py
+    (``topology_summary`` / ``first_local_device``) so device
+    resolution has ONE owner — raw enumeration here silently disagrees
+    with the mesh on subset-mesh and multi-process runs."""
+    if _top(rel) != "telemetry":
+        return []
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute)
+                and f.attr in {"devices", "local_devices"}
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "jax"):
+            hits.append((node.lineno,
+                         f"raw jax.{f.attr}() in telemetry/ — resolve "
+                         "devices via parallel.mesh "
+                         "(topology_summary/first_local_device)"))
+    return hits
+
+
 # --- rule: mutable-default-arg -----------------------------------------
 
 
@@ -288,6 +315,9 @@ ALL_RULES = [
     Rule("python-rng",
          "stdlib/NumPy RNG in compiled scope",
          _check_python_rng),
+    Rule("raw-devices",
+         "raw jax.devices()/jax.local_devices() inside telemetry/",
+         _check_raw_devices),
     Rule("mutable-default-arg",
          "mutable default argument",
          _check_mutable_default),

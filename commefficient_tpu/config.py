@@ -294,6 +294,12 @@ class Config:
     # without probes; shares the --on_divergence action.
     alarm_step_time_ratio: float = 0.0
     alarm_step_time_window: int = 16
+    # collective-skew rule (telemetry/alarms.py): fire when a traced
+    # round's max cross-device collective enter-delta exceeds this
+    # ratio x the round's collective seconds (schema-v4 device_time
+    # skew stats). 0 = off. Needs --profile to produce trace buckets;
+    # shares the --on_divergence action.
+    alarm_collective_skew: float = 0.0
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -331,6 +337,8 @@ class Config:
             "--alarm_step_time_ratio must be >= 0 (0 = rule off)"
         assert self.alarm_step_time_window >= 2, \
             "--alarm_step_time_window must be >= 2"
+        assert self.alarm_collective_skew >= 0, \
+            "--alarm_collective_skew must be >= 0 (0 = rule off)"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -619,6 +627,13 @@ def build_parser(default_lr: Optional[float] = None,
                         default=16,
                         help="rolling-median window (rounds) for "
                         "--alarm_step_time_ratio")
+    parser.add_argument("--alarm_collective_skew", type=float,
+                        default=0.0,
+                        help="collective_skew rule: fire when a traced "
+                        "round's max cross-device collective "
+                        "enter-delta exceeds this ratio x its "
+                        "collective seconds (0 = off; needs --profile; "
+                        "action from --on_divergence)")
 
     return parser
 

@@ -50,6 +50,38 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devices), (CLIENT_AXIS,))
 
 
+def first_local_device() -> jax.Device:
+    """Local device 0 — the canonical probe target for memory stats
+    and placement checks. The single sanctioned raw-device escape
+    hatch: telemetry code resolves devices through this module (the
+    ``raw-devices`` lint rule, analysis/lint.py) so subset meshes and
+    multi-host topologies keep one source of truth."""
+    return jax.local_devices()[0]
+
+
+def topology_summary() -> dict:
+    """The run's device topology, as recorded by run manifests and
+    ledger meta records (and used to key perf-gate baselines):
+    ``{device_count, local_device_count, process_index, process_count,
+    backend, device_kind}``. Degrades to a 1-device/1-process CPU
+    shape if the backend cannot initialise (manifest writing must
+    never take a run down)."""
+    try:
+        devices = jax.devices()
+        return {
+            "device_count": len(devices),
+            "local_device_count": len(jax.local_devices()),
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+            "backend": jax.default_backend(),
+            "device_kind": devices[0].device_kind if devices else "",
+        }
+    except Exception:
+        return {"device_count": 1, "local_device_count": 1,
+                "process_index": 0, "process_count": 1,
+                "backend": "unknown", "device_kind": ""}
+
+
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None) -> int:

@@ -270,12 +270,21 @@ class FedModel:
         self._prev_residual = None
         from commefficient_tpu.telemetry.alarms import build_alarm_engine
         self.alarm_engine = build_alarm_engine(args, self.telemetry)
+        if self.alarm_engine is not None:
+            # trace-derived skew escalates like any probe alarm: the
+            # profiler's bucket merge calls straight into the engine
+            self.telemetry.on_device_time = \
+                self.alarm_engine.check_device_time
         # roofline cost model (analysis/cost.py), computed lazily at
         # the first --profile'd round from the lowered round program
         self._cost_model = None
+        from commefficient_tpu.parallel import mesh as mesh_lib
+        topo = mesh_lib.topology_summary()
         self.telemetry.emit_meta(
             num_clients=num_clients,
             num_devices=int(np.prod(self.mesh.devices.shape)),
+            process_index=topo["process_index"],
+            process_count=topo["process_count"],
             clientstore=self.clientstore,
             plan=round_plan(args))
 

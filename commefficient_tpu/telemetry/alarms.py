@@ -29,6 +29,15 @@ round instead of silently training on garbage. Three rules:
                        on synchronous rounds only — pipelined
                        dispatch times measure the host, not the
                        round.
+``collective_skew``  — trace-derived (schema-v4 ``device_time``): a
+                       profiled round's straggler wait dominates its
+                       collective bucket — max cross-device
+                       enter-delta above ``--alarm_collective_skew``
+                       x the round's collective seconds. The fleet
+                       version of the step-time rule: one slow
+                       participant taxes every device in the mesh,
+                       and the skew decomposition names it. Only
+                       rounds inside a trace window are evaluated.
 
 Every fired rule is appended to the round record's ``alarms`` list
 (when a ledger is attached) regardless of action. The action then
@@ -87,6 +96,8 @@ class AlarmEngine:
             getattr(cfg, "alarm_step_time_ratio", 0.0) or 0.0)
         self.step_time_window = int(
             getattr(cfg, "alarm_step_time_window", 16) or 16)
+        self.collective_skew = float(
+            getattr(cfg, "alarm_collective_skew", 0.0) or 0.0)
         self.telemetry = telemetry
         self._consecutive = 0
         self._step_times = deque(maxlen=self.step_time_window)
@@ -151,6 +162,29 @@ class AlarmEngine:
                   "rolling_median": med}]
         return self._escalate(round_index, fired)
 
+    def check_device_time(self, round_index: int, buckets) -> list:
+        """``collective_skew``: fires when a traced round's max
+        cross-device enter-delta (telemetry/trace.py skew stats)
+        exceeds ``collective_skew`` x the round's collective bucket.
+        Wired as ``Telemetry.on_device_time`` so it runs when trace
+        buckets merge — after the round closed, before emission (the
+        flagged record still reaches the sink with its alarms)."""
+        if self.collective_skew <= 0 or not buckets:
+            return []
+        skew = buckets.get("skew") or {}
+        delta = skew.get("max_enter_delta_s")
+        coll = float(buckets.get("collective_s") or 0.0)
+        if delta is None or coll <= 0:
+            return []
+        threshold = self.collective_skew * coll
+        if float(delta) <= threshold:
+            return []
+        fired = [{"rule": "collective_skew",
+                  "value": float(delta), "threshold": threshold,
+                  "collective_s": coll,
+                  "straggler_device": skew.get("straggler_device")}]
+        return self._escalate(round_index, fired)
+
     def _escalate(self, round_index: int, fired: list) -> list:
         """Shared escalation tail: flag the ledger record, then act —
         ``abort`` raises AFTER flagging so the record that reaches the
@@ -174,9 +208,12 @@ class AlarmEngine:
 
 
 def build_alarm_engine(cfg, telemetry=None):
-    """An engine when probes are on or the step-time rule is armed,
-    else None (no per-round call)."""
-    if getattr(cfg, "probe_period", 0) or float(
-            getattr(cfg, "alarm_step_time_ratio", 0.0) or 0.0) > 0:
+    """An engine when probes are on or the step-time / collective-skew
+    rules are armed, else None (no per-round call)."""
+    if (getattr(cfg, "probe_period", 0)
+            or float(getattr(cfg, "alarm_step_time_ratio", 0.0)
+                     or 0.0) > 0
+            or float(getattr(cfg, "alarm_collective_skew", 0.0)
+                     or 0.0) > 0):
         return AlarmEngine(cfg, telemetry)
     return None

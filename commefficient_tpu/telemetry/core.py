@@ -115,8 +115,8 @@ def hbm_peak_bytes():
     """Peak accelerator bytes-in-use on local device 0, or None (CPU
     backends don't report; any failure degrades to None)."""
     try:
-        import jax
-        stats = jax.local_devices()[0].memory_stats()
+        from commefficient_tpu.parallel import mesh
+        stats = mesh.first_local_device().memory_stats()
         if stats:
             return int(stats.get("peak_bytes_in_use", 0)) or None
     except Exception:
@@ -144,6 +144,11 @@ class Telemetry:
         # registered by FedModel under --profile; merged device-time
         # buckets derive roofline_utilization from it
         self.expected_round_s = None
+        # optional callback(round_index, buckets) invoked when trace
+        # buckets merge — FedModel points it at the alarm engine's
+        # collective-skew check so trace-derived skew can escalate
+        # like any other alarm rule
+        self.on_device_time = None
         if self._sinks:
             _ensure_compile_listener()
 
@@ -256,6 +261,9 @@ class Telemetry:
             # not round to zero
             buckets["roofline_utilization"] = round(exp / busy, 6)
         rec["device_time"] = buckets
+        cb = self.on_device_time
+        if cb is not None:
+            cb(index, buckets)
 
     def flag_alarm(self, index: int, alarm: dict):
         """Append an alarm dict to round ``index``'s record (schema
@@ -313,30 +321,50 @@ class Telemetry:
 NULL_TELEMETRY = Telemetry()
 
 
-def build_telemetry(args, extra_sinks=()) -> Telemetry:
+def build_telemetry(args, extra_sinks=(), process_index=None,
+                    process_count=None) -> Telemetry:
     """Resolve a run's Telemetry from its Config.
 
-    ``--ledger PATH`` attaches the JSONL sink (process 0 only on
-    multi-process meshes — the accounting arrays are replicated, so
-    one writer suffices); ``--telemetry_console`` the end-of-run
-    console summary. The TensorBoard sink is attached later by the
-    trainer, which owns the run logdir.
+    ``--ledger PATH`` attaches a JSONL sink on EVERY process: process
+    0 writes the canonical ledger at ``PATH`` (round records carry the
+    replicated accounting arrays, so one canonical writer suffices);
+    process k > 0 writes the ``PATH.p<k>.jsonl`` shard — its own
+    host-phase spans, RSS watermarks, and locally-observed bytes —
+    announced once per run so multi-host data is never silently
+    dropped. ``scripts/ledger_merge.py`` joins the shards back on
+    round id. Records are process-stamped whenever the mesh is
+    multi-process. ``--telemetry_console`` attaches the end-of-run
+    console summary (process 0 only). The TensorBoard sink is attached
+    later by the trainer, which owns the run logdir.
+
+    ``process_index``/``process_count`` default to the live jax
+    runtime; tests inject them to exercise the shard layout without a
+    multi-process mesh.
     """
     sinks = list(extra_sinks)
     path = getattr(args, "ledger", "") or ""
     console = bool(getattr(args, "telemetry_console", False))
     if path or console:
-        primary = True
-        try:
-            import jax
-            primary = jax.process_index() == 0
-        except Exception:
-            pass
-        if primary:
-            from commefficient_tpu.telemetry.sinks import (ConsoleSink,
-                                                           JSONLSink)
-            if path:
-                sinks.append(JSONLSink(path))
-            if console:
-                sinks.append(ConsoleSink())
+        if process_index is None or process_count is None:
+            try:
+                import jax
+                process_index = jax.process_index()
+                process_count = jax.process_count()
+            except Exception:
+                process_index, process_count = 0, 1
+        pidx, pcount = int(process_index), int(process_count)
+        from commefficient_tpu.telemetry.sinks import (ConsoleSink,
+                                                       JSONLSink,
+                                                       shard_ledger_path)
+        if path:
+            spath = shard_ledger_path(path, pidx)
+            stamp = pidx if pcount > 1 else None
+            sinks.append(JSONLSink(spath, process=stamp))
+            if pidx != 0:
+                print(f"telemetry: process {pidx}/{pcount} writing "
+                      f"ledger shard {spath} (process 0 owns the "
+                      f"canonical ledger; merge with "
+                      f"scripts/ledger_merge.py)")
+        if console and pidx == 0:
+            sinks.append(ConsoleSink())
     return Telemetry(sinks)

@@ -24,7 +24,17 @@ Metrics extracted from a ledger (``metrics_from_records``):
   (compute/collective/transfer/host_gap/busy);
 * ``bench:<metric>`` — bench-record headline values
   (clients/s — higher is better); a bench record's ``round_times_s``
-  list also yields ``bench:<metric>:round_s`` samples.
+  list also yields ``bench:<metric>:round_s`` samples;
+* ``device:skew_*`` — schema-v4 collective-skew stats (max/p95
+  cross-device enter-delta — lower is better).
+
+Baselines are **topology-keyed** (schema 2): one committed
+``perf_baseline.json`` holds an independent metrics entry per
+``(device_count, process_count)`` point, so the 8-device headline is
+guarded by an 8-device reference and can never be "regressed" by
+comparison against a single-chip run. Schema-1 baselines (one flat,
+topology-blind metrics dict) remain readable: they resolve for any
+topology, exactly as they always did, until re-captured.
 
 Pure stdlib, no jax — importable by tier-1 unit tests and by
 ``scripts/perf_gate.py``.
@@ -38,7 +48,12 @@ from typing import Dict, List
 
 from commefficient_tpu.telemetry import clock
 
-BASELINE_SCHEMA = 1
+BASELINE_SCHEMA = 2
+READABLE_BASELINE_SCHEMAS = (1, 2)
+
+#: topology key for runs whose device/process counts are unknown
+#: (pre-fleet ledgers with no meta record; direct metrics-dict tests)
+ANY_TOPOLOGY = "any"
 
 #: default gate knobs (CLI-overridable): generous enough for CI-class
 #: noise, tight enough that a 2x regression can never pass
@@ -83,9 +98,17 @@ def metrics_from_records(records) -> Dict[str, Dict]:
         if kind == "round":
             for name, secs in (rec.get("spans") or {}).items():
                 spans.setdefault(name, []).append(1e3 * float(secs))
-            for bname, val in (rec.get("device_time") or {}).items():
+            dt = rec.get("device_time") or {}
+            for bname, val in dt.items():
                 if isinstance(val, (int, float)):
                     device.setdefault(bname, []).append(float(val))
+            skew = dt.get("skew")
+            if isinstance(skew, dict):
+                for sname in ("max_enter_delta_s", "p95_enter_delta_s"):
+                    val = skew.get(sname)
+                    if isinstance(val, (int, float)):
+                        device.setdefault(f"skew_{sname}",
+                                          []).append(float(val))
         elif kind == "bench":
             metric = rec.get("metric")
             if metric is None:
@@ -112,13 +135,92 @@ def metrics_from_records(records) -> Dict[str, Dict]:
     return out
 
 
+def topology_key(device_count=None, process_count=None) -> str:
+    """Baseline entry key for one topology point. ``d<D>p<P>`` when
+    both counts are known, :data:`ANY_TOPOLOGY` otherwise — unknown
+    topologies form their own bucket rather than silently matching a
+    counted one."""
+    if device_count is None or process_count is None:
+        return ANY_TOPOLOGY
+    return f"d{int(device_count)}p{int(process_count)}"
+
+
+def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
+                        device_count=None, process_count=None,
+                        config_hash: str = "") -> Dict:
+    entry = {"ts": clock.wall(), "source": source, "metrics": metrics}
+    if device_count is not None:
+        entry["device_count"] = int(device_count)
+    if process_count is not None:
+        entry["process_count"] = int(process_count)
+    if config_hash:
+        entry["config_hash"] = config_hash
+    return entry
+
+
 def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
-                  extra: Dict = None) -> Dict:
+                  extra: Dict = None, device_count=None,
+                  process_count=None, config_hash: str = "") -> Dict:
+    """A fresh schema-2 baseline holding one topology entry."""
+    key = topology_key(device_count, process_count)
     base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
-            "source": source, "metrics": metrics}
+            "topologies": {key: make_topology_entry(
+                metrics, source=source, device_count=device_count,
+                process_count=process_count, config_hash=config_hash)}}
     if extra:
         base.update(extra)
     return base
+
+
+def migrate_baseline(baseline: Dict) -> Dict:
+    """Schema-1 -> schema-2: the flat metrics dict becomes the
+    :data:`ANY_TOPOLOGY` entry (it was captured topology-blind, so
+    that is the honest key). Schema-2 passes through unchanged."""
+    if baseline.get("schema") == BASELINE_SCHEMA:
+        return baseline
+    return {"schema": BASELINE_SCHEMA,
+            "ts": baseline.get("ts", clock.wall()),
+            "topologies": {ANY_TOPOLOGY: {
+                "ts": baseline.get("ts", clock.wall()),
+                "source": baseline.get("source", ""),
+                "metrics": baseline.get("metrics", {})}}}
+
+
+def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
+                    source: str = "", device_count=None,
+                    process_count=None, config_hash: str = "") -> Dict:
+    """Insert/replace ONE topology's entry, leaving every other
+    topology point untouched — how the gate CLI re-captures the
+    8-device headline without disturbing the single-chip one.
+    Schema-1 input is migrated first. Returns the (new) baseline."""
+    base = migrate_baseline(dict(baseline)) if baseline else \
+        {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
+         "topologies": {}}
+    base["topologies"] = dict(base.get("topologies", {}))
+    key = topology_key(device_count, process_count)
+    base["topologies"][key] = make_topology_entry(
+        metrics, source=source, device_count=device_count,
+        process_count=process_count, config_hash=config_hash)
+    base["ts"] = clock.wall()
+    return base
+
+
+def baseline_entry(baseline: Dict, device_count=None,
+                   process_count=None):
+    """The topology entry ``compare`` gates against, or None when the
+    baseline has no entry for this topology. Schema-1 baselines
+    resolve for ANY topology (their historical, topology-blind
+    behaviour — re-capture to get keyed guarding)."""
+    schema = baseline.get("schema")
+    if schema not in READABLE_BASELINE_SCHEMAS:
+        raise ValueError(
+            f"baseline schema {schema!r} not in "
+            f"{READABLE_BASELINE_SCHEMAS} — re-capture the baseline")
+    if schema == 1:
+        return {"source": baseline.get("source", ""),
+                "metrics": baseline.get("metrics", {})}
+    return baseline.get("topologies", {}).get(
+        topology_key(device_count, process_count))
 
 
 def _threshold(base_entry: Dict, rel_tol: float, mad_k: float):
@@ -128,21 +230,29 @@ def _threshold(base_entry: Dict, rel_tol: float, mad_k: float):
 
 def compare(baseline: Dict, metrics: Dict[str, Dict],
             rel_tol: float = REL_TOL,
-            mad_k: float = MAD_K) -> Dict:
-    """Gate ``metrics`` against ``baseline``. Returns::
+            mad_k: float = MAD_K, device_count=None,
+            process_count=None) -> Dict:
+    """Gate ``metrics`` against ``baseline``'s entry for this
+    topology. Returns::
 
         {"regressions": [...], "improvements": [...],
-         "skipped": [...], "checked": N}
+         "skipped": [...], "checked": N, "topology": key}
 
     Only metrics present on BOTH sides are gated (a new span or a
     trace-less run is a skip, not a failure). Sub-resolution timing
     metrics are never hard failures (MIN_GATED_SECONDS-equivalent:
-    0.1 ms for ms-metrics, 100 µs for s-metrics)."""
-    if baseline.get("schema") != BASELINE_SCHEMA:
+    0.1 ms for ms-metrics, 100 µs for s-metrics). Raises ValueError
+    when the baseline has no entry for this topology — an ungated
+    topology point must fail loudly, not pass silently."""
+    key = topology_key(device_count, process_count)
+    entry = baseline_entry(baseline, device_count, process_count)
+    if entry is None:
+        have = ", ".join(sorted(baseline.get("topologies", {}))) \
+            or "none"
         raise ValueError(
-            f"baseline schema {baseline.get('schema')!r} != "
-            f"{BASELINE_SCHEMA} — re-capture the baseline")
-    base_metrics = baseline.get("metrics", {})
+            f"no baseline entry for topology {key} (have: {have}) — "
+            f"capture one with --write-baseline")
+    base_metrics = entry.get("metrics", {})
     regressions, improvements, skipped = [], [], []
     checked = 0
     for name in sorted(set(base_metrics) | set(metrics)):
@@ -179,11 +289,15 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
                 improvements.append(entry)
     return {"regressions": regressions,
             "improvements": improvements,
-            "skipped": skipped, "checked": checked}
+            "skipped": skipped, "checked": checked,
+            "topology": key}
 
 
 def render_verdict(verdict: Dict) -> str:
-    lines = [f"perf gate: {verdict['checked']} metric(s) checked, "
+    topo = verdict.get("topology")
+    lines = [f"perf gate"
+             f"{f' [{topo}]' if topo else ''}: "
+             f"{verdict['checked']} metric(s) checked, "
              f"{len(verdict['regressions'])} regression(s), "
              f"{len(verdict['improvements'])} improvement(s), "
              f"{len(verdict['skipped'])} skipped"]

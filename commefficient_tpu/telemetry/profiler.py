@@ -75,6 +75,14 @@ class trace_window:
                         trace_window_s=round(win, 6),
                         expected_round_s=tel.expected_round_s)
             except Exception as e:  # noqa: BLE001 — observability only
+                from commefficient_tpu.telemetry.alarms import \
+                    DivergenceAbort
+                if isinstance(e, DivergenceAbort):
+                    # a collective_skew alarm escalated to abort while
+                    # the buckets merged — that's the run policy
+                    # acting, not an attribution failure; let it stop
+                    # the trainer like any other abort
+                    raise
                 print("WARNING: trace attribution failed "
                       f"({type(e).__name__}: {e}); ledger emits "
                       "without device_time")

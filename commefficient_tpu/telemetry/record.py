@@ -49,17 +49,37 @@ Schema v3 adds one key to round records:
              analysis/cost.py) when a cost model was registered.
              compute + collective + transfer + host_gap == window by
              construction.
+
+Schema v4 is a fleet extension — no new required keys, two content
+changes:
+
+``device_time.per_device`` / ``device_time.skew`` — the aggregate
+             buckets gain nested per-device buckets ({busy, compute,
+             collective, transfer, wait, wire} per device lane; wait
+             + wire == collective exactly) and round-level collective
+             skew stats (max/p95 enter-delta seconds, straggler
+             device id, matched-collective count). These are the only
+             dict-valued entries allowed inside ``device_time``.
+``process`` — optional on every record: the jax process index that
+             observed it. Stamped by the per-process ledger shards
+             (``<ledger>.p<k>.jsonl``, telemetry/core.py) so merged
+             multi-host ledgers (scripts/ledger_merge.py) stay
+             attributable.
 """
 
 from __future__ import annotations
 
 from commefficient_tpu.telemetry import clock
 
-LEDGER_SCHEMA_VERSION = 3
+LEDGER_SCHEMA_VERSION = 4
 
-# versions validate_record accepts: v1 (pre-probe) and v2 (pre-trace)
-# ledgers stay readable by the report tooling
-READABLE_SCHEMA_VERSIONS = (1, 2, 3)
+# versions validate_record accepts: v1 (pre-probe), v2 (pre-trace) and
+# v3 (pre-fleet) ledgers stay readable by the report tooling
+READABLE_SCHEMA_VERSIONS = (1, 2, 3, 4)
+
+# device_time keys whose values are nested dicts (v4); every other
+# bucket value must be numeric
+DEVICE_TIME_DICT_KEYS = ("per_device", "skew")
 
 KINDS = ("meta", "round", "epoch", "bench", "summary")
 
@@ -170,9 +190,17 @@ def validate_record(rec) -> list:
         if dt is not None:
             if not isinstance(dt, dict):
                 problems.append("device_time is not a dict")
-            elif any(not isinstance(v, (int, float))
-                     for v in dt.values()):
-                problems.append("non-numeric device_time bucket")
+            else:
+                for k, v in dt.items():
+                    if k in DEVICE_TIME_DICT_KEYS:
+                        if not isinstance(v, dict):
+                            problems.append(
+                                f"device_time.{k} is not a dict")
+                    elif not isinstance(v, (int, float)):
+                        problems.append("non-numeric device_time bucket")
+    proc = rec.get("process")
+    if proc is not None and not isinstance(proc, int):
+        problems.append("process is non-integer")
     if kind == "bench":
         for key in ("metric", "value", "unit"):
             if key not in rec:

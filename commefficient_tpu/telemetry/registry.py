@@ -71,15 +71,36 @@ def _environment() -> dict:
     env = {"python": sys.version.split()[0]}
     try:
         import jax
+        from commefficient_tpu.parallel import mesh
+        topo = mesh.topology_summary()
         env["jax_version"] = jax.__version__
-        env["backend"] = jax.default_backend()
-        env["device_count"] = jax.device_count()
-        env["process_count"] = jax.process_count()
-        devs = jax.devices()
-        env["device_kind"] = devs[0].device_kind if devs else ""
+        env["backend"] = topo["backend"]
+        env["device_count"] = topo["device_count"]
+        env["process_count"] = topo["process_count"]
+        env["device_kind"] = topo["device_kind"]
     except Exception:
         pass
     return env
+
+
+def run_topology(manifest: dict) -> tuple:
+    """(device_count, process_count) of a run — the topology half of
+    the comparability key. Pre-fleet manifests that never recorded
+    the counts key as (None, None): they only ever compare against
+    each other, never silently against a counted run."""
+    dc = manifest.get("device_count")
+    pc = manifest.get("process_count")
+    return (int(dc) if dc is not None else None,
+            int(pc) if pc is not None else None)
+
+
+def run_key(manifest: dict) -> tuple:
+    """(config_hash, device_count, process_count): two runs are
+    comparable — diffable by the report, gateable against one
+    baseline entry — only when ALL three match. Config hash alone is
+    not an identity: the same config on 1 vs 8 devices is a scaling
+    experiment, not a regression."""
+    return (manifest.get("config_hash") or "",) + run_topology(manifest)
 
 
 def write_manifest(runs_dir: str = "runs", *, args=None,
@@ -103,6 +124,11 @@ def write_manifest(runs_dir: str = "runs", *, args=None,
                        if isinstance(mesh_shape, dict) else mesh_shape),
     }
     rec.update(_environment())
+    if rec.get("ledger") and (rec.get("process_count") or 1) > 1:
+        from commefficient_tpu.telemetry.sinks import shard_ledger_path
+        rec["ledger_shards"] = [
+            shard_ledger_path(rec["ledger"], k)
+            for k in range(1, rec["process_count"])]
     if extra:
         rec.update(extra)
     out_dir = os.path.join(runs_dir, MANIFEST_DIR)
@@ -166,14 +192,22 @@ def list_manifests(runs_dir: str = "runs") -> list:
     return out
 
 
-def latest_ledgers(runs_dir: str = "runs", n: int = 2) -> list:
+def latest_ledgers(runs_dir: str = "runs", n: int = 2,
+                   key: tuple = None) -> list:
     """The newest ``n`` manifests whose ledger file still exists,
-    newest FIRST: [(manifest_path, manifest, ledger_path), ...]."""
+    newest FIRST: [(manifest_path, manifest, ledger_path), ...].
+
+    ``key`` (a ``run_key`` tuple) restricts hits to comparable runs —
+    the report/gate pass the newest run's key so "latest vs previous"
+    never pairs different configs or topologies."""
     hits = []
     for path, rec in reversed(list_manifests(runs_dir)):
         ledger = rec.get("ledger") or ""
-        if ledger and os.path.exists(ledger):
-            hits.append((path, rec, ledger))
-            if len(hits) >= n:
-                break
+        if not (ledger and os.path.exists(ledger)):
+            continue
+        if key is not None and run_key(rec) != tuple(key):
+            continue
+        hits.append((path, rec, ledger))
+        if len(hits) >= n:
+            break
     return hits

@@ -17,17 +17,32 @@ from commefficient_tpu.telemetry.record import (make_bench_record,
                                                 make_summary_record)
 
 
+def shard_ledger_path(path: str, process_index: int) -> str:
+    """Per-process ledger path: process 0 owns the canonical ``path``;
+    process k writes the ``<path>.p<k>.jsonl`` shard that
+    ``scripts/ledger_merge.py`` joins back on round id. Namespacing by
+    process index means two processes pointed at the same ``--ledger``
+    can never interleave writes into one file."""
+    k = int(process_index)
+    return path if k == 0 else f"{path}.p{k}.jsonl"
+
+
 class JSONLSink:
     """One JSON object per line, appended to ``path``; flushed per
-    record (rounds are coarse enough that durability wins)."""
+    record (rounds are coarse enough that durability wins). When
+    ``process`` is given, every record is stamped with that jax
+    process index (multi-host shards stay attributable post-merge)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, process=None):
         self.path = path
+        self.process = None if process is None else int(process)
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._f = open(path, "a")
 
     def write(self, rec):
+        if self.process is not None:
+            rec = dict(rec, process=self.process)
         json.dump(rec, self._f, separators=(",", ":"),
                   default=_json_default)
         self._f.write("\n")

@@ -25,6 +25,21 @@ window: {compute, collective, h2d/d2h transfer, host-gap}, by interval
 union so nested/overlapping op events never double-count. Buckets sum
 to the round window by construction — the acceptance bar for the
 schema-v3 ``device_time`` ledger field.
+
+Schema v4 keeps each device lane's interval set instead of collapsing
+to one union: every round additionally carries
+``per_device[<device_id>]`` buckets ({busy, compute, collective,
+transfer} for that device alone) and a *skew decomposition* of the
+collective bucket. Matching collective events are aligned across
+device lanes (k-th in-window occurrence of each collective op name);
+a device's collective time then splits into **wait** (straggler skew:
+from this device entering the collective until the LAST device
+enters) and **wire** (the post-alignment transfer, ``collective -
+wait`` — exact by construction). Round-level skew stats (max/p95
+enter-delta, the straggler device id) land in ``device_time.skew``
+and feed the ``collective_skew`` alarm rule (telemetry/alarms.py).
+The cross-device aggregate buckets are computed from the pooled
+interval set exactly as in v3 — bit-for-bit unchanged.
 """
 
 from __future__ import annotations
@@ -33,6 +48,7 @@ import glob
 import gzip
 import json
 import os
+import re
 
 ROUND_MARKER = "fed_round"
 PHASE_PREFIX = "fed_phase"
@@ -163,21 +179,38 @@ def _lane_names(events):
     return procs, threads
 
 
-def device_lanes(events):
-    """(pid, tid) pairs whose events are device-side execution:
-    ``/device:*`` processes (TPU/GPU xplanes) or ``tf_XLA*`` runtime
-    threads (the CPU backend's per-device execution threads)."""
+def lane_devices(events):
+    """(pid, tid) -> device id for every device-side execution lane.
+
+    TPU/GPU xplanes expose one ``/device:<KIND>:<N>`` process per
+    device — every thread under it belongs to that device, so the id
+    is the process-name suffix (``TPU:0``). The CPU backend runs each
+    virtual device on a ``tf_XLA*`` runtime thread; each such thread
+    is its own lane, labelled ``cpu:<n>`` by the trailing integer of
+    the thread name (stable across a run, unlike raw tids)."""
     procs, threads = _lane_names(events)
-    lanes = set()
+    out = {}
     for e in events:
         if e.get("ph") != "X":
             continue
         key = (e.get("pid"), e.get("tid"))
+        if key in out:
+            continue
         pname = procs.get(key[0], "")
         tname = threads.get(key, "")
-        if pname.startswith("/device:") or tname.startswith("tf_XLA"):
-            lanes.add(key)
-    return lanes
+        if pname.startswith("/device:"):
+            out[key] = pname[len("/device:"):]
+        elif tname.startswith("tf_XLA"):
+            m = re.search(r"(\d+)$", tname)
+            out[key] = "cpu:%s" % (m.group(1) if m else key[1])
+    return out
+
+
+def device_lanes(events):
+    """(pid, tid) pairs whose events are device-side execution:
+    ``/device:*`` processes (TPU/GPU xplanes) or ``tf_XLA*`` runtime
+    threads (the CPU backend's per-device execution threads)."""
+    return set(lane_devices(events))
 
 
 # --- interval math -----------------------------------------------------
@@ -239,25 +272,114 @@ def _classify(name: str) -> str:
     return "compute"
 
 
+def _collective_groups(coll_by_dev, lo, hi):
+    """Align matching collective events across devices inside one
+    round window.
+
+    ``coll_by_dev``: device -> [(op_name, ts, end), ...]. Each
+    device's in-window occurrences of an op name are sorted by start;
+    the k-th occurrence on every device forms one *group* (the same
+    HLO collective executes once per participant, so equal names +
+    occurrence rank is the alignment key). Returns
+    ``[{device: (enter, exit)}, ...]`` with enters/exits clipped to
+    the window."""
+    per = {}
+    for dev, insts in coll_by_dev.items():
+        for name, ts, end in insts:
+            a, b = max(ts, lo), min(end, hi)
+            if b > a:
+                per.setdefault(name, {}).setdefault(dev, []).append((a, b))
+    groups = []
+    for name in sorted(per):
+        by_dev = per[name]
+        for occ in by_dev.values():
+            occ.sort()
+        depth = max(len(occ) for occ in by_dev.values())
+        for k in range(depth):
+            groups.append({d: occ[k]
+                           for d, occ in sorted(by_dev.items())
+                           if k < len(occ)})
+    return groups
+
+
+def _p95(values):
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    # nearest-rank: matches the ledger's other percentile fields
+    idx = max(0, int(round(0.95 * len(vals) + 0.5)) - 1)
+    return vals[min(idx, len(vals) - 1)]
+
+
+def _skew_stats(groups):
+    """Per-device wait intervals + round skew stats from the aligned
+    collective groups of one window.
+
+    For a group entered last at ``last_enter``, a device's *wait* is
+    ``[enter, min(last_enter, exit)]`` — the straggler-skew slice of
+    its collective time; the remainder is *wire*. Single-participant
+    groups contribute no wait (all wire). The straggler device is the
+    one that caused the most waiting: argmax over devices of the
+    summed enter-delta of the groups it entered last."""
+    wait_iv = {}
+    deltas, caused = [], {}
+    for g in groups:
+        if len(g) < 2:
+            continue
+        enters = {d: iv[0] for d, iv in g.items()}
+        last_enter = max(enters.values())
+        delta = last_enter - min(enters.values())
+        deltas.append(delta)
+        # deterministic straggler on ties: largest enter, then id
+        straggler = max(sorted(g), key=lambda d: (enters[d], d))
+        caused[straggler] = caused.get(straggler, 0.0) + delta
+        for d, (a, b) in g.items():
+            w = min(last_enter, b)
+            if w > a:
+                wait_iv.setdefault(d, []).append((a, w))
+    stats = {
+        "n_collectives": len(deltas),
+        "max_enter_delta_s": round(max(deltas) / 1e6, 9) if deltas else 0.0,
+        "p95_enter_delta_s": round(_p95(deltas) / 1e6, 9),
+        "straggler_device": (max(sorted(caused), key=lambda d: caused[d])
+                             if caused else None),
+    }
+    return wait_iv, stats
+
+
 def attribute_rounds(events) -> dict:
     """Per-round device-time buckets from one trace's events:
 
         {round_index: {"window_s", "busy_s", "compute_s",
-                       "collective_s", "transfer_s", "host_gap_s"}}
+                       "collective_s", "transfer_s", "host_gap_s",
+                       "per_device": {device_id: {...}},
+                       "skew": {...}}}
 
     ``busy`` is the union of all device-lane events clipped to the
     round window (parallel lanes don't double-count wall time);
     collective/transfer are the unions of the matching-named events;
     ``compute = busy - collective - transfer`` and ``host_gap =
     window - busy``, so the four buckets sum to the window exactly.
+    The aggregate buckets pool every lane's intervals — identical to
+    the schema-v3 computation bit-for-bit.
+
+    ``per_device[<id>]`` repeats the bucket math on that device's own
+    interval set and splits its collective bucket into ``wait_s``
+    (straggler skew, from the cross-device alignment of matching
+    collectives) and ``wire_s = collective_s - wait_s`` — an exact
+    partition by construction. ``skew`` carries the round-level stats
+    (max/p95 enter-delta, straggler device id, matched-group count).
     """
     wins = round_windows(events)
     if not wins:
         return {}
-    lanes = device_lanes(events)
+    lanes = lane_devices(events)
     dev, coll, xfer = [], [], []
+    by_dev = {}          # device -> {"dev": [...], "coll": [...], "xfer": [...]}
+    coll_insts = {}      # device -> [(op_name, ts, end), ...]
     for e in events:
-        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in lanes:
+        key = (e.get("pid"), e.get("tid"))
+        if e.get("ph") != "X" or key not in lanes:
             continue
         name = e.get("name", "")
         if name == ROUND_MARKER or name.startswith(PHASE_PREFIX):
@@ -265,12 +387,21 @@ def attribute_rounds(events) -> dict:
         ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
         iv = (ts, ts + dur)
         dev.append(iv)
+        d = lanes[key]
+        slot = by_dev.setdefault(d, {"dev": [], "coll": [], "xfer": []})
+        slot["dev"].append(iv)
         kind = _classify(name)
         if kind == "collective":
             coll.append(iv)
+            slot["coll"].append(iv)
+            coll_insts.setdefault(d, []).append((name, iv[0], iv[1]))
         elif kind == "transfer":
             xfer.append(iv)
+            slot["xfer"].append(iv)
     dev, coll, xfer = _union(dev), _union(coll), _union(xfer)
+    for slot in by_dev.values():
+        for k in slot:
+            slot[k] = _union(slot[k])
 
     out = {}
     for ridx, lo, hi in wins:
@@ -283,7 +414,7 @@ def attribute_rounds(events) -> dict:
         # (disjoint buckets: the four sum to the window)
         xfer_us = _measure(_union(t + c)) - coll_us
         win_us = hi - lo
-        out[ridx] = {
+        buckets = {
             "window_s": round(win_us / 1e6, 6),
             "busy_s": round(busy_us / 1e6, 6),
             "compute_s": round((busy_us - coll_us - xfer_us) / 1e6, 6),
@@ -291,6 +422,33 @@ def attribute_rounds(events) -> dict:
             "transfer_s": round(xfer_us / 1e6, 6),
             "host_gap_s": round((win_us - busy_us) / 1e6, 6),
         }
+        groups = _collective_groups(coll_insts, lo, hi)
+        wait_iv, skew = _skew_stats(groups)
+        per_device = {}
+        for d in sorted(by_dev):
+            slot = by_dev[d]
+            d_busy_us = _measure(_union(_clip(slot["dev"], lo, hi)))
+            d_c = _union(_clip(slot["coll"], lo, hi))
+            d_t = _union(_clip(slot["xfer"], lo, hi))
+            d_coll_us = _measure(d_c)
+            d_xfer_us = _measure(_union(list(d_t) + list(d_c))) - d_coll_us
+            d_wait_us = _measure(_union(_clip(wait_iv.get(d, ()), lo, hi)))
+            coll_s = round(d_coll_us / 1e6, 6)
+            wait_s = round(min(d_wait_us, d_coll_us) / 1e6, 6)
+            per_device[d] = {
+                "busy_s": round(d_busy_us / 1e6, 6),
+                "compute_s": round(
+                    (d_busy_us - d_coll_us - d_xfer_us) / 1e6, 6),
+                "collective_s": coll_s,
+                "transfer_s": round(d_xfer_us / 1e6, 6),
+                "wait_s": wait_s,
+                # difference of two 6-dp values: wait + wire ==
+                # collective holds exactly, not just to tolerance
+                "wire_s": round(coll_s - wait_s, 6),
+            }
+        buckets["per_device"] = per_device
+        buckets["skew"] = skew
+        out[ridx] = buckets
     return out
 
 

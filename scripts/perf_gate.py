@@ -10,13 +10,23 @@
                                                  # run
 
 The committed baseline pins median + MAD per metric (host-span times,
-schema-v3 device-time buckets, bench clients/s); ``--check`` fails —
-exit 1 — only outside a noise band of ``max(rel_tol x median, k x
-MAD)`` (telemetry/gate.py), so relay jitter passes and a real
-regression cannot. ``--write-baseline`` over an existing baseline
-first gates the new run against it and REFUSES to re-baseline over a
-hard regression (``--force`` overrides, for intentional trade-offs —
-the diff of perf_baseline.json is then the reviewable artifact).
+schema-v3/v4 device-time buckets and skew stats, bench clients/s);
+``--check`` fails — exit 1 — only outside a noise band of
+``max(rel_tol x median, k x MAD)`` (telemetry/gate.py), so relay
+jitter passes and a real regression cannot. ``--write-baseline`` over
+an existing baseline first gates the new run against it and REFUSES
+to re-baseline over a hard regression (``--force`` overrides, for
+intentional trade-offs — the diff of perf_baseline.json is then the
+reviewable artifact).
+
+Baselines are **topology-keyed** (gate schema 2): the run's
+``(device_count, process_count)`` — from its manifest, its ledger
+meta record, or ``--device_count``/``--process_count`` — selects
+which baseline entry gates it, and ``--write-baseline`` replaces ONLY
+that entry. An 8-device run can therefore never be "compared" against
+the single-chip baseline (the historical mis-comparison), and each
+scaling-curve point (scripts/scaling_bench.py) is guarded
+independently. Runs with unknown topology bucket under ``any``.
 
 Pure host-side JSON work: no jax import, safe as a tier-1 CPU smoke.
 """
@@ -54,6 +64,33 @@ def load_ledger_records(path):
     return records
 
 
+def resolve_topology(manifest=None, records=(), device_count=None,
+                     process_count=None):
+    """The run's (device_count, process_count) for baseline keying:
+    CLI overrides win, then the run manifest, then the ledger's meta
+    record (``num_devices``; pre-fleet metas never recorded a process
+    count — those ran the single-process path, so 1). (None, None)
+    when nothing knows — such runs gate under the ``any`` bucket."""
+    dc, pc = device_count, process_count
+    if manifest is not None:
+        mdc, mpc = registry.run_topology(manifest)
+        dc = mdc if dc is None else dc
+        pc = mpc if pc is None else pc
+    if dc is None or pc is None:
+        for rec in records:
+            if rec.get("kind") != "meta":
+                continue
+            if dc is None and rec.get("num_devices") is not None:
+                dc = int(rec["num_devices"])
+                if pc is None:
+                    pc = int(rec.get("process_count") or 1)
+            elif pc is None and rec.get("process_count") is not None:
+                pc = int(rec["process_count"])
+            if dc is not None and pc is not None:
+                break
+    return dc, pc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="perf regression gate over telemetry ledgers")
@@ -85,9 +122,17 @@ def main(argv=None):
     ap.add_argument("--json", default=None,
                     help="dump the verdict (or captured metrics) to "
                          "this path")
+    ap.add_argument("--device_count", type=int, default=None,
+                    help="override the run's device count for "
+                         "baseline keying (normally read from the "
+                         "manifest / ledger meta)")
+    ap.add_argument("--process_count", type=int, default=None,
+                    help="override the run's process count for "
+                         "baseline keying")
     args = ap.parse_args(argv)
 
     ledger = args.ledger
+    manifest = None
     if ledger is None and args.runs_dir:
         hits = registry.latest_ledgers(args.runs_dir, n=1)
         if not hits:
@@ -95,8 +140,10 @@ def main(argv=None):
                   f"{args.runs_dir}")
             return 1
         mpath, manifest, ledger = hits[0]
+        dc, pc = registry.run_topology(manifest)
         print(f"run: {mpath} (config {manifest.get('config_hash', '')[:8]}, "
-              f"git {manifest.get('git_sha', '')[:8]}) -> {ledger}")
+              f"git {manifest.get('git_sha', '')[:8]}, "
+              f"topology {gate.topology_key(dc, pc)}) -> {ledger}")
     if ledger is None:
         ap.error("one of --ledger / --runs_dir is required")
 
@@ -105,9 +152,15 @@ def main(argv=None):
     if not metrics:
         print(f"{ledger}: no gateable metrics (empty ledger?)")
         return 1
-    print(f"{ledger}: {len(metrics)} metric(s) extracted")
+    dc, pc = resolve_topology(manifest, records,
+                              args.device_count, args.process_count)
+    topo = gate.topology_key(dc, pc)
+    print(f"{ledger}: {len(metrics)} metric(s) extracted "
+          f"(topology {topo})")
+    chash = (manifest or {}).get("config_hash", "")
 
     verdict = None
+    existing = None
     if args.check or (args.write_baseline
                       and os.path.exists(args.baseline)
                       and not args.force):
@@ -115,11 +168,30 @@ def main(argv=None):
             print(f"baseline {args.baseline} missing — capture one "
                   "with --write-baseline first")
             return 1
-        baseline = gate.load_baseline(args.baseline)
-        verdict = gate.compare(baseline, metrics,
-                               rel_tol=args.rel_tol,
-                               mad_k=args.mad_k)
-        print(gate.render_verdict(verdict))
+        existing = gate.load_baseline(args.baseline)
+        entry = gate.baseline_entry(existing, dc, pc)
+        if entry is None and args.write_baseline and not args.check:
+            # first capture of a NEW topology point: nothing to gate
+            # this run against, other points stay untouched
+            print(f"baseline has no {topo} entry yet — capturing it")
+        elif entry is None:
+            print(f"perf gate: FAIL — baseline {args.baseline} has "
+                  f"no {topo} entry (this topology point is ungated; "
+                  "capture one with --write-baseline)")
+            return 1
+        else:
+            if entry is not None and chash and \
+                    entry.get("config_hash") and \
+                    entry["config_hash"] != chash:
+                print(f"WARNING: baseline {topo} entry was captured "
+                      f"from config {entry['config_hash'][:8]}, run "
+                      f"is {chash[:8]} — metrics may not be "
+                      "comparable")
+            verdict = gate.compare(existing, metrics,
+                                   rel_tol=args.rel_tol,
+                                   mad_k=args.mad_k,
+                                   device_count=dc, process_count=pc)
+            print(gate.render_verdict(verdict))
 
     if args.write_baseline:
         if verdict and verdict["regressions"] and not args.force:
@@ -128,10 +200,15 @@ def main(argv=None):
                   "vs the existing baseline — fix them or pass "
                   "--force for an intentional trade-off")
             return 1
+        if existing is None and os.path.exists(args.write_baseline):
+            existing = gate.load_baseline(args.write_baseline)
         gate.save_baseline(
-            gate.make_baseline(metrics, source=os.path.abspath(ledger)),
+            gate.update_baseline(existing or {}, metrics,
+                                 source=os.path.abspath(ledger),
+                                 device_count=dc, process_count=pc,
+                                 config_hash=chash),
             args.write_baseline)
-        print(f"baseline -> {args.write_baseline}")
+        print(f"baseline[{topo}] -> {args.write_baseline}")
 
     if args.json:
         with open(args.json, "w") as f:

@@ -1,0 +1,323 @@
+"""Topology scaling curves: one config swept across device counts.
+
+    python scripts/scaling_bench.py                    # 1 -> 2 -> 4
+    python scripts/scaling_bench.py --device_counts 1,2,4,8 \
+        --rounds 8 --runs_dir runs
+    python scripts/scaling_bench.py --multihost        # adds a
+                                                       # 2-process point
+
+Each point runs the SAME small FetchSGD round workload (so every
+manifest shares one config hash) in a fresh subprocess pinned to N
+virtual CPU devices (``--xla_force_host_platform_device_count`` — the
+device count is frozen at backend init, hence one process per point;
+on a real pod, run the worker once per slice topology instead). Every
+point is profiled, so its ledger carries schema-v4 per-device buckets
+and collective-skew stats, and writes one run-registry manifest with a
+top-level ``scaling`` block:
+
+    {"device_count", "process_count", "clients_per_s",
+     "parallel_efficiency", "collective_fraction", "max_skew_s"}
+
+``parallel_efficiency`` is per-device throughput relative to the
+smallest point ((tput_N / N) / (tput_ref / N_ref)): 1.0 is linear
+scaling, the gap to 1.0 is what the collective fraction + skew columns
+explain. ``scripts/telemetry_report.py --runs_dir runs`` renders the
+curve; ``scripts/perf_gate.py`` gates each point against its own
+topology-keyed baseline entry.
+
+``--multihost`` appends a 2-process point via the
+scripts/multihost_smoke.py launcher pattern (free-port coordinator,
+``jax.distributed.initialize`` per worker): process 0 writes the
+canonical ledger + manifest, process 1 writes a ledger shard, and the
+parent merges them with scripts/ledger_merge.py — the end-to-end
+fleet-observatory path on one machine.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+POINT_TAG = "SCALING_POINT "
+
+# one shared workload geometry: 8 workers so every device count in
+# {1, 2, 4, 8} divides it, tiny dense model + sketch so a point is
+# seconds, not minutes, on CPU
+W, B, DIM, ROUNDS_DEFAULT = 8, 4, 32, 5
+
+
+def worker(args):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if args.coordinator:
+        jax.distributed.initialize(args.coordinator,
+                                   num_processes=args.num_processes,
+                                   process_id=args.process_id)
+    assert jax.device_count() == args.devices, \
+        (jax.device_count(), args.devices)
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.runtime import FedModel, FedOptimizer
+    from commefficient_tpu.telemetry import clock, registry
+    from commefficient_tpu.telemetry.profiler import trace_window
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(64, use_bias=False)(x)
+
+    module = Lin()
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, DIM)))["params"]
+    cfg = Config(mode="sketch", error_type="virtual",
+                 local_momentum=0.0, virtual_momentum=0.9,
+                 num_workers=W, local_batch_size=B,
+                 num_clients=W * 2, dataset_name="CIFAR10", seed=0,
+                 k=16, num_rows=3, num_cols=256)
+    cfg.ledger = args.ledger
+    cfg.do_profile = True
+
+    def loss(p, batch, _cfg):
+        pred = module.apply({"params": p}, batch["x"])
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        return jnp.sum(pred ** 2 * batch["mask"][..., None]) / n, ()
+
+    model = FedModel(module, params, loss, cfg, padded_batch_size=B)
+    opt = FedOptimizer([{"lr": 0.1}], cfg)
+    rng = np.random.RandomState(0)  # same seed on every process: SPMD
+
+    def mk(r):
+        return {"x": rng.randn(W, B, DIM).astype(np.float32),
+                "y": rng.randn(W, B).astype(np.float32),
+                "mask": np.ones((W, B), np.float32),
+                "client_ids": np.arange(r, r + W, dtype=np.int32)
+                % (W * 2)}
+
+    model(mk(0))  # round 0 outside the window: compile/warmup
+    opt.step()
+    logdir = os.path.join(tempfile.mkdtemp(prefix="scaling_"), "trace")
+    with trace_window(logdir, telemetry=model.telemetry):
+        t0 = clock.tick()
+        for r in range(1, args.rounds + 1):
+            model(mk(r))
+            opt.step()
+        jax.block_until_ready(model.ps_weights)
+        dt = clock.tick() - t0
+    model.finalize()
+
+    if jax.process_index() != 0:
+        return 0
+
+    clients_per_s = W * args.rounds / dt
+    # parallel efficiency vs the reference (smallest) point: how much
+    # of each added device's throughput the topology actually keeps
+    if args.ref_clients_per_s > 0:
+        eff = ((clients_per_s / args.devices)
+               / (args.ref_clients_per_s / args.ref_devices))
+    else:
+        eff = 1.0
+
+    # the ledger this run just wrote explains the curve: collective
+    # fraction of the round window + worst straggler skew
+    coll_fracs, skews = [], []
+    with open(args.ledger) as f:
+        for line in f:
+            rec = json.loads(line)
+            dt_rec = rec.get("device_time") if rec.get(
+                "kind") == "round" else None
+            if not dt_rec:
+                continue
+            if dt_rec.get("window_s"):
+                coll_fracs.append(dt_rec.get("collective_s", 0.0)
+                                  / dt_rec["window_s"])
+            skew = dt_rec.get("skew") or {}
+            if skew.get("max_enter_delta_s") is not None:
+                skews.append(skew["max_enter_delta_s"])
+    point = {
+        "device_count": int(jax.device_count()),
+        "process_count": int(jax.process_count()),
+        "clients_per_s": round(clients_per_s, 2),
+        "parallel_efficiency": round(eff, 3),
+        "collective_fraction": round(
+            sum(coll_fracs) / len(coll_fracs), 4) if coll_fracs
+        else 0.0,
+        "max_skew_s": round(max(skews), 6) if skews else 0.0,
+    }
+    manifest = registry.write_manifest(
+        args.runs_dir, args=cfg, ledger=args.ledger,
+        bench={"clients_per_s": {"value": point["clients_per_s"],
+                                 "unit": "clients/s"}},
+        extra={"scaling": point})
+    print(POINT_TAG + json.dumps(point), flush=True)
+    print(f"manifest -> {manifest}", file=sys.stderr)
+    return 0
+
+
+def _run_point(n, args, ref, stamp, extra_cmd=(), extra_env=None,
+               nproc=1):
+    """Spawn worker subprocess(es) for one topology point; returns
+    (point dict, ledger path) or raises RuntimeError."""
+    os.makedirs(os.path.join(args.runs_dir, "scaling"), exist_ok=True)
+    ledger = os.path.join(args.runs_dir, "scaling",
+                          f"scale_{stamp}_d{n}p{nproc}.jsonl")
+    dpp = n // nproc
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           "--devices", str(n), "--rounds", str(args.rounds),
+           "--runs_dir", args.runs_dir, "--ledger", ledger]
+    if ref is not None:
+        cmd += ["--ref_clients_per_s", str(ref[0]),
+                "--ref_devices", str(ref[1])]
+    cmd += list(extra_cmd)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count="
+                         f"{dpp}",
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.update(extra_env or {})
+    if nproc == 1:
+        out = subprocess.run(cmd, env=env, capture_output=True,
+                             text=True, timeout=args.timeout)
+        outs, codes = [out.stdout + out.stderr], [out.returncode]
+    else:
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs, logs = [], []
+        for i in range(nproc):
+            log = tempfile.TemporaryFile(mode="w+")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                cmd + ["--process_id", str(i),
+                       "--num_processes", str(nproc),
+                       "--coordinator", f"localhost:{port}"],
+                env=env, stdout=log, stderr=subprocess.STDOUT))
+        deadline = time.time() + args.timeout
+        while any(p.poll() is None for p in procs) \
+                and time.time() < deadline:
+            # a dead coordinator hangs its partner in
+            # jax.distributed.initialize: kill the survivors
+            if any(p.poll() not in (None, 0) for p in procs):
+                break
+            time.sleep(0.5)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=60)
+        outs = []
+        for log in logs:
+            log.seek(0)
+            outs.append(log.read())
+            log.close()
+        codes = [p.returncode for p in procs]
+    if nproc > 1 and any(
+            "Multiprocess computations aren't implemented" in out
+            for out in outs):
+        # this jaxlib's CPU backend cannot run cross-process
+        # computations (same limitation hits
+        # scripts/multihost_smoke.py) — skip the point instead of
+        # failing so the single-process curve still lands
+        return None, ledger
+    point = None
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith(POINT_TAG):
+                point = json.loads(line[len(POINT_TAG):])
+    if any(codes) or point is None:
+        for i, out in enumerate(outs):
+            sys.stderr.write(f"--- point d{n}p{nproc} worker {i} "
+                             f"(exit {codes[i]}) ---\n")
+            sys.stderr.write(out[-4000:] + "\n")
+        raise RuntimeError(f"scaling point d{n}p{nproc} failed")
+    return point, ledger
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="sweep one config across device counts; one "
+                    "registry manifest per topology point")
+    ap.add_argument("--device_counts", default="1,2,4",
+                    help="comma-separated single-process points "
+                         "(default 1,2,4; each must divide "
+                         f"{W} workers)")
+    ap.add_argument("--rounds", type=int, default=ROUNDS_DEFAULT)
+    ap.add_argument("--runs_dir", default="runs")
+    ap.add_argument("--multihost", action="store_true",
+                    help="append a 2-process point (2 devices per "
+                         "process) and merge its ledger shards")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="per-point subprocess timeout, seconds")
+    # worker-mode flags (spawned internally)
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--devices", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--ledger", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--ref_clients_per_s", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--ref_devices", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--process_id", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--num_processes", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--coordinator", default=None,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        return worker(args)
+
+    counts = sorted({int(x) for x in args.device_counts.split(",")})
+    for n in counts:
+        if W % n:
+            ap.error(f"device count {n} does not divide {W} workers")
+    stamp = int(time.time())
+    points, ref = [], None
+    for n in counts:
+        point, _ = _run_point(n, args, ref, stamp)
+        if ref is None:
+            ref = (point["clients_per_s"], n)
+        points.append(point)
+        print(f"d{n}p1: {point['clients_per_s']} clients/s, "
+              f"eff {point['parallel_efficiency']:.2f}, "
+              f"collective {point['collective_fraction'] * 100:.1f}%, "
+              f"skew max {point['max_skew_s']} s", flush=True)
+
+    if args.multihost:
+        point, ledger = _run_point(4, args, ref, stamp, nproc=2)
+        if point is None:
+            print("d4p2: SKIP (CPU backend lacks multiprocess "
+                  "computations)", flush=True)
+        else:
+            points.append(point)
+            print(f"d4p2: {point['clients_per_s']} clients/s, "
+                  f"eff {point['parallel_efficiency']:.2f}, "
+                  f"collective "
+                  f"{point['collective_fraction'] * 100:.1f}%, "
+                  f"skew max {point['max_skew_s']} s", flush=True)
+            merge = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "ledger_merge.py")
+            subprocess.run([sys.executable, merge, ledger],
+                           check=True)
+
+    print(f"{len(points)} scaling point(s) registered under "
+          f"{args.runs_dir} — render the curve with:\n"
+          f"  python scripts/telemetry_report.py --runs_dir "
+          f"{args.runs_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

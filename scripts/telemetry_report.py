@@ -11,14 +11,22 @@
 
     python scripts/telemetry_report.py --runs_dir runs
         registry mode: list recent manifest-registered runs
-        (telemetry/registry.py), summarize the latest run's ledger and
-        diff it against the previous one — no hand-typed paths
+        (telemetry/registry.py), summarize the latest run's ledger,
+        diff it against the previous COMPARABLE run (same config hash
+        AND same (device_count, process_count) topology — an 8-device
+        run never diffs against a single-chip one), and render any
+        scaling curves (scripts/scaling_bench.py sweeps) found in the
+        registry — no hand-typed paths
 
 Schema-v3 ledgers additionally render the trace-derived device-time
 breakdown (compute / collective / transfer / host-gap per round) and
-the roofline expectation next to the host-span percentiles.
-``--json`` prints the summary (or diff) as one JSON object instead of
-text. Invalid records are reported but don't abort the render.
+the roofline expectation next to the host-span percentiles. Schema-v4
+ledgers add per-device lanes (busy/collective/wait/wire per device),
+round collective-skew stats, and — for merged multi-host ledgers
+(scripts/ledger_merge.py) — per-process shard summaries with each
+host's gap. ``--json`` prints the summary (or diff) as one JSON
+object instead of text. Invalid records are reported but don't abort
+the render.
 """
 
 from __future__ import annotations
@@ -73,15 +81,59 @@ def summarize(records) -> dict:
     probe_vals = {}          # probe key -> [(round, value), ...]
     alarm_rounds = []        # [{"round": r, "alarms": [...]}, ...]
     device_vals = {}         # v3 device-time bucket -> [seconds, ...]
+    lane_vals = {}           # v4: device id -> bucket -> [seconds]
+    skew_vals = {}           # v4: skew stat -> [seconds, ...]
+    stragglers = {}          # v4: device id -> straggler-round count
+    shard_vals = {}          # merged ledgers: "p<k>" -> aggregates
     uplink = downlink = 0.0
     rss_peak = hbm_peak = None
     for r in rounds:
         for name, secs in r["spans"].items():
             span_vals.setdefault(name, []).append(float(secs))
         # v3-only: trace-derived device-time buckets
-        for name, val in (r.get("device_time") or {}).items():
+        dt = r.get("device_time") or {}
+        for name, val in dt.items():
             if isinstance(val, (int, float)):
                 device_vals.setdefault(name, []).append(float(val))
+        # v4-only: per-device lanes + collective-skew stats
+        pd = dt.get("per_device")
+        if isinstance(pd, dict):
+            for dev, buckets in pd.items():
+                slot = lane_vals.setdefault(dev, {})
+                for bname, bval in (buckets or {}).items():
+                    if isinstance(bval, (int, float)):
+                        slot.setdefault(bname, []).append(float(bval))
+        skew = dt.get("skew")
+        if isinstance(skew, dict):
+            for sname in ("max_enter_delta_s", "p95_enter_delta_s"):
+                sval = skew.get(sname)
+                if isinstance(sval, (int, float)):
+                    skew_vals.setdefault(sname, []).append(float(sval))
+            dev = skew.get("straggler_device")
+            if dev:
+                stragglers[dev] = stragglers.get(dev, 0) + 1
+        # merged multi-host ledgers: per-process shard data joined
+        # onto the canonical round record (scripts/ledger_merge.py)
+        shards = r.get("shards")
+        if isinstance(shards, dict):
+            for pk, sh in sorted(shards.items()):
+                if not isinstance(sh, dict):
+                    continue
+                entry = shard_vals.setdefault(
+                    pk, {"rounds": 0, "span_total_s": 0.0,
+                         "host_gap_s": [], "rss_peak": None})
+                entry["rounds"] += 1
+                entry["span_total_s"] += sum(
+                    float(v) for v in (sh.get("spans") or {}).values()
+                    if isinstance(v, (int, float)))
+                hg = sh.get("host_gap_s")
+                if isinstance(hg, (int, float)):
+                    entry["host_gap_s"].append(float(hg))
+                rss = sh.get("host_rss_peak_bytes")
+                if isinstance(rss, (int, float)) and \
+                        (entry["rss_peak"] is None
+                         or rss > entry["rss_peak"]):
+                    entry["rss_peak"] = rss
         for name, n in r["counters"].items():
             counters[name] = counters.get(name, 0) + n
         uplink += r.get("uplink_bytes") or 0.0
@@ -133,6 +185,29 @@ def summarize(records) -> dict:
                 "mean_ms": round(1e3 * sum(sv) / len(sv), 3),
                 "p50_ms": round(1e3 * _pct(sv, 50), 3),
                 "p95_ms": round(1e3 * _pct(sv, 95), 3)}
+    per_device = {}
+    for dev, buckets in sorted(lane_vals.items()):
+        per_device[dev] = {
+            bname: round(1e3 * sum(vals) / len(vals), 3)
+            for bname, vals in sorted(buckets.items())}
+    collective_skew = None
+    if skew_vals:
+        collective_skew = {"stragglers": dict(sorted(
+            stragglers.items()))}
+        for sname, vals in sorted(skew_vals.items()):
+            collective_skew[sname] = {
+                "mean_ms": round(1e3 * sum(vals) / len(vals), 6),
+                "max_ms": round(1e3 * max(vals), 6),
+                "n": len(vals)}
+    shards = {}
+    for pk, entry in sorted(shard_vals.items()):
+        hg = entry["host_gap_s"]
+        shards[pk] = {
+            "rounds": entry["rounds"],
+            "span_total_s": round(entry["span_total_s"], 4),
+            "host_gap_mean_ms": (round(1e3 * sum(hg) / len(hg), 3)
+                                 if hg else None),
+            "host_rss_peak_bytes": entry["rss_peak"]}
     return {
         "meta": next((r for r in records if r["kind"] == "meta"),
                      None),
@@ -141,6 +216,9 @@ def summarize(records) -> dict:
         "downlink_bytes": downlink,
         "spans": spans,
         "device_time": device_time,
+        "per_device": per_device,
+        "collective_skew": collective_skew,
+        "shards": shards,
         "cost_model": next(
             (r.get("cost_model") for r in records
              if r["kind"] == "meta" and r.get("cost_model")), None),
@@ -196,6 +274,29 @@ def render_summary(s, label="") -> str:
             lines.append(f"  device {name}: mean {v['mean_ms']} "
                          f"ms/round (p50 {v['p50_ms']}, "
                          f"p95 {v['p95_ms']}, {v['n']} rounds)")
+    for dev, buckets in s.get("per_device", {}).items():
+        bits = ", ".join(f"{b.replace('_s', '')} {v} ms"
+                         for b, v in buckets.items())
+        lines.append(f"  lane {dev}: {bits} (means/round)")
+    csk = s.get("collective_skew")
+    if csk:
+        mx = csk.get("max_enter_delta_s") or {}
+        p95 = csk.get("p95_enter_delta_s") or {}
+        lines.append(
+            f"  collective skew: enter-delta mean "
+            f"{mx.get('mean_ms')} ms, max {mx.get('max_ms')} ms "
+            f"(p95-stat mean {p95.get('mean_ms')} ms, "
+            f"{mx.get('n')} rounds)")
+        if csk.get("stragglers"):
+            lines.append(
+                f"  stragglers (rounds led): {csk['stragglers']}")
+    for pk, sh in s.get("shards", {}).items():
+        gap = (f", host-gap mean {sh['host_gap_mean_ms']} ms"
+               if sh.get("host_gap_mean_ms") is not None else "")
+        rss = (f", RSS peak {_mib(sh['host_rss_peak_bytes'])}"
+               if sh.get("host_rss_peak_bytes") is not None else "")
+        lines.append(f"  shard {pk}: {sh['rounds']} rounds, spans "
+                     f"total {sh['span_total_s']} s{gap}{rss}")
     cm = s.get("cost_model")
     if cm:
         lines.append(
@@ -316,9 +417,67 @@ def render_diff(d, label_a, label_b) -> str:
     return "\n".join(lines)
 
 
+def scaling_curves(manifests) -> list:
+    """Scaling-curve points from the registry: manifests carrying a
+    ``scaling`` dict (scripts/scaling_bench.py) grouped by config
+    hash, newest manifest per topology point, sorted by device count.
+    Only groups with >= 2 distinct points form a curve."""
+    from commefficient_tpu.telemetry import registry
+
+    groups = {}
+    for path, rec in manifests:             # oldest first
+        if not isinstance(rec.get("scaling"), dict):
+            continue
+        by_topo = groups.setdefault(rec.get("config_hash", ""), {})
+        by_topo[registry.run_topology(rec)] = (path, rec)
+    curves = []
+    for chash, by_topo in sorted(groups.items()):
+        if len(by_topo) < 2:
+            continue
+        points = []
+        for (dc, pc), (path, rec) in sorted(
+                by_topo.items(),
+                key=lambda kv: (kv[0][0] or 0, kv[0][1] or 0)):
+            sc = rec["scaling"]
+            points.append({
+                "device_count": dc, "process_count": pc,
+                "clients_per_s": sc.get("clients_per_s"),
+                "parallel_efficiency": sc.get("parallel_efficiency"),
+                "collective_fraction": sc.get("collective_fraction"),
+                "max_skew_s": sc.get("max_skew_s"),
+                "manifest": path})
+        curves.append({"config_hash": chash, "points": points})
+    return curves
+
+
+def render_scaling_curves(curves) -> str:
+    lines = []
+    for curve in curves:
+        lines.append(f"== scaling curve (config "
+                     f"{curve['config_hash'][:8] or '????????'}, "
+                     f"{len(curve['points'])} points) ==")
+        for p in curve["points"]:
+            dc = p["device_count"]
+            pc = p["process_count"]
+            bits = [f"{p['clients_per_s']:.6g} clients/s"
+                    if isinstance(p["clients_per_s"], (int, float))
+                    else "clients/s ?"]
+            if isinstance(p["parallel_efficiency"], (int, float)):
+                bits.append(f"eff {p['parallel_efficiency']:.3f}")
+            if isinstance(p["collective_fraction"], (int, float)):
+                bits.append(
+                    f"collective {100 * p['collective_fraction']:.1f}%")
+            if isinstance(p["max_skew_s"], (int, float)):
+                bits.append(f"skew max {p['max_skew_s']:.6g} s")
+            lines.append(f"  d{dc}p{pc}: " + ", ".join(bits))
+    return "\n".join(lines)
+
+
 def runs_dir_report(runs_dir: str, as_json: bool) -> int:
     """Registry mode: list the recent manifest-registered runs, render
-    the latest run's ledger, and diff it against the previous one."""
+    the latest run's ledger, diff it against the previous COMPARABLE
+    one (same config hash + topology; registry.run_key), and render
+    any scaling curves the registry holds."""
     from commefficient_tpu.telemetry import registry
 
     manifests = registry.list_manifests(runs_dir)
@@ -334,36 +493,54 @@ def runs_dir_report(runs_dir: str, as_json: bool) -> int:
                 (f"{m}: {v.get('value')} {v.get('unit', '')}"
                  for m, v in bench.items()
                  if isinstance(v, dict)), "")
+            dc, pc = registry.run_topology(rec)
+            topo = (f"d{dc}p{pc}" if dc is not None and pc is not None
+                    else "d?p?")
             print(f"  {os.path.basename(path)}: "
                   f"git {rec.get('git_sha', '')[:8]}, "
                   f"config {rec.get('config_hash', '')[:8]}, "
-                  f"backend {rec.get('backend', '?')}"
+                  f"backend {rec.get('backend', '?')}, {topo}"
                   + (f", {headline}" if headline else ""))
-    hits = registry.latest_ledgers(runs_dir, n=2)
+    curves = scaling_curves(manifests)
+    hits = registry.latest_ledgers(runs_dir, n=1)
     if not hits:
         print("no manifest points at an existing ledger file")
         return 1
-    _, _, latest = hits[0]
+    _, latest_manifest, latest = hits[0]
     records, problems = load_ledger(latest)
     for p in problems:
         print(f"WARNING {latest}: {p}", file=sys.stderr)
     summ = summarize(records)
-    if len(hits) < 2:
+    # previous COMPARABLE run only: same config hash AND topology —
+    # pairing the newest two manifests regardless of device count
+    # made an 8-device run "regress" against a single-chip baseline
+    key = registry.run_key(latest_manifest)
+    prev_hits = registry.latest_ledgers(runs_dir, n=2, key=key)
+    prev = prev_hits[1][2] if len(prev_hits) > 1 else None
+    if prev is None:
         if as_json:
-            print(json.dumps(summ))
+            print(json.dumps({"latest": summ,
+                              "scaling_curves": curves}))
         else:
             print(render_summary(summ, label=latest))
+            if not len(prev_hits) > 1:
+                print("(no previous run with this config+topology "
+                      "to diff against)")
+            if curves:
+                print(render_scaling_curves(curves))
         return 0
-    _, _, prev = hits[1]
     records_p, problems_p = load_ledger(prev)
     for p in problems_p:
         print(f"WARNING {prev}: {p}", file=sys.stderr)
     d = diff_summaries(summarize(records_p), summ)
     if as_json:
-        print(json.dumps({"latest": summ, "diff_vs_previous": d}))
+        print(json.dumps({"latest": summ, "diff_vs_previous": d,
+                          "scaling_curves": curves}))
     else:
         print(render_summary(summ, label=latest))
         print(render_diff(d, prev, latest))
+        if curves:
+            print(render_scaling_curves(curves))
     return 0
 
 

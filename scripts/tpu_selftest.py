@@ -257,6 +257,46 @@ def trace_smoke():
         shutil.rmtree(logdir, ignore_errors=True)
 
 
+def scaling_smoke():
+    """Two-point CPU scaling sweep straight through the run registry:
+    scripts/scaling_bench.py must register one manifest per topology
+    point (distinct (device_count, process_count) keys, shared config
+    hash) with a ``scaling`` block the report can render as a curve.
+    Pinned to the virtual CPU mesh on purpose — the registry/manifest
+    plumbing is backend-independent, and the real-TPU throughput
+    points come from running scaling_bench against the pod itself."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from commefficient_tpu.telemetry import registry
+
+    runs_dir = tempfile.mkdtemp(prefix="scaling_smoke_")
+    try:
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scaling_bench.py")
+        out = subprocess.run(
+            [sys.executable, script, "--device_counts", "1,2",
+             "--rounds", "3", "--runs_dir", runs_dir],
+            capture_output=True, text=True, timeout=560)
+        assert out.returncode == 0, out.stdout + out.stderr
+        manifests = registry.list_manifests(runs_dir)
+        topos = sorted(registry.run_topology(m) for _, m in manifests)
+        assert topos == [(1, 1), (2, 1)], topos
+        hashes = {m.get("config_hash") for _, m in manifests}
+        assert len(hashes) == 1, hashes
+        for _, m in manifests:
+            sc = m.get("scaling")
+            assert sc and sc["clients_per_s"] > 0, m
+            assert 0.0 < sc["parallel_efficiency"], m
+        eff2 = [m["scaling"]["parallel_efficiency"]
+                for _, m in manifests
+                if registry.run_topology(m) == (2, 1)][0]
+        return f"2 points registered, d2p1 efficiency {eff2:.2f}"
+    finally:
+        shutil.rmtree(runs_dir, ignore_errors=True)
+
+
 def bench_throughput():
     """Headline bench must clear the BASELINE north-star (>= 8x)."""
     import json
@@ -277,6 +317,7 @@ def main():
     check("probe_smoke", probe_smoke)
     check("audit_smoke", audit_smoke)
     check("trace_smoke", trace_smoke)
+    check("scaling_smoke", scaling_smoke)
     check("flash_attention_parity", flash_attention_parity)
     check("bench_vs_baseline", bench_throughput)
     if FAILED:

@@ -123,6 +123,11 @@ SEEDED = {
             out.append(x)
             return out
         """, "mutable-default-arg"),
+    "telemetry/devicey.py": ("""
+        import jax
+        def lanes():
+            return [d.id for d in jax.devices()] + jax.local_devices()
+        """, "raw-devices"),
 }
 
 

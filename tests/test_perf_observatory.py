@@ -46,14 +46,35 @@ class TestTraceAttribution:
         events = trace.load_trace_events(FIXTURE)
         buckets = trace.attribute_rounds(events)
         assert sorted(buckets) == [0, 1]
-        assert buckets[0] == {
+        # the v3 aggregate buckets must stay BIT-FOR-BIT what they
+        # were before per-device attribution existed (the cross-device
+        # union is the same interval set the old pooled path measured)
+        agg = ("window_s", "busy_s", "compute_s", "collective_s",
+               "transfer_s", "host_gap_s")
+        assert {k: buckets[0][k] for k in agg} == {
             "window_s": 0.001, "busy_s": 0.0004,
             "compute_s": 0.00015, "collective_s": 0.00015,
             "transfer_s": 0.0001, "host_gap_s": 0.0006}
-        assert buckets[1] == {
+        assert {k: buckets[1][k] for k in agg} == {
             "window_s": 0.0015, "busy_s": 0.0004,
             "compute_s": 0.0003, "collective_s": 0.0,
             "transfer_s": 0.0001, "host_gap_s": 0.0011}
+        # v4: the same rounds also carry per-device lanes (TPU:0 from
+        # the /device: pid, cpu:30 from the tf_XLA thread) and skew
+        # stats — the all-reduce here runs on ONE lane, so there is no
+        # cross-device group to align and no skew
+        assert sorted(buckets[0]["per_device"]) == ["TPU:0", "cpu:30"]
+        assert buckets[0]["per_device"]["TPU:0"] == {
+            "busy_s": 0.0004, "compute_s": 0.00015,
+            "collective_s": 0.00015, "transfer_s": 0.0001,
+            "wait_s": 0.0, "wire_s": 0.00015}
+        assert buckets[1]["per_device"]["cpu:30"] == {
+            "busy_s": 0.0003, "compute_s": 0.0003,
+            "collective_s": 0.0, "transfer_s": 0.0,
+            "wait_s": 0.0, "wire_s": 0.0}
+        for r in (0, 1):
+            assert buckets[r]["skew"]["n_collectives"] == 0
+            assert buckets[r]["skew"]["straggler_device"] is None
 
     def test_buckets_partition_each_window(self):
         buckets = trace.attribute_rounds(
@@ -278,7 +299,10 @@ class TestPerfGateCLI:
         assert os.path.exists(baseline)
         base = gate.load_baseline(baseline)
         assert base["schema"] == gate.BASELINE_SCHEMA
-        assert "span:round_dispatch:ms" in base["metrics"]
+        # the synthetic ledger carries no topology info, so it lands
+        # under the "any" bucket of the schema-2 topology map
+        entry = gate.baseline_entry(base, None, None)
+        assert "span:round_dispatch:ms" in entry["metrics"]
 
         # same run gates green against its own baseline
         assert pg.main(["--ledger", good, "--baseline", baseline,
@@ -289,13 +313,15 @@ class TestPerfGateCLI:
         # re-baselining over a regression is refused without --force
         assert pg.main(["--ledger", slow, "--baseline", baseline,
                         "--write-baseline", baseline]) == 1
-        assert gate.load_baseline(baseline)["metrics"][
+        assert gate.baseline_entry(
+            gate.load_baseline(baseline), None, None)["metrics"][
             "span:round_dispatch:ms"]["median"] == pytest.approx(50.0)
         # --force is the explicit trade-off escape hatch
         assert pg.main(["--ledger", slow, "--baseline", baseline,
                         "--write-baseline", baseline,
                         "--force"]) == 0
-        assert gate.load_baseline(baseline)["metrics"][
+        assert gate.baseline_entry(
+            gate.load_baseline(baseline), None, None)["metrics"][
             "span:round_dispatch:ms"]["median"] == pytest.approx(200.0)
 
     def test_empty_ledger_is_an_error(self, tmp_path):
@@ -632,7 +658,7 @@ class TestProfileIntegration:
         assert all(not validate_record(r) for r in recs)
         rounds = [r for r in recs if r["kind"] == "round"]
         assert len(rounds) == 5
-        assert all(r["schema"] == 3 for r in rounds)
+        assert all(r["schema"] == 4 for r in rounds)
 
         traced = [r for r in rounds if r.get("device_time")]
         assert [r["round"] for r in traced] == [1, 2, 3, 4]
@@ -643,6 +669,13 @@ class TestProfileIntegration:
                      + dt["transfer_s"] + dt["host_gap_s"])
             assert abs(parts - dt["window_s"]) < 1e-5
             assert dt["busy_s"] > 0
+            # v4: real traces carry per-device lanes whose wait+wire
+            # split partitions each device's collective bucket exactly
+            assert dt["per_device"]
+            for lane in dt["per_device"].values():
+                assert lane["wait_s"] + lane["wire_s"] == \
+                    pytest.approx(lane["collective_s"], abs=1e-9)
+            assert dt["skew"]["n_collectives"] >= 0
             # the --profile cost model registered expected_round_s,
             # so every traced round carries a utilization
             assert 0 < dt["roofline_utilization"] <= 1.0
@@ -673,3 +706,581 @@ class TestProfileIntegration:
                         "--write-baseline", baseline]) == 0
         assert pg.main(["--ledger", ledger, "--baseline", baseline,
                         "--check"]) == 0
+
+
+# --- v4: per-device attribution + collective skew ---------------------
+
+
+SKEW_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                            "skew.trace.json.gz")
+
+AGG_KEYS = ("window_s", "busy_s", "compute_s", "collective_s",
+            "transfer_s", "host_gap_s")
+
+
+class TestSkewAttribution:
+    """``skew.trace.json.gz``: two TPU device lanes whose all-reduces
+    enter at different times. Round 0: TPU:0 enters all-reduce.7 at
+    1300, TPU:1 (the straggler — still computing) at 1450, both exit
+    1600 — so TPU:0's collective 300 us splits into 150 us *wait* and
+    150 us *wire*, TPU:1's 150 us is all wire. Round 1: a 20 us enter
+    delta on all-reduce.8 plus a single-participant reduce-scatter on
+    TPU:0 (no peer group: all wire, excluded from skew stats)."""
+
+    def test_fixture_golden_per_device_buckets(self):
+        buckets = trace.attribute_rounds(
+            trace.load_trace_events(SKEW_FIXTURE))
+        assert sorted(buckets) == [0, 1]
+        b0 = buckets[0]
+        assert {k: b0[k] for k in AGG_KEYS} == {
+            "window_s": 0.002, "busy_s": 0.0006,
+            "compute_s": 0.0002, "collective_s": 0.0003,
+            "transfer_s": 0.0001, "host_gap_s": 0.0014}
+        assert b0["per_device"] == {
+            "TPU:0": {"busy_s": 0.0006, "compute_s": 0.0002,
+                      "collective_s": 0.0003, "transfer_s": 0.0001,
+                      "wait_s": 0.00015, "wire_s": 0.00015},
+            "TPU:1": {"busy_s": 0.0005, "compute_s": 0.00035,
+                      "collective_s": 0.00015, "transfer_s": 0.0,
+                      "wait_s": 0.0, "wire_s": 0.00015}}
+        assert b0["skew"] == {
+            "n_collectives": 1, "max_enter_delta_s": 0.00015,
+            "p95_enter_delta_s": 0.00015, "straggler_device": "TPU:1"}
+        b1 = buckets[1]
+        assert {k: b1[k] for k in AGG_KEYS} == {
+            "window_s": 0.001, "busy_s": 0.0003,
+            "compute_s": 0.0, "collective_s": 0.0003,
+            "transfer_s": 0.0, "host_gap_s": 0.0007}
+        assert b1["per_device"] == {
+            "TPU:0": {"busy_s": 0.0003, "compute_s": 0.0,
+                      "collective_s": 0.0003, "transfer_s": 0.0,
+                      "wait_s": 2e-05, "wire_s": 0.00028},
+            "TPU:1": {"busy_s": 0.00018, "compute_s": 0.0,
+                      "collective_s": 0.00018, "transfer_s": 0.0,
+                      "wait_s": 0.0, "wire_s": 0.00018}}
+        assert b1["skew"] == {
+            "n_collectives": 1, "max_enter_delta_s": 2e-05,
+            "p95_enter_delta_s": 2e-05, "straggler_device": "TPU:1"}
+
+    def test_wait_plus_wire_partitions_collective_exactly(self):
+        """Per device, wait_s + wire_s must reproduce collective_s
+        EXACTLY (wire is computed as the rounded difference, so the
+        identity survives 6-dp rounding), and each lane's busy time
+        must partition into compute + collective + transfer."""
+        for fixture in (FIXTURE, SKEW_FIXTURE):
+            buckets = trace.attribute_rounds(
+                trace.load_trace_events(fixture))
+            for b in buckets.values():
+                for dev, lane in b["per_device"].items():
+                    assert lane["wait_s"] + lane["wire_s"] == \
+                        pytest.approx(lane["collective_s"],
+                                      abs=1e-12), (fixture, dev)
+                    assert lane["compute_s"] + lane["collective_s"] \
+                        + lane["transfer_s"] == \
+                        pytest.approx(lane["busy_s"], abs=1e-12)
+
+    def test_aggregate_never_exceeds_lane_sums(self):
+        """The aggregate buckets are the cross-device interval UNION:
+        concurrent work on two lanes collapses, so aggregate busy is
+        bounded by the per-lane sum and dominated by every single
+        lane."""
+        buckets = trace.attribute_rounds(
+            trace.load_trace_events(SKEW_FIXTURE))
+        for b in buckets.values():
+            lane_busy = [l["busy_s"] for l in b["per_device"].values()]
+            assert max(lane_busy) <= b["busy_s"] + 1e-12
+            assert b["busy_s"] <= sum(lane_busy) + 1e-12
+
+    def test_v4_buckets_validate_and_round_trip(self):
+        buckets = trace.attribute_rounds(
+            trace.load_trace_events(SKEW_FIXTURE))
+        rec = make_round_record(7)
+        rec["device_time"] = buckets[0]
+        assert validate_record(rec) == []
+        back = json.loads(json.dumps(rec))
+        assert validate_record(back) == []
+        assert back["device_time"] == rec["device_time"]
+
+    def test_skew_metrics_reach_the_gate(self):
+        rec = make_round_record(0)
+        rec["device_time"] = {"busy_s": 0.5, "skew": {
+            "n_collectives": 3, "max_enter_delta_s": 0.02,
+            "p95_enter_delta_s": 0.01, "straggler_device": "TPU:1"}}
+        metrics = gate.metrics_from_records([rec])
+        assert metrics["device:skew_max_enter_delta_s"]["median"] == \
+            pytest.approx(0.02)
+        assert metrics["device:skew_max_enter_delta_s"]["better"] == \
+            "lower"
+        assert metrics["device:skew_p95_enter_delta_s"]["better"] == \
+            "lower"
+
+
+class _SkewAlarmCfg(_AlarmCfg):
+    alarm_collective_skew = 0.4
+
+
+class TestCollectiveSkewAlarm:
+    BUCKETS = {"window_s": 1.0, "busy_s": 0.6, "compute_s": 0.5,
+               "collective_s": 0.1, "transfer_s": 0.0,
+               "host_gap_s": 0.4}
+
+    @staticmethod
+    def _with_skew(delta, straggler="TPU:3"):
+        b = dict(TestCollectiveSkewAlarm.BUCKETS)
+        b["skew"] = {"n_collectives": 2, "max_enter_delta_s": delta,
+                     "p95_enter_delta_s": delta,
+                     "straggler_device": straggler}
+        return b
+
+    def test_fires_above_collective_fraction(self):
+        eng = AlarmEngine(_SkewAlarmCfg())
+        # threshold = 0.4 x collective_s 0.1 = 0.04 s of skew
+        assert eng.check_device_time(0, self._with_skew(0.03)) == []
+        fired = eng.check_device_time(1, self._with_skew(0.05))
+        assert fired and fired[0]["rule"] == "collective_skew"
+        assert fired[0]["straggler_device"] == "TPU:3"
+        assert fired[0]["value"] == pytest.approx(0.05)
+        assert fired[0]["threshold"] == pytest.approx(0.04)
+
+    def test_no_collective_no_fire(self):
+        eng = AlarmEngine(_SkewAlarmCfg())
+        b = self._with_skew(0.5)
+        b["collective_s"] = 0.0
+        assert eng.check_device_time(0, b) == []
+        # v3 buckets without skew never fire either
+        assert eng.check_device_time(1, dict(self.BUCKETS)) == []
+
+    def test_disarmed_when_zero(self):
+        class Off(_AlarmCfg):
+            alarm_collective_skew = 0.0
+        eng = AlarmEngine(Off())
+        assert eng.check_device_time(0, self._with_skew(9.9)) == []
+
+    def test_flags_ledger_record_through_telemetry(self):
+        sink = _ListSink()
+        tel = Telemetry(sinks=[sink])
+        tel.hold_emission(True)
+        tel.begin_round(0)
+        eng = AlarmEngine(_SkewAlarmCfg(), telemetry=tel)
+        tel.on_device_time = eng.check_device_time
+        tel.merge_round_device_time(0, self._with_skew(0.09))
+        tel.hold_emission(False)
+        tel.close()
+        rounds = [r for r in sink.records if r["kind"] == "round"]
+        assert rounds[0]["alarms"]
+        assert rounds[0]["alarms"][0]["rule"] == "collective_skew"
+
+    def test_abort_action_raises_from_merge(self):
+        class Abort(_SkewAlarmCfg):
+            on_divergence = "abort"
+        tel = Telemetry(sinks=[_ListSink()])
+        tel.begin_round(0)
+        eng = AlarmEngine(Abort(), telemetry=tel)
+        tel.on_device_time = eng.check_device_time
+        with pytest.raises(DivergenceAbort):
+            tel.merge_round_device_time(0, self._with_skew(0.5))
+
+    def test_build_alarm_engine_arms_on_skew_alone(self):
+        from commefficient_tpu.telemetry.alarms import \
+            build_alarm_engine
+
+        class OnlySkew(_AlarmCfg):
+            probe_period = 0
+            alarm_step_time_ratio = 0.0
+            alarm_collective_skew = 0.5
+        assert build_alarm_engine(OnlySkew()) is not None
+
+        class Nothing(OnlySkew):
+            alarm_collective_skew = 0.0
+        assert build_alarm_engine(Nothing()) is None
+
+
+# --- cross-host ledger shards -----------------------------------------
+
+
+def _load_script(name):
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _ShardCfg:
+    def __init__(self, ledger, console=False):
+        self.ledger = ledger
+        self.telemetry_console = console
+
+
+class TestLedgerShards:
+    def test_shard_path_naming(self):
+        from commefficient_tpu.telemetry.sinks import shard_ledger_path
+        assert shard_ledger_path("/x/a.jsonl", 0) == "/x/a.jsonl"
+        assert shard_ledger_path("/x/a.jsonl", 1) == \
+            "/x/a.jsonl.p1.jsonl"
+        assert shard_ledger_path("/x/a.jsonl", 3) == \
+            "/x/a.jsonl.p3.jsonl"
+
+    def test_every_process_writes_its_shard(self, tmp_path, capsys):
+        """The old process-0 gate silently dropped every other host's
+        telemetry; now process k > 0 writes a process-stamped shard
+        and says so once."""
+        from commefficient_tpu.telemetry.core import build_telemetry
+        ledger = str(tmp_path / "led.jsonl")
+
+        tel0 = build_telemetry(_ShardCfg(ledger), process_index=0,
+                               process_count=2)
+        tel0.begin_round(0)
+        tel0.close()
+        tel1 = build_telemetry(_ShardCfg(ledger), process_index=1,
+                               process_count=2)
+        tel1.begin_round(0)
+        tel1.close()
+
+        assert os.path.exists(ledger)
+        shard = ledger + ".p1.jsonl"
+        assert os.path.exists(shard)
+        out = capsys.readouterr().out
+        assert "ledger shard" in out and ".p1.jsonl" in out
+        canon = [json.loads(l) for l in open(ledger)]
+        shrd = [json.loads(l) for l in open(shard)]
+        assert all(not validate_record(r) for r in canon + shrd)
+        # both sides are process-stamped on a multi-process mesh
+        assert {r["process"] for r in canon} == {0}
+        assert {r["process"] for r in shrd} == {1}
+
+    def test_single_process_is_unstamped(self, tmp_path):
+        from commefficient_tpu.telemetry.core import build_telemetry
+        ledger = str(tmp_path / "solo.jsonl")
+        tel = build_telemetry(_ShardCfg(ledger), process_index=0,
+                              process_count=1)
+        tel.begin_round(0)
+        tel.close()
+        recs = [json.loads(l) for l in open(ledger)]
+        assert recs and all("process" not in r for r in recs)
+
+    def _write_shard_fixture(self, tmp_path):
+        ledger = str(tmp_path / "fleet.jsonl")
+        with open(ledger, "w") as f:
+            meta = {"schema": 1, "kind": "meta", "ts": 0.0,
+                    "num_devices": 4, "process_count": 2}
+            f.write(json.dumps(meta) + "\n")
+            for r in range(2):
+                rec = make_round_record(r)
+                rec["spans"] = {"round_dispatch": 0.05}
+                rec["device_time"] = {
+                    "window_s": 0.1, "busy_s": 0.08,
+                    "compute_s": 0.07, "collective_s": 0.01,
+                    "transfer_s": 0.0, "host_gap_s": 0.02}
+                f.write(json.dumps(rec) + "\n")
+        shard = ledger + ".p1.jsonl"
+        with open(shard, "w") as f:
+            f.write(json.dumps({"schema": 1, "kind": "meta",
+                                "ts": 0.0, "process": 1}) + "\n")
+            for r in range(3):  # round 2 exists ONLY on the shard
+                rec = make_round_record(r)
+                rec["process"] = 1
+                rec["spans"] = {"client_feed": 0.01}
+                rec["host_rss_peak_bytes"] = 1000.0 + r
+                rec["uplink_bytes"] = 64.0
+                rec["device_time"] = {
+                    "window_s": 0.1, "busy_s": 0.06,
+                    "compute_s": 0.05, "collective_s": 0.01,
+                    "transfer_s": 0.0, "host_gap_s": 0.04}
+                f.write(json.dumps(rec) + "\n")
+        return ledger, shard
+
+    def test_merge_joins_shards_on_round_id(self, tmp_path):
+        lm = _load_script("ledger_merge")
+        ledger, shard = self._write_shard_fixture(tmp_path)
+        assert lm.discover_shards(ledger) == [(1, shard)]
+        assert lm.main([ledger]) == 0
+        merged_path = ledger + ".merged.jsonl"
+        assert os.path.exists(merged_path)
+        merged = [json.loads(l) for l in open(merged_path)]
+        rounds = [r for r in merged if r.get("kind") == "round"]
+        assert [r["round"] for r in rounds] == [0, 1, 2]
+        for r in rounds[:2]:
+            sh = r["shards"]["p1"]
+            assert sh["spans"] == {"client_feed": 0.01}
+            assert sh["uplink_bytes"] == 64.0
+            # per-host host gap: the multi-host straggler scoreboard
+            assert r["host_gap_by_process"] == {
+                "p0": 0.02, "p1": 0.04}
+        # the round only process 1 survived to record is kept, flagged
+        assert rounds[2]["shard_only"] is True
+        assert rounds[2]["process"] == 1
+        # shard meta dropped: only the canonical meta remains
+        metas = [r for r in merged if r.get("kind") == "meta"]
+        assert len(metas) == 1 and "process" not in metas[0]
+
+    def test_merge_without_shards_is_an_error(self, tmp_path):
+        lm = _load_script("ledger_merge")
+        ledger = str(tmp_path / "solo.jsonl")
+        with open(ledger, "w") as f:
+            f.write(json.dumps(make_round_record(0)) + "\n")
+        assert lm.main([ledger]) == 1
+
+    def test_report_summarizes_merged_shards(self, tmp_path):
+        lm = _load_script("ledger_merge")
+        tr = _load_script("telemetry_report")
+        ledger, _ = self._write_shard_fixture(tmp_path)
+        assert lm.main([ledger]) == 0
+        records, problems = tr.load_ledger(ledger + ".merged.jsonl")
+        assert problems == []
+        summ = tr.summarize(records)
+        assert summ["shards"]["p1"]["rounds"] == 2
+        assert summ["shards"]["p1"]["host_gap_mean_ms"] == \
+            pytest.approx(40.0)
+        assert summ["shards"]["p1"]["host_rss_peak_bytes"] == 1001.0
+        rendered = tr.render_summary(summ, label="merged")
+        assert "shard p1" in rendered
+
+
+# --- topology-keyed gate ----------------------------------------------
+
+
+class TestTopologyGate:
+    def test_entries_are_isolated_per_topology(self):
+        base = gate.make_baseline(
+            {"span:round_dispatch:ms": _metric(10.0)},
+            device_count=8, process_count=1, config_hash="cafe")
+        entry = gate.baseline_entry(base, 8, 1)
+        assert entry["device_count"] == 8
+        assert entry["config_hash"] == "cafe"
+        assert gate.baseline_entry(base, 4, 1) is None
+        verdict = gate.compare(
+            base, {"span:round_dispatch:ms": _metric(11.0)},
+            device_count=8, process_count=1)
+        assert verdict["topology"] == "d8p1"
+        assert verdict["regressions"] == []
+        # an ungated topology point fails LOUDLY, never silently
+        with pytest.raises(ValueError, match="d4p1"):
+            gate.compare(base,
+                         {"span:round_dispatch:ms": _metric(11.0)},
+                         device_count=4, process_count=1)
+
+    def test_update_replaces_only_one_topology(self):
+        base = gate.make_baseline(
+            {"span:a:ms": _metric(10.0)}, device_count=1,
+            process_count=1)
+        base = gate.update_baseline(
+            base, {"span:a:ms": _metric(5.0)}, source="x",
+            device_count=8, process_count=1, config_hash="c8")
+        assert sorted(base["topologies"]) == ["d1p1", "d8p1"]
+        base = gate.update_baseline(
+            base, {"span:a:ms": _metric(4.0)}, source="y",
+            device_count=8, process_count=1, config_hash="c8")
+        assert base["topologies"]["d8p1"]["metrics"][
+            "span:a:ms"]["median"] == pytest.approx(4.0)
+        assert base["topologies"]["d1p1"]["metrics"][
+            "span:a:ms"]["median"] == pytest.approx(10.0)
+
+    def test_v1_baseline_resolves_for_any_topology(self):
+        """Legacy topology-blind baselines keep working (their
+        historical behaviour) until re-captured."""
+        v1 = {"schema": 1, "ts": 0.0, "source": "old",
+              "metrics": {"span:a:ms": _metric(10.0)}}
+        assert gate.baseline_entry(v1, 8, 1)["metrics"]
+        verdict = gate.compare(v1, {"span:a:ms": _metric(11.0)},
+                               device_count=8, process_count=1)
+        assert verdict["regressions"] == []
+        migrated = gate.migrate_baseline(v1)
+        assert migrated["schema"] == gate.BASELINE_SCHEMA
+        assert migrated["topologies"][gate.ANY_TOPOLOGY][
+            "metrics"]["span:a:ms"]["median"] == 10.0
+
+    def test_unreadable_schema_raises(self):
+        with pytest.raises(ValueError, match="schema"):
+            gate.baseline_entry({"schema": 99}, 1, 1)
+
+    def test_cli_topology_cycle(self, tmp_path, capsys):
+        """One baseline file guards several topology points
+        independently: a regression at d4p1 fails ONLY d4p1, and a
+        topology with no entry is a loud failure."""
+        pg = _load_perf_gate()
+        good = str(tmp_path / "good.jsonl")
+        slow = str(tmp_path / "slow.jsonl")
+        baseline = str(tmp_path / "perf_baseline.json")
+        _write_ledger(good, 0.050)
+        _write_ledger(slow, 0.200)
+
+        assert pg.main(["--ledger", good, "--write-baseline", baseline,
+                        "--device_count", "8",
+                        "--process_count", "1"]) == 0
+        # no d4p1 entry yet: --check fails loudly...
+        assert pg.main(["--ledger", good, "--baseline", baseline,
+                        "--check", "--device_count", "4",
+                        "--process_count", "1"]) == 1
+        assert "no d4p1 entry" in capsys.readouterr().out
+        # ...and --write-baseline captures it without gating
+        assert pg.main(["--ledger", good, "--write-baseline", baseline,
+                        "--device_count", "4",
+                        "--process_count", "1"]) == 0
+        base = gate.load_baseline(baseline)
+        assert sorted(base["topologies"]) == ["d4p1", "d8p1"]
+        # a regression at ONE topology point fails that point only
+        assert pg.main(["--ledger", slow, "--baseline", baseline,
+                        "--check", "--device_count", "4",
+                        "--process_count", "1"]) == 1
+        assert pg.main(["--ledger", good, "--baseline", baseline,
+                        "--check", "--device_count", "8",
+                        "--process_count", "1"]) == 0
+
+    def test_cli_reads_topology_from_ledger_meta(self, tmp_path):
+        pg = _load_perf_gate()
+        ledger = str(tmp_path / "meta.jsonl")
+        with open(ledger, "w") as f:
+            f.write(json.dumps({"schema": 1, "kind": "meta",
+                                "ts": 0.0, "num_devices": 8}) + "\n")
+            rec = make_round_record(0)
+            rec["spans"] = {"round_dispatch": 0.05}
+            f.write(json.dumps(rec) + "\n")
+        records = pg.load_ledger_records(ledger)
+        # pre-fleet metas never recorded process_count: defaults to 1
+        assert pg.resolve_topology(None, records) == (8, 1)
+        # CLI overrides win
+        assert pg.resolve_topology(None, records,
+                                   device_count=2,
+                                   process_count=2) == (2, 2)
+        manifest = {"device_count": 16, "process_count": 4}
+        assert pg.resolve_topology(manifest, records) == (16, 4)
+
+
+# --- registry topology keys -------------------------------------------
+
+
+class TestRegistryTopologyKeys:
+    def test_run_topology_and_key(self):
+        m = {"config_hash": "c", "device_count": 8, "process_count": 2}
+        assert registry.run_topology(m) == (8, 2)
+        assert registry.run_key(m) == ("c", 8, 2)
+        # pre-fleet manifests: unknown topology, never silently
+        # comparable with a counted run
+        assert registry.run_topology({}) == (None, None)
+        assert registry.run_key({"config_hash": "c"}) != \
+            registry.run_key(m)
+
+    def test_manifest_records_live_topology(self, tmp_path):
+        ledger = str(tmp_path / "a.jsonl")
+        open(ledger, "w").close()
+        registry.write_manifest(str(tmp_path / "runs"),
+                                args=_Cfg(x=1), ledger=ledger)
+        (_, rec), = registry.list_manifests(str(tmp_path / "runs"))
+        assert isinstance(rec["device_count"], int)
+        assert isinstance(rec["process_count"], int)
+        # single-process run: no shard list
+        assert "ledger_shards" not in rec
+
+    def _fake_manifest(self, runs, name, ts, chash, ledger, dc, pc,
+                       scaling=None):
+        out_dir = os.path.join(runs, registry.MANIFEST_DIR)
+        os.makedirs(out_dir, exist_ok=True)
+        rec = {"schema": 1, "kind": "run_manifest", "ts": ts,
+               "config_hash": chash, "ledger": ledger,
+               "device_count": dc, "process_count": pc,
+               "git_sha": "", "bench": {}}
+        if scaling:
+            rec["scaling"] = scaling
+        path = os.path.join(out_dir, f"run_{name}.json")
+        with open(path, "w") as f:
+            json.dump(rec, f)
+        return path
+
+    def test_latest_ledgers_key_filter(self, tmp_path):
+        runs = str(tmp_path / "runs")
+        led = str(tmp_path / "led.jsonl")
+        open(led, "w").close()
+        self._fake_manifest(runs, "a", 1.0, "cfg", led, 1, 1)
+        self._fake_manifest(runs, "b", 2.0, "cfg", led, 8, 1)
+        self._fake_manifest(runs, "c", 3.0, "cfg", led, 8, 1)
+        hits = registry.latest_ledgers(runs, n=5,
+                                       key=("cfg", 8, 1))
+        assert len(hits) == 2
+        assert all(registry.run_topology(m) == (8, 1)
+                   for _, m, _ in hits)
+        # newest first
+        assert hits[0][1]["ts"] == 3.0
+        assert registry.latest_ledgers(runs, n=5,
+                                       key=("cfg", 2, 1)) == []
+
+
+# --- scaling curves in the report -------------------------------------
+
+
+class TestScalingCurves:
+    def _scaling(self, cps, eff, frac=0.1, skew=0.001):
+        return {"clients_per_s": cps, "parallel_efficiency": eff,
+                "collective_fraction": frac, "max_skew_s": skew}
+
+    def test_groups_by_config_and_orders_by_topology(self):
+        tr = _load_script("telemetry_report")
+        manifests = [
+            ("m4", {"config_hash": "aaaa", "device_count": 4,
+                    "process_count": 1,
+                    "scaling": self._scaling(300.0, 0.75)}),
+            ("m1", {"config_hash": "aaaa", "device_count": 1,
+                    "process_count": 1,
+                    "scaling": self._scaling(100.0, 1.0)}),
+            # a single-point config is not a curve
+            ("mx", {"config_hash": "bbbb", "device_count": 1,
+                    "process_count": 1,
+                    "scaling": self._scaling(50.0, 1.0)}),
+            # manifests without a scaling block are ignored
+            ("my", {"config_hash": "aaaa", "device_count": 2,
+                    "process_count": 1}),
+        ]
+        curves = tr.scaling_curves(manifests)
+        assert len(curves) == 1
+        assert curves[0]["config_hash"] == "aaaa"
+        assert [(p["device_count"], p["process_count"])
+                for p in curves[0]["points"]] == [(1, 1), (4, 1)]
+        rendered = tr.render_scaling_curves(curves)
+        assert "d1p1" in rendered and "d4p1" in rendered
+        assert "eff 0.750" in rendered
+        assert "clients/s" in rendered
+
+    def test_newest_manifest_wins_per_topology_point(self):
+        tr = _load_script("telemetry_report")
+        manifests = [  # list_manifests order: oldest first
+            ("old", {"config_hash": "aaaa", "device_count": 2,
+                     "process_count": 1,
+                     "scaling": self._scaling(10.0, 0.5)}),
+            ("new", {"config_hash": "aaaa", "device_count": 2,
+                     "process_count": 1,
+                     "scaling": self._scaling(20.0, 0.9)}),
+            ("one", {"config_hash": "aaaa", "device_count": 1,
+                     "process_count": 1,
+                     "scaling": self._scaling(11.0, 1.0)}),
+        ]
+        curves = tr.scaling_curves(manifests)
+        (curve,) = curves
+        p2 = [p for p in curve["points"]
+              if p["device_count"] == 2][0]
+        assert p2["clients_per_s"] == 20.0
+        assert p2["manifest"] == "new"
+
+    def test_runs_dir_report_renders_curve(self, tmp_path, capsys):
+        tr = _load_script("telemetry_report")
+        runs = str(tmp_path / "runs")
+        out_dir = os.path.join(runs, registry.MANIFEST_DIR)
+        os.makedirs(out_dir)
+        for i, (dc, cps, eff) in enumerate(
+                [(1, 100.0, 1.0), (2, 180.0, 0.9)]):
+            ledger = str(tmp_path / f"led{dc}.jsonl")
+            _write_ledger(ledger, 0.05)
+            rec = {"schema": 1, "kind": "run_manifest",
+                   "ts": float(i + 1), "config_hash": "aaaa",
+                   "ledger": ledger, "device_count": dc,
+                   "process_count": 1, "git_sha": "", "bench": {},
+                   "scaling": self._scaling(cps, eff)}
+            with open(os.path.join(out_dir,
+                                   f"run_{i}.json"), "w") as f:
+                json.dump(rec, f)
+        assert tr.runs_dir_report(runs, as_json=False) == 0
+        out = capsys.readouterr().out
+        assert "scaling curve" in out
+        assert "d1p1" in out and "d2p1" in out
+        # the two runs differ in topology: no cross-topology diff
+        assert "no previous run with this config+topology" in out

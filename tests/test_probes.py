@@ -213,6 +213,13 @@ def test_probes_off_program_identical(mode, error_type):
     assert default == explicit_off
     assert probed != default
 
+    # observability knobs that live entirely on the host — the skew
+    # alarm threshold reads trace-derived buckets, never the program —
+    # must be invisible to the lowered HLO
+    skew_cfg = dataclasses.replace(cfg, alarm_collective_skew=0.5)
+    assert _lower_text(build_client_round(skew_cfg, linear_loss, 3),
+                       skew_cfg) == default
+
     def _server_text(sr):
         ps = jax.ShapeDtypeStruct((8,), jnp.float32)
         ss = jax.eval_shape(lambda: ServerState.init(cfg))

@@ -154,25 +154,42 @@ def test_close_flushes_byteless_rounds(tmp_path):
     assert validate_record(recs[0]) == []
 
 
-def test_schema_v3_device_time_round_trip(tmp_path):
-    """A fresh round record is schema v3 with ``device_time: None``;
-    a populated numeric bucket dict validates and survives the JSONL
-    sink; malformed device_time is caught; v1/v2 ledgers (no
-    device_time key) stay readable."""
+def test_schema_v4_device_time_round_trip(tmp_path):
+    """A fresh round record is schema v4 with ``device_time: None``;
+    a populated bucket dict — numeric aggregates plus the v4
+    ``per_device``/``skew`` sub-dicts — validates and survives the
+    JSONL sink; malformed device_time is caught; v1/v2 (no
+    device_time key) and v3 (numeric-only buckets) ledgers stay
+    readable."""
     from commefficient_tpu.telemetry.record import (
         READABLE_SCHEMA_VERSIONS, make_round_record)
 
-    assert READABLE_SCHEMA_VERSIONS == (1, 2, 3)
+    assert READABLE_SCHEMA_VERSIONS == (1, 2, 3, 4)
     rec = make_round_record(0)
-    assert rec["schema"] == 3 and rec["device_time"] is None
+    assert rec["schema"] == 4 and rec["device_time"] is None
     assert validate_record(rec) == []
 
     rec["device_time"] = {"window_s": 0.01, "busy_s": 0.004,
                           "compute_s": 0.003, "collective_s": 0.0005,
                           "transfer_s": 0.0005, "host_gap_s": 0.006,
-                          "roofline_utilization": 0.2}
+                          "roofline_utilization": 0.2,
+                          "per_device": {"TPU:0": {
+                              "busy_s": 0.004, "wait_s": 0.0001,
+                              "wire_s": 0.0004}},
+                          "skew": {"n_collectives": 2,
+                                   "max_enter_delta_s": 0.0001,
+                                   "p95_enter_delta_s": 0.0001,
+                                   "straggler_device": "TPU:0"}}
     assert validate_record(rec) == []
-    path = str(tmp_path / "v3.jsonl")
+    # dict values are allowed ONLY under the v4 sub-dict keys
+    bad_dict = dict(rec, device_time={"window_s": {"oops": 1.0}})
+    assert any("device_time" in p for p in validate_record(bad_dict))
+    # shard records may stamp their process index; it must be an int
+    stamped = dict(rec, process=1)
+    assert validate_record(stamped) == []
+    assert any("process" in p
+               for p in validate_record(dict(rec, process="p1")))
+    path = str(tmp_path / "v4.jsonl")
     sink = JSONLSink(path)
     sink.write(rec)
     sink.close()
@@ -195,11 +212,17 @@ def test_schema_v3_device_time_round_trip(tmp_path):
           if k not in ("probes", "alarms")}
     v1["schema"] = 1
     assert validate_record(v1) == []
-    # ...but a v3 record MUST carry it
-    v3_missing = {k: v for k, v in make_round_record(2).items()
+    # v3 ledgers (numeric-only buckets, no per_device/skew) read back
+    v3 = dict(make_round_record(1), schema=3)
+    v3["device_time"] = {"window_s": 0.01, "busy_s": 0.004,
+                         "compute_s": 0.003, "collective_s": 0.0005,
+                         "transfer_s": 0.0005, "host_gap_s": 0.006}
+    assert validate_record(v3) == []
+    # ...but a v3+/v4 record MUST carry the key
+    v4_missing = {k: v for k, v in make_round_record(2).items()
                   if k != "device_time"}
     assert any("device_time" in p
-               for p in validate_record(v3_missing))
+               for p in validate_record(v4_missing))
 
 
 def test_console_sink_aggregates(capsys):
