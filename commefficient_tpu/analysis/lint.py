@@ -279,6 +279,42 @@ def _check_raw_devices(rel, lines, tree):
     return hits
 
 
+# --- rule: chaos-confinement -------------------------------------------
+
+
+def _is_chaos_module(modname) -> bool:
+    return bool(modname) and modname.split(".")[-1] == "chaos"
+
+
+def _check_chaos_confinement(rel, lines, tree):
+    """``data/chaos.py`` (byzantine/fault injection) is strictly a
+    test/bench facility: no production module may import it, so the
+    adversarial hooks can never ride along into a real run. Tests,
+    benches and scripts live outside the scanned package root and wire
+    chaos in through the public hooks (``transmit_transform``, loader
+    wrapping) instead."""
+    if rel.as_posix() == "data/chaos.py":
+        return []
+    hits = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if _is_chaos_module(a.name):
+                    hits.append((node.lineno,
+                                 f"import {a.name} outside "
+                                 "data/chaos.py — chaos is "
+                                 "test/bench-only"))
+        elif isinstance(node, ast.ImportFrom):
+            if _is_chaos_module(node.module) or any(
+                    a.name == "chaos" for a in node.names):
+                src = ("." * node.level) + (node.module or "")
+                hits.append((node.lineno,
+                             f"from {src} import ... pulls in "
+                             "data/chaos.py — chaos is "
+                             "test/bench-only"))
+    return hits
+
+
 # --- rule: mutable-default-arg -----------------------------------------
 
 
@@ -318,6 +354,9 @@ ALL_RULES = [
     Rule("raw-devices",
          "raw jax.devices()/jax.local_devices() inside telemetry/",
          _check_raw_devices),
+    Rule("chaos-confinement",
+         "data/chaos.py imported by a production module",
+         _check_chaos_confinement),
     Rule("mutable-default-arg",
          "mutable default argument",
          _check_mutable_default),

@@ -16,12 +16,25 @@ miss so the caller falls back to a synchronous gather.
 
 from __future__ import annotations
 
+import logging
 import queue
+import random
 import threading
+import time
 
 import numpy as np
 
 from commefficient_tpu.telemetry import clock
+
+logger = logging.getLogger("commefficient_tpu.clientstore.prefetch")
+
+#: transient shard-read retry policy: GATHER_TRIES total attempts,
+#: exponential backoff with +-50% jitter between them. A one-off NFS
+#: hiccup or page-cache miss recovers invisibly; a persistent failure
+#: still surfaces (as the per-job error on take()) after
+#: GATHER_TRIES attempts, so a dead disk cannot silently stall a run.
+GATHER_TRIES = 3
+GATHER_BACKOFF_S = 0.05
 
 
 class StorePrefetcher:
@@ -57,12 +70,42 @@ class StorePrefetcher:
                     return
                 ids, buf = job
                 try:
-                    rows, version = self._store.gather(ids, out=buf)
+                    rows, version = self._gather_with_retry(ids, buf)
                     self._done.put((ids, rows, version, None))
                 except BaseException as exc:  # surfaced by take()
                     self._done.put((ids, None, 0, exc))
         except BaseException as exc:
             self._failure = exc
+
+    def _gather_with_retry(self, ids, buf):
+        """``store.gather`` with bounded retry: transient shard-read
+        failures (OSError/IOError from a file-backed store) get
+        GATHER_TRIES attempts with jittered exponential backoff
+        before the error rides the done-queue to the caller.
+        Non-I/O errors (a real bug) are never retried."""
+        delay = GATHER_BACKOFF_S
+        for attempt in range(GATHER_TRIES):
+            try:
+                return self._store.gather(ids, out=buf)
+            except OSError as exc:
+                if attempt + 1 >= GATHER_TRIES:
+                    raise
+                jittered = delay * (0.5 + random.random())
+                logger.warning(
+                    "transient clientstore gather failure "
+                    "(attempt %d/%d, retrying in %.3fs): %s",
+                    attempt + 1, GATHER_TRIES, jittered, exc)
+                time.sleep(jittered)
+                delay *= 2
+
+    def _fail_for_test(self, exc):
+        """Chaos-harness hook (data/chaos.kill_prefetch_worker):
+        mark the worker loop dead exactly as an escaped exception
+        would, so tests can exercise the death-surfacing path
+        without racing a real thread crash."""
+        self._failure = exc
+        self._stop.set()
+        self._jobs.put(None)
 
     def _check_failure(self):
         if self._failure is not None:

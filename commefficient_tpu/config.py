@@ -16,6 +16,7 @@ from typing import Optional, Sequence
 MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
 ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
+ROBUST_AGGS = ("none", "median", "trimmed", "clip")
 
 # dataset -> num classes (reference utils.py:37-44)
 FED_DATASETS = {
@@ -300,6 +301,51 @@ class Config:
     # skew stats). 0 = off. Needs --profile to produce trace buckets;
     # shares the --on_divergence action.
     alarm_collective_skew: float = 0.0
+    # robust aggregation (core/robust.py): how the round folds the
+    # per-client transmits. "none" = the plain datapoint-weighted mean
+    # (bit-identical program to a build without the flag); "median" =
+    # coordinate-wise median over per-client (or grouped) per-datapoint
+    # mean transmits; "trimmed" = coordinate-wise trimmed mean dropping
+    # --robust_trim_frac of each tail; "clip" = per-client norm clip to
+    # --robust_clip_norm before the plain weighted fold. Robust folds
+    # need materialised per-client transmits, so they disable the
+    # fused-gradient and sketch-after-local-sum fast paths (sketch mode
+    # sketches per client — the median-of-sketches estimator of the
+    # sketched-SGD line). The server only ever sees the robust
+    # aggregate: rejected client mass is never fed into the virtual
+    # momentum/error state.
+    robust_agg: str = "none"
+    # fraction of clients trimmed from EACH tail per coordinate under
+    # --robust_agg trimmed (t = floor(frac * alive))
+    robust_trim_frac: float = 0.1
+    # per-client transmit-norm clip threshold (per-datapoint-mean
+    # scale) under --robust_agg clip; 0 = auto (the median of the
+    # round's alive per-client norms)
+    robust_clip_norm: float = 0.0
+    # --robust_agg median: fold clients into this many groups (mean
+    # within a group, median across groups — 1903.04488's
+    # median-of-means over sketches); 0 = every client its own group.
+    # num_workers must divide evenly.
+    robust_median_groups: int = 0
+    # byzantine_suspect rule (telemetry/alarms.py): fire when the
+    # round's max per-client transmit norm exceeds this ratio x the
+    # alive-client mean norm (needs probes for client_norm_* to
+    # exist). 0 = off; shares the --on_divergence action.
+    alarm_byzantine_ratio: float = 0.0
+    # fold_rejection_rate rule: fire when the robust fold's relative
+    # deviation from the plain mean exceeds this (the mass the fold
+    # rejected; needs --robust_agg != none and probes). 0 = off.
+    alarm_fold_rejection: float = 0.0
+    # periodic round-cadence autosave (runtime/checkpoint.py): save a
+    # full resumable checkpoint every N completed training rounds
+    # (0 = off; epoch-cadence --checkpoint_every is independent).
+    # Mid-epoch saves capture the sampler's in-progress epoch state,
+    # so a crash resumes at the autosaved round, bit-exact.
+    checkpoint_every_rounds: int = 0
+    # retention for round-cadence autosaves: keep this many numbered
+    # history snapshots (ckpt_<tag>_r<round>.npz hardlinks) besides
+    # the latest; 0 = latest only
+    checkpoint_keep: int = 0
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -339,6 +385,22 @@ class Config:
             "--alarm_step_time_window must be >= 2"
         assert self.alarm_collective_skew >= 0, \
             "--alarm_collective_skew must be >= 0 (0 = rule off)"
+        assert self.robust_agg in ROBUST_AGGS, \
+            "--robust_agg must be none|median|trimmed|clip"
+        assert 0.0 <= self.robust_trim_frac < 0.5, \
+            "--robust_trim_frac must be in [0, 0.5)"
+        assert self.robust_clip_norm >= 0, \
+            "--robust_clip_norm must be >= 0 (0 = auto)"
+        assert self.robust_median_groups >= 0, \
+            "--robust_median_groups must be >= 0 (0 = per-client)"
+        assert self.alarm_byzantine_ratio >= 0, \
+            "--alarm_byzantine_ratio must be >= 0 (0 = rule off)"
+        assert self.alarm_fold_rejection >= 0, \
+            "--alarm_fold_rejection must be >= 0 (0 = rule off)"
+        assert self.checkpoint_every_rounds >= 0, \
+            "--checkpoint_every_rounds must be >= 0 (0 = off)"
+        assert self.checkpoint_keep >= 0, \
+            "--checkpoint_keep must be >= 0"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -390,6 +452,18 @@ class Config:
             assert self.error_type != "local", \
                 "local error accumulation is pointless uncompressed " \
                 "(fed_worker.py:223-224)"
+        if self.robust_agg != "none":
+            # robust folds need the round's per-client transmits
+            # materialised at once; the chunked scan only ever holds
+            # a running sum
+            assert self.client_chunk == 0, \
+                "--robust_agg needs the full per-client transmit " \
+                "stack; incompatible with --client_chunk"
+            if self.robust_agg == "median" \
+                    and self.robust_median_groups > 1:
+                assert self.num_workers % self.robust_median_groups \
+                    == 0, "--robust_median_groups must divide " \
+                    "--num_workers"
         return self
 
     @property
@@ -634,6 +708,44 @@ def build_parser(default_lr: Optional[float] = None,
                         "enter-delta exceeds this ratio x its "
                         "collective seconds (0 = off; needs --profile; "
                         "action from --on_divergence)")
+    parser.add_argument("--robust_agg", type=str, default="none",
+                        choices=list(ROBUST_AGGS),
+                        help="robust fold over per-client transmits: "
+                        "median (coordinate-wise median of sketch "
+                        "groups), trimmed (trimmed mean), clip "
+                        "(norm-clipped fold). Rejected mass never "
+                        "enters the error-feedback residuals.")
+    parser.add_argument("--robust_trim_frac", type=float, default=0.1,
+                        help="fraction trimmed from each tail per "
+                        "coordinate under --robust_agg trimmed")
+    parser.add_argument("--robust_clip_norm", type=float, default=0.0,
+                        help="per-client transmit-norm clip threshold "
+                        "under --robust_agg clip (0 = auto: median of "
+                        "alive per-client norms)")
+    parser.add_argument("--robust_median_groups", type=int, default=0,
+                        help="number of client groups for "
+                        "median-of-sketch-groups (0 = every client "
+                        "its own group; must divide --num_workers)")
+    parser.add_argument("--alarm_byzantine_ratio", type=float,
+                        default=0.0,
+                        help="byzantine_suspect rule: fire when "
+                        "max/mean per-client transmit norm exceeds "
+                        "this ratio (0 = off; needs probes; action "
+                        "from --on_divergence)")
+    parser.add_argument("--alarm_fold_rejection", type=float,
+                        default=0.0,
+                        help="fold_rejection_rate rule: fire when the "
+                        "robust fold deviates from the plain mean by "
+                        "more than this relative rate (0 = off; needs "
+                        "probes; action from --on_divergence)")
+    parser.add_argument("--checkpoint_every_rounds", type=int,
+                        default=0,
+                        help="autosave the checkpoint every N rounds "
+                        "(0 = off; independent of the epoch-cadence "
+                        "--checkpoint_every)")
+    parser.add_argument("--checkpoint_keep", type=int, default=0,
+                        help="history snapshots retained by the round "
+                        "autosaver (0 = latest only)")
 
     return parser
 

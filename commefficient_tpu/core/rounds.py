@@ -127,8 +127,11 @@ def resolve_rot_lanes(cfg: Config) -> int:
 def sketch_is_late(cfg: Config) -> bool:
     """Sketch-mode fast path predicate: sketching after the local
     dense sum (linearity) is legal whenever no per-client op touches
-    the table — i.e. absent ``max_grad_norm``'s per-sketch clip."""
-    return cfg.mode == "sketch" and cfg.max_grad_norm is None
+    the table — i.e. absent ``max_grad_norm``'s per-sketch clip.
+    Robust folds need per-client sketches (median-of-sketches), so
+    ``--robust_agg`` also forces the early-sketch path."""
+    return (cfg.mode == "sketch" and cfg.max_grad_norm is None
+            and getattr(cfg, "robust_agg", "none") == "none")
 
 
 def fused_grad_eligible(cfg: Config) -> bool:
@@ -141,7 +144,8 @@ def fused_grad_eligible(cfg: Config) -> bool:
     return (cfg.mode in ("sketch", "uncompressed", "true_topk")
             and cfg.local_momentum == 0 and cfg.error_type != "local"
             and not cfg.do_topk_down and not cfg.do_dp
-            and cfg.max_grad_norm is None and cfg.microbatch_size <= 0)
+            and cfg.max_grad_norm is None and cfg.microbatch_size <= 0
+            and getattr(cfg, "robust_agg", "none") == "none")
 
 
 def round_plan(cfg: Config) -> dict:
@@ -158,6 +162,7 @@ def round_plan(cfg: Config) -> dict:
         "transmit_shape": list(cfg.transmit_shape),
         "upload_floats_per_client": int(cfg.upload_floats_per_client),
         "fused_grad": fused_grad_eligible(cfg),
+        "robust_agg": getattr(cfg, "robust_agg", "none"),
         "pipeline_depth": int(getattr(cfg, "pipeline_depth", 1)),
         "client_chunk": int(getattr(cfg, "client_chunk", 0)),
         "clientstore": getattr(cfg, "clientstore", "device"),
@@ -192,7 +197,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                        unravel: Callable = None,
                        dense_rows: bool = False,
                        probes: bool = False,
-                       probe_recovery: bool = False) -> Callable:
+                       probe_recovery: bool = False,
+                       transmit_transform: Callable = None) -> Callable:
     """Returns jit-able
     ``client_round(ps_weights, client_states, batch, client_ids, rng,
     fedavg_lr) -> RoundResult``.
@@ -223,6 +229,15 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     linearity identity), at 1/clients_per_device the sketch cost and
     with compressed inter-chip traffic. Pass ``mesh`` to enable; falls
     back to sketch-of-local-sum without one.
+
+    ``transmit_transform``: optional traceable
+    ``(transmit, batch, client_ids, rng) -> transmit`` applied to the
+    materialised per-client transmit stack before the fold — the
+    chaos harness's byzantine-attack hook (data/chaos.py; this module
+    deliberately never imports chaos). Passing one forces the
+    per-client path (the fused program has no per-client transmits);
+    the default ``None`` is never traced, keeping the round program
+    bit-identical to a build without the parameter.
     """
     cfg.validate_runtime()
     # recovery needs probes on and a sketch to recover from
@@ -239,6 +254,15 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
 
     sketch = args2sketch(cfg)
     sketch_late = sketch_is_late(cfg)
+    # Trace-time gate: robust folds replace the mean over materialised
+    # per-client transmits; at the default "none" the branch below is
+    # never traced and the round program is bit-identical to today's
+    # (pinned by test_probes_off_program_identical).
+    robust = getattr(cfg, "robust_agg", "none") != "none"
+    if transmit_transform is not None:
+        assert getattr(cfg, "client_chunk", 0) == 0, \
+            "transmit_transform needs the full per-client transmit " \
+            "stack; incompatible with --client_chunk"
     # Fused-gradient fast path: when no per-client transform touches
     # the gradient (no local momentum/error, clip, DP, topk_down or
     # microbatching), the aggregated quantity is exactly the gradient
@@ -251,7 +275,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     # backward over its local clients and ONE psum crosses the ICI —
     # of (r, c) sketch tables in sketch mode (compressed traffic, the
     # FetchSGD linearity identity), of the dense gradient otherwise.
-    fused_grad = fused_grad_eligible(cfg)
+    fused_grad = (fused_grad_eligible(cfg)
+                  and transmit_transform is None)
     if cfg.mode == "fedavg":
         per_client = _build_fedavg_client_step(cfg, loss_fn,
                                                padded_batch_size)
@@ -309,12 +334,30 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
 
             return local_loss
 
+        # Weight-decay share of this shard. At the default (no
+        # dropout) the even 1/n_shards split keeps today's program;
+        # under --dropout_prob the share becomes this shard's alive-
+        # datapoint fraction so the cross-shard sum matches the
+        # per-client path exactly: full (wd/num_workers)·p while any
+        # client survives, exact zero on a fully-dropped round (the
+        # per-client path's dead transmits are zeros — the fused path
+        # must not keep decaying weights on a round nobody joined).
+        if getattr(cfg, "dropout_prob", 0.0) > 0:
+            wd_frac = jnp.sum(batch["mask"]) / total
+        else:
+            wd_frac = None  # even split — today's exact constants
+
+        def _wd_coef():
+            if wd_frac is None:
+                return cfg.weight_decay / cfg.num_workers / n_shards
+            return (cfg.weight_decay / cfg.num_workers) * wd_frac
+
         if tree_sketch:
             tree = unravel(ps_weights)
             (_, metrics), g_tree = jax.value_and_grad(
                 make_local_loss(tree_loss), has_aux=True)(tree)
             if cfg.weight_decay != 0:
-                coef = (cfg.weight_decay / cfg.num_workers / n_shards)
+                coef = _wd_coef()
                 # decay in f32 regardless of leaf dtype: the flat path
                 # computes g + coef*p on the f32 flat vector, and
                 # sketch_from_leaves casts leaves to f32 anyway — a
@@ -336,8 +379,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             make_local_loss(loss_fn), has_aux=True)(ps_weights)
         if cfg.weight_decay != 0:
             # Σ_i (wd/num_workers)·p·n_i / total = (wd/num_workers)·p
-            g = g + (cfg.weight_decay / cfg.num_workers
-                     / n_shards) * ps_weights
+            g = g + _wd_coef() * ps_weights
         t = sketch.sketch(g) if cfg.mode == "sketch" else g
         if with_dense:
             return t, metrics, g
@@ -418,6 +460,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     def client_round(ps_weights, client_states: ClientStates, batch,
                      client_ids, rng, fedavg_lr=1.0) -> RoundResult:
         W = client_ids.shape[0]
+        real_ids = client_ids  # pre-sentinel ids for the chaos hook
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(client_ids)
 
         # dead slots (the loader pads ragged rounds with id 0 and an
@@ -454,10 +497,19 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         )(ps_weights, _some(vel_rows, W), _some(err_rows, W),
           _some(wt_rows, W), batch, rngs, fedavg_lr)
 
+        if transmit_transform is not None:
+            transmit = transmit_transform(transmit, batch, real_ids,
+                                          rng)
+
         # Σ_clients transmit, ÷ total datapoints — one all-reduce
         # (reference fed_worker.py:131-140 + fed_aggregator.py:328-334)
         total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
-        if sketch_late:
+        fold_pr = None
+        if robust:
+            from commefficient_tpu.core.robust import robust_fold
+            aggregated, fold_pr = robust_fold(cfg, transmit, batch,
+                                              probes=probes)
+        elif sketch_late:
             aggregated = _sketch_after_local_sum(
                 sketch, transmit, mesh) / total
         else:
@@ -467,6 +519,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         if probes:
             pr = _agg_probes(aggregated)
             pr.update(_client_norm_probes(transmit, batch))
+            if fold_pr:
+                pr.update(fold_pr)
             if probe_recovery and sketch_late:
                 # the dense transmits exist on this path anyway, so
                 # the ground-truth aggregate is one extra sum; the

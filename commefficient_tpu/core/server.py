@@ -110,6 +110,13 @@ def server_update(cfg: Config,
     the LR was already applied in the clients' local SGD
     (fed_aggregator.py:448-453).
 
+    Under ``--robust_agg`` (core/robust.py) ``gradient`` is already
+    the robust aggregate: mass the fold rejected (trimmed tails,
+    clipped excess, off-median clients) never reaches this function,
+    so it cannot leak into Vvelocity / Verror — the error-feedback
+    residuals only ever accumulate what the server actually applied.
+    No robust-specific handling belongs here.
+
     ``probes=True`` (a trace-time flag) additionally fills
     ``ServerUpdate.probes`` with the schema-v2 server diagnostics:
     ``update_norm`` (‖lr-scaled weight update‖), ``residual_norm``

@@ -1,0 +1,281 @@
+"""Deterministic fault + adversary injection (the chaos harness).
+
+Everything here is SEEDED and REPLAYABLE: the same ChaosConfig
+produces the same byzantine client set, the same dropout trace and
+the same host-fault schedule on every run, so a chaos test failure is
+a plain repro, not a flake. Three fault families:
+
+Byzantine clients
+    A seeded subset of client ids turns adversarial. ``label_flip``
+    poisons the DATA (y -> (num_classes-1) - y on the byzantine rows
+    of each round batch, applied by :meth:`ChaosInjector.wrap_loader`).
+    The gradient-level attacks — ``sign_flip`` (transmit x -1),
+    ``scale`` (transmit x C), ``noise`` (transmit replaced by
+    N(0, noise_std²) scaled by the client's datapoint count) — act on
+    the per-client transmit inside the jitted round via the traceable
+    function from :meth:`ChaosInjector.transmit_transform`, passed to
+    ``build_client_round(..., transmit_transform=...)``. With the
+    default ``transmit_transform=None`` the hook is never traced and
+    the round program is bit-identical to a chaos-free build (pinned
+    by the HLO-identity test).
+
+Dropout traces
+    Beyond the loader's i.i.d. ``dropout_prob``: a seeded two-state
+    Markov chain (calm/burst) drops a CORRELATED subset of the
+    round's client slots for the whole burst — the "rack went dark
+    for a few rounds" shape i.i.d. drops can't produce.
+
+Host faults
+    :class:`FlakyStore` wraps a clientstore so ``gather`` fails (or
+    stalls) on a seeded schedule — the fixture behind the prefetch
+    retry/backoff tests. :meth:`ChaosInjector.straggler_sleep`
+    simulates slow input lanes by sleeping before designated rounds'
+    batches are released, and :func:`kill_prefetch_worker` murders a
+    StorePrefetcher's thread mid-run to exercise the worker-death
+    surfacing path.
+
+Import policy: production modules must NOT import this file — chaos
+is reachable only from tests, benches and scripts (enforced by the
+``chaos-confinement`` lint rule in analysis/lint.py). The
+engine-side hook is a generic parameter; only the harness that builds
+the attack lives here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ATTACKS", "ChaosConfig", "ChaosInjector", "FlakyStore",
+           "kill_prefetch_worker"]
+
+ATTACKS = ("none", "label_flip", "sign_flip", "scale", "noise")
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    """One replayable fault scenario. All schedules derive from
+    ``seed``; a field's zero value disables that fault family."""
+
+    seed: int = 0
+    # -- byzantine clients ------------------------------------------
+    attack: str = "none"
+    byzantine_frac: float = 0.0        # fraction of the client pool
+    byzantine_ids: Optional[Sequence[int]] = None  # explicit override
+    attack_scale: float = 10.0         # C for the "scale" attack
+    noise_std: float = 1.0             # sigma for the "noise" attack
+    num_classes: int = 0               # required for label_flip
+    # -- correlated dropout trace -----------------------------------
+    burst_start_prob: float = 0.0      # calm -> burst per round
+    burst_stop_prob: float = 0.5       # burst -> calm per round
+    burst_drop_frac: float = 0.5       # slots dropped during a burst
+    # -- host faults ------------------------------------------------
+    shard_fail_prob: float = 0.0       # FlakyStore transient failures
+    shard_fail_streak: int = 1         # consecutive failures per hit
+    shard_delay_s: float = 0.0         # FlakyStore read latency
+    straggler_every: int = 0           # every Nth round is a straggler
+    straggler_delay_s: float = 0.0     # how long the slow lane sleeps
+
+    def __post_init__(self):
+        assert self.attack in ATTACKS, self.attack
+        if self.attack == "label_flip":
+            assert self.num_classes > 1, \
+                "label_flip needs ChaosConfig.num_classes"
+
+
+class ChaosInjector:
+    """Materialises one ChaosConfig against a client pool."""
+
+    def __init__(self, cfg: ChaosConfig, num_clients: int):
+        self.cfg = cfg
+        self.num_clients = int(num_clients)
+        rng = np.random.RandomState(cfg.seed)
+        if cfg.byzantine_ids is not None:
+            ids = np.asarray(sorted(set(int(i) for i
+                                        in cfg.byzantine_ids)),
+                             np.int32)
+        elif cfg.attack != "none" and cfg.byzantine_frac > 0:
+            k = max(1, int(round(cfg.byzantine_frac * num_clients)))
+            ids = np.sort(rng.choice(num_clients, size=min(
+                k, num_clients), replace=False)).astype(np.int32)
+        else:
+            ids = np.zeros((0,), np.int32)
+        self.byzantine = ids
+        # independent streams so toggling one fault family never
+        # perturbs another's schedule
+        self._drop_rng = np.random.RandomState(cfg.seed + 1)
+        self._noise_seed = cfg.seed + 2
+        self._in_burst = False
+        self._burst_slots: Optional[np.ndarray] = None
+        self._round = 0
+
+    # -- byzantine side ---------------------------------------------
+
+    def is_byzantine(self, client_ids) -> np.ndarray:
+        return np.isin(np.asarray(client_ids), self.byzantine)
+
+    def poison_batch(self, batch: dict) -> dict:
+        """label_flip: y -> (num_classes-1) - y on byzantine rows.
+        Other attacks act on transmits, not data — no-op here."""
+        if self.cfg.attack != "label_flip" or "y" not in batch:
+            return batch
+        bad = self.is_byzantine(batch["client_ids"])
+        if not bad.any():
+            return batch
+        batch = dict(batch)
+        y = batch["y"].copy()
+        y[bad] = (self.cfg.num_classes - 1) - y[bad]
+        batch["y"] = y
+        return batch
+
+    def transmit_transform(self):
+        """A traceable (transmit, batch, client_ids, rng) -> transmit
+        for ``build_client_round``, or None when the configured attack
+        lives at the data level. Byzantine membership is tested inside
+        the trace (jnp.isin against the seeded id set), so one
+        compiled round serves every round's client draw."""
+        if self.cfg.attack not in ("sign_flip", "scale", "noise"):
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        byz = jnp.asarray(self.byzantine)
+        attack = self.cfg.attack
+        C = float(self.cfg.attack_scale)
+        sigma = float(self.cfg.noise_std)
+        noise_seed = self._noise_seed
+
+        def transform(transmit, batch, client_ids, rng):
+            if byz.size == 0:
+                return transmit
+            bad = jnp.isin(client_ids, byz)
+            badx = bad.reshape((-1,) + (1,) * (transmit.ndim - 1))
+            if attack == "sign_flip":
+                evil = -transmit
+            elif attack == "scale":
+                evil = C * transmit
+            else:  # noise: transmit = sigma*N(0,1) * datapoint count,
+                # matching the honest transmit's batch-size scaling
+                n = jnp.sum(batch["mask"],
+                            axis=tuple(range(1, batch["mask"].ndim)))
+                nx = n.reshape(badx.shape)
+                nrng = jax.random.fold_in(
+                    jax.random.fold_in(rng, noise_seed), 7)
+                evil = sigma * jax.random.normal(
+                    nrng, transmit.shape, transmit.dtype) * nx
+            return jnp.where(badx, evil, transmit)
+
+        return transform
+
+    # -- dropout trace ----------------------------------------------
+
+    def _advance_burst(self, W: int):
+        c = self.cfg
+        if self._in_burst:
+            if self._drop_rng.rand() < c.burst_stop_prob:
+                self._in_burst, self._burst_slots = False, None
+        elif c.burst_start_prob > 0 \
+                and self._drop_rng.rand() < c.burst_start_prob:
+            self._in_burst = True
+            k = max(1, int(round(c.burst_drop_frac * W)))
+            self._burst_slots = self._drop_rng.choice(
+                W, size=min(k, W), replace=False)
+
+    def drop_slots(self, W: int) -> Optional[np.ndarray]:
+        """This round's correlated-drop slot indices (None when calm).
+        The same subset holds for the burst's whole lifetime."""
+        self._advance_burst(W)
+        return self._burst_slots if self._in_burst else None
+
+    # -- loader wrapping --------------------------------------------
+
+    def wrap_loader(self, loader) -> Iterator[dict]:
+        """Iterate ``loader`` with data poisoning, the correlated
+        dropout trace and straggler sleeps applied, in round order.
+        len() and peek_next_client_ids pass through untouched on the
+        wrapper object returned by :meth:`wrap`."""
+        c = self.cfg
+        for batch in loader:
+            self._round += 1
+            if c.straggler_every > 0 and c.straggler_delay_s > 0 \
+                    and self._round % c.straggler_every == 0:
+                time.sleep(c.straggler_delay_s)
+            batch = self.poison_batch(batch)
+            slots = self.drop_slots(batch["mask"].shape[0])
+            if slots is not None and len(slots):
+                batch = dict(batch)
+                mask = batch["mask"].copy()
+                mask[slots] = 0.0
+                batch["mask"] = mask
+            yield batch
+
+    def wrap(self, loader):
+        return _ChaosLoader(self, loader)
+
+
+class _ChaosLoader:
+    """Loader facade: chaos-wrapped iteration, everything else
+    delegated (len, W/B, peek_next_client_ids for the prefetch
+    feed)."""
+
+    def __init__(self, injector: ChaosInjector, loader):
+        self._injector = injector
+        self._loader = loader
+
+    def __iter__(self):
+        return self._injector.wrap_loader(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
+class FlakyStore:
+    """Clientstore wrapper whose ``gather`` transiently fails and/or
+    stalls on a seeded schedule — the fixture behind the prefetch
+    retry/backoff tests. A scheduled hit raises for
+    ``shard_fail_streak`` consecutive attempts, then succeeds: with
+    bounded retry (3 tries) a streak of 2 recovers invisibly and a
+    streak of 3+ surfaces as the worker-death RuntimeError."""
+
+    def __init__(self, store, cfg: ChaosConfig):
+        self._store = store
+        self._cfg = cfg
+        self._rng = np.random.RandomState(cfg.seed + 3)
+        self._streak_left = 0
+        self.attempts = 0
+        self.failures = 0
+
+    def gather(self, ids, out=None):
+        self.attempts += 1
+        if self._cfg.shard_delay_s > 0:
+            time.sleep(self._cfg.shard_delay_s)
+        if self._streak_left == 0 \
+                and self._cfg.shard_fail_prob > 0 \
+                and self._rng.rand() < self._cfg.shard_fail_prob:
+            self._streak_left = max(1, int(self._cfg.shard_fail_streak))
+        if self._streak_left > 0:
+            self._streak_left -= 1
+            self.failures += 1
+            raise OSError("chaos: transient shard read failure")
+        return self._store.gather(ids, out=out)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
+def kill_prefetch_worker(prefetcher) -> None:
+    """Simulate a prefetch-worker crash: poison the work queue so the
+    worker thread exits its loop as if it had died mid-run. The next
+    ``take``/``submit`` must surface the PR-2 worker-death
+    RuntimeError rather than hang."""
+    fail = getattr(prefetcher, "_fail_for_test", None)
+    if callable(fail):
+        fail(RuntimeError("chaos: prefetch worker killed"))
+        return
+    raise RuntimeError("prefetcher exposes no kill hook")

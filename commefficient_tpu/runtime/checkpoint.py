@@ -62,8 +62,16 @@ def _atomic_savez(path: str, **arrays):
 def save_checkpoint(path: str, model, opt, scheduler=None,
                     sampler=None, epoch: int = 0,
                     extra: Optional[dict] = None,
-                    loader=None) -> str:
-    """Serialise the full runtime state to ``path`` (.npz)."""
+                    loader=None, mid_epoch: bool = False) -> str:
+    """Serialise the full runtime state to ``path`` (.npz).
+
+    ``mid_epoch=True`` (the round-cadence autosaver) additionally
+    captures the sampler's LIVE epoch state — permutation, per-client
+    cursors, the lookahead's buffered round spec and the post-draw
+    RNG — so a resumed run continues the interrupted epoch's
+    remaining rounds bit-exactly instead of restarting the epoch.
+    Epoch-boundary saves must NOT set it: their exhausted iterator
+    state would make the resumed epoch yield zero rounds."""
     if getattr(model, "_inflight", None):
         # flushing here would drop the flushed rounds' metrics and
         # desync the trainer's pending queue — the caller must drain
@@ -169,6 +177,31 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
     # its round counter
     if loader is not None and hasattr(loader, "_round_counter"):
         meta["loader_round_counter"] = int(loader._round_counter)
+    # --dropout_prob draws from the loader's own RNG stream every
+    # round — capture it or a resumed run replays drops from the
+    # re-seeded stream while the uninterrupted run's had advanced
+    dr = getattr(loader, "_dropout_rng", None)
+    if dr is not None and hasattr(dr, "get_state"):
+        g = dr.get_state()
+        meta["dropout_rng"] = [g[0], None, int(g[2]), int(g[3]),
+                               float(g[4])]
+        arrays["dropout_rng_keys"] = np.asarray(g[1])
+    if mid_epoch and sampler is not None \
+            and hasattr(sampler, "export_state"):
+        st = sampler.export_state()
+        if st is not None:
+            meta["sampler_mid_epoch"] = True
+            arrays["sampler_mid_permuted"] = np.asarray(st["permuted"])
+            arrays["sampler_mid_cur"] = np.asarray(st["cur"])
+            if st.get("rng_state") is not None:
+                rs = st["rng_state"]
+                meta["sampler_mid_rng"] = [rs[0], None, int(rs[2]),
+                                           int(rs[3]), float(rs[4])]
+                arrays["sampler_mid_rng_keys"] = np.asarray(rs[1])
+            if st.get("spec_workers") is not None:
+                arrays["sampler_mid_spec_workers"] = st["spec_workers"]
+                arrays["sampler_mid_spec_sizes"] = st["spec_sizes"]
+                arrays["sampler_mid_spec_idx"] = st["spec_idx"]
 
     # every process gathered (the allgathers above are collectives)
     # but exactly one writes — concurrent writers on a shared
@@ -415,22 +448,122 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
         if loader is not None and "loader_round_counter" in meta \
                 and hasattr(loader, "_round_counter"):
             loader._round_counter = meta["loader_round_counter"]
+        dr = getattr(loader, "_dropout_rng", None)
+        if dr is not None and "dropout_rng" in meta \
+                and hasattr(dr, "set_state"):
+            g = meta["dropout_rng"]
+            dr.set_state((g[0], np.asarray(z["dropout_rng_keys"]),
+                          g[2], g[3], g[4]))
+        if sampler is not None and meta.get("sampler_mid_epoch") \
+                and hasattr(sampler, "import_state"):
+            st = {"permuted": np.asarray(z["sampler_mid_permuted"]),
+                  "cur": np.asarray(z["sampler_mid_cur"])}
+            if "sampler_mid_rng" in meta:
+                r = meta["sampler_mid_rng"]
+                st["rng_state"] = (
+                    r[0], np.asarray(z["sampler_mid_rng_keys"]),
+                    r[2], r[3], r[4])
+            if "sampler_mid_spec_workers" in z.files:
+                st["spec_workers"] = np.asarray(
+                    z["sampler_mid_spec_workers"])
+                st["spec_sizes"] = np.asarray(
+                    z["sampler_mid_spec_sizes"])
+                st["spec_idx"] = np.asarray(z["sampler_mid_spec_idx"])
+            sampler.import_state(st)
     return meta
 
 
+def history_file(directory: str, tag: str, round_index: int) -> str:
+    """A retained autosave snapshot's path (round-stamped)."""
+    return os.path.join(directory,
+                        f"ckpt_{tag}_r{int(round_index):08d}.npz")
+
+
+class RoundAutosaver:
+    """``--checkpoint_every_rounds`` round-cadence autosave.
+
+    Called from the trainers' round loop after every completed round.
+    Saves a ``mid_epoch`` checkpoint at the configured cadence —
+    skipping rounds whose pipelined dispatches are still inflight
+    (forcing a drain on the hot path would serialise the pipeline;
+    the next eligible round retries) — then retains up to
+    ``--checkpoint_keep`` round-stamped history snapshots via
+    hardlinks to the just-written archive (zero copy cost; falls
+    back to a copy on link-hostile filesystems) and prunes the
+    oldest beyond the budget. A SIGTERM at any point leaves either
+    the previous or the new checkpoint intact — never a torn one
+    (the save itself is tmp+rename atomic)."""
+
+    def __init__(self, args, model, opt, scheduler, sampler, loader,
+                 tag: str):
+        self.every = int(getattr(args, "checkpoint_every_rounds", 0)
+                         or 0)
+        self.keep = int(getattr(args, "checkpoint_keep", 0) or 0)
+        self.args = args
+        self.model, self.opt, self.scheduler = model, opt, scheduler
+        self.sampler, self.loader, self.tag = sampler, loader, tag
+        self.path = checkpoint_file(args.checkpoint_path, tag)
+        self._last_saved = -1
+
+    def __call__(self, epoch: int):
+        """``epoch``: the 0-based epoch currently in progress (a
+        mid-epoch resume re-enters this same epoch)."""
+        if self.every <= 0:
+            return
+        r = int(self.model.round_index)
+        if r <= 0 or r % self.every or r == self._last_saved:
+            return
+        if getattr(self.model, "_inflight", None):
+            return
+        save_checkpoint(self.path, self.model, self.opt,
+                        self.scheduler, self.sampler, epoch=int(epoch),
+                        loader=self.loader, mid_epoch=True)
+        self._last_saved = r
+        if self.keep > 0 and jax.process_index() == 0:
+            self._retain(r)
+
+    def _retain(self, round_index: int):
+        import re
+        import shutil
+        hist = history_file(self.args.checkpoint_path, self.tag,
+                            round_index)
+        if not os.path.exists(hist):
+            try:
+                os.link(self.path, hist)
+            except OSError:
+                shutil.copy2(self.path, hist)
+        pat = re.compile(
+            rf"^ckpt_{re.escape(self.tag)}_r(\d+)\.npz$")
+        snaps = sorted(
+            (int(m.group(1)), m.group(0))
+            for m in (pat.match(n) for n in
+                      os.listdir(self.args.checkpoint_path))
+            if m)
+        for _, name in snaps[:-self.keep]:
+            try:
+                os.unlink(os.path.join(self.args.checkpoint_path,
+                                       name))
+            except OSError:
+                pass
+
+
 def setup_resume(args, model, opt, scheduler, loader, tag: str):
-    """Shared trainer wiring: returns ``(start_epoch, epoch_hook)``.
+    """Shared trainer wiring: returns
+    ``(start_epoch, epoch_hook, round_hook)``.
 
     - ``--resume`` requires ``--checkpoint`` and an existing file —
       anything else raises instead of silently training from scratch
       (and then overwriting the directory's checkpoints).
     - ``epoch_hook`` saves every ``--checkpoint_every`` epochs and at
       the end of training.
+    - ``round_hook(epoch)`` is the :class:`RoundAutosaver` when
+      ``--checkpoint_every_rounds`` is set (None otherwise); the
+      trainers call it after every completed round.
     """
     import math
 
     if not (args.do_checkpoint or args.do_resume):
-        return 0, None
+        return 0, None, None
     if args.do_resume and not args.do_checkpoint:
         raise ValueError("--resume requires --checkpoint")
     path = checkpoint_file(args.checkpoint_path, tag)
@@ -443,7 +576,9 @@ def setup_resume(args, model, opt, scheduler, loader, tag: str):
         meta = load_checkpoint(path, model, opt, scheduler, sampler,
                                loader)
         start_epoch = meta["epoch"]
-        print(f"resumed from {path} at epoch {start_epoch}")
+        print(f"resumed from {path} at epoch {start_epoch}"
+              + (" (mid-epoch)" if meta.get("sampler_mid_epoch")
+                 else ""))
 
     def epoch_hook(ep):
         if (args.checkpoint_every
@@ -452,4 +587,8 @@ def setup_resume(args, model, opt, scheduler, loader, tag: str):
             save_checkpoint(path, model, opt, scheduler, sampler,
                             epoch=ep, loader=loader)
 
-    return start_epoch, epoch_hook
+    round_hook = None
+    if int(getattr(args, "checkpoint_every_rounds", 0) or 0) > 0:
+        round_hook = RoundAutosaver(args, model, opt, scheduler,
+                                    sampler, loader, tag)
+    return start_epoch, epoch_hook, round_hook

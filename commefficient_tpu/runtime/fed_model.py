@@ -315,6 +315,24 @@ class FedModel:
             self.client_store = None
         self.telemetry.close()
 
+    def interrupted(self):
+        """Crash-safety cleanup after a mid-round SIGTERM/exception:
+        discard every partially-dispatched round's host-side state so
+        ``finalize()`` (device barrier, store teardown, telemetry
+        close) runs cleanly. Server state and residuals are left
+        untouched — the last round-cadence autosave is the consistent
+        restore point, and dropping the in-flight rounds keeps both
+        the ledger and the client store free of rounds the checkpoint
+        never saw (a half-written-back round would desync store rows
+        from the checkpointed server state)."""
+        self._inflight = []
+        self._oplog = []
+        self._probe_log = {}
+        self._probe_host = {}
+        self.pending_aggregated = None
+        self.pending_client_ids = None
+        self._store_pending = None
+
     # --- host client store (commefficient_tpu/clientstore) ---------------
 
     def attach_participant_feed(self, feed: Callable):

@@ -29,6 +29,20 @@ round instead of silently training on garbage. Three rules:
                        on synchronous rounds only — pipelined
                        dispatch times measure the host, not the
                        round.
+``byzantine_suspect`` — a per-client transmit-norm outlier:
+                       ``client_norm_max`` above
+                       ``--alarm_byzantine_ratio`` x
+                       ``client_norm_mean``. Sign-flip hides inside
+                       the norm distribution; scaling/noise attacks
+                       stick out here even when a robust fold has
+                       already neutralised them — the operator wants
+                       the *name* of the problem, not just survival.
+``fold_rejection_rate`` — the robust fold (``--robust_agg``)
+                       deviated from the plain mean by more than
+                       ``--alarm_fold_rejection`` (relative). High
+                       rejection means the fold is actively fighting
+                       someone; sustained high rejection on honest
+                       data means the trim/clip is set too tight.
 ``collective_skew``  — trace-derived (schema-v4 ``device_time``): a
                        profiled round's straggler wait dominates its
                        collective bucket — max cross-device
@@ -98,6 +112,10 @@ class AlarmEngine:
             getattr(cfg, "alarm_step_time_window", 16) or 16)
         self.collective_skew = float(
             getattr(cfg, "alarm_collective_skew", 0.0) or 0.0)
+        self.byzantine_ratio = float(
+            getattr(cfg, "alarm_byzantine_ratio", 0.0) or 0.0)
+        self.fold_rejection = float(
+            getattr(cfg, "alarm_fold_rejection", 0.0) or 0.0)
         self.telemetry = telemetry
         self._consecutive = 0
         self._step_times = deque(maxlen=self.step_time_window)
@@ -136,6 +154,29 @@ class AlarmEngine:
             fired.append({"rule": "recovery_error",
                           "value": float(rerr),
                           "threshold": self.recovery_error})
+
+        if self.byzantine_ratio > 0:
+            cmax = probes.get("client_norm_max")
+            cmean = probes.get("client_norm_mean")
+            if cmax is not None and cmean is not None:
+                ratio = (float(cmax) / float(cmean)
+                         if float(cmean) > 0 else
+                         (math.inf if float(cmax) > 0 else 0.0))
+                if not _finite(ratio) \
+                        or ratio > self.byzantine_ratio:
+                    fired.append({"rule": "byzantine_suspect",
+                                  "value": float(ratio),
+                                  "threshold": self.byzantine_ratio,
+                                  "client_norm_max": float(cmax),
+                                  "client_norm_mean": float(cmean)})
+
+        if self.fold_rejection > 0:
+            frr = probes.get("fold_rejection_rate")
+            if frr is not None and (not _finite(frr)
+                                    or frr > self.fold_rejection):
+                fired.append({"rule": "fold_rejection_rate",
+                              "value": float(frr),
+                              "threshold": self.fold_rejection})
 
         return self._escalate(round_index, fired)
 
@@ -214,6 +255,10 @@ def build_alarm_engine(cfg, telemetry=None):
             or float(getattr(cfg, "alarm_step_time_ratio", 0.0)
                      or 0.0) > 0
             or float(getattr(cfg, "alarm_collective_skew", 0.0)
+                     or 0.0) > 0
+            or float(getattr(cfg, "alarm_byzantine_ratio", 0.0)
+                     or 0.0) > 0
+            or float(getattr(cfg, "alarm_fold_rejection", 0.0)
                      or 0.0) > 0):
         return AlarmEngine(cfg, telemetry)
     return None

@@ -340,6 +340,13 @@ def build_telemetry(args, extra_sinks=(), process_index=None,
     ``process_index``/``process_count`` default to the live jax
     runtime; tests inject them to exercise the shard layout without a
     multi-process mesh.
+
+    ``--resume`` runs append to the SAME ledger: the sink truncates
+    any torn tail the interrupted writer left, then drops replayed
+    round records at or below the file's last recorded round id, so
+    the resumed ledger's round ids stay monotone and deduplicated
+    (replay is bit-exact from the checkpoint, so dropping the
+    duplicates loses nothing).
     """
     sinks = list(extra_sinks)
     path = getattr(args, "ledger", "") or ""
@@ -355,11 +362,16 @@ def build_telemetry(args, extra_sinks=(), process_index=None,
         pidx, pcount = int(process_index), int(process_count)
         from commefficient_tpu.telemetry.sinks import (ConsoleSink,
                                                        JSONLSink,
+                                                       last_round_index,
                                                        shard_ledger_path)
         if path:
             spath = shard_ledger_path(path, pidx)
             stamp = pidx if pcount > 1 else None
-            sinks.append(JSONLSink(spath, process=stamp))
+            resume_after = (last_round_index(spath)
+                            if getattr(args, "do_resume", False)
+                            else None)
+            sinks.append(JSONLSink(spath, process=stamp,
+                                   resume_after=resume_after))
             if pidx != 0:
                 print(f"telemetry: process {pidx}/{pcount} writing "
                       f"ledger shard {spath} (process 0 owns the "

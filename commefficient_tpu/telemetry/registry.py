@@ -140,9 +140,17 @@ def write_manifest(runs_dir: str = "runs", *, args=None,
     while os.path.exists(path):
         path = os.path.join(out_dir, f"{name}.{n}.json")
         n += 1
-    with open(path, "w") as f:
+    # tmp + rename: a writer killed mid-dump must never leave a
+    # half-written manifest at the canonical name (list_manifests
+    # skips unparseable files, but a torn manifest would silently
+    # drop the run from the registry; the orphaned .tmp is inert)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(rec, f, indent=1, sort_keys=True)
         f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
     return path
 
 

@@ -27,25 +27,98 @@ def shard_ledger_path(path: str, process_index: int) -> str:
     return path if k == 0 else f"{path}.p{k}.jsonl"
 
 
-class JSONLSink:
-    """One JSON object per line, appended to ``path``; flushed per
-    record (rounds are coarse enough that durability wins). When
-    ``process`` is given, every record is stamped with that jax
-    process index (multi-host shards stay attributable post-merge)."""
+def recover_torn_tail(path: str) -> int:
+    """Truncate a JSONL file's torn last line in place, if any.
 
-    def __init__(self, path: str, process=None):
+    A writer killed mid-write (SIGKILL, power loss) can leave a
+    partial final line. Every complete line ends with ``\\n`` and
+    parses as JSON; anything after the last newline — or a final
+    newline-terminated line that does not parse — is the torn tail.
+    Returns the number of bytes dropped (0 for a clean file)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    if size == 0:
+        return 0
+    with open(path, "rb+") as f:
+        # scan back from EOF for the last complete line boundary
+        f.seek(0, os.SEEK_END)
+        end = f.tell()
+        f.seek(max(0, end - 1))
+        keep = end
+        if f.read(1) != b"\n":
+            # no trailing newline: drop everything past the previous
+            # one (the whole file, if it is a single torn line)
+            chunk = min(end, 1 << 16)
+            f.seek(end - chunk)
+            tail = f.read(chunk)
+            nl = tail.rfind(b"\n")
+            keep = end - chunk + nl + 1 if nl >= 0 else 0
+        if keep != end:
+            f.truncate(keep)
+    return size - keep
+
+
+def last_round_index(path: str):
+    """Max round id among a ledger's round records (None when the
+    file is missing/empty/has no round records). Unparseable lines
+    are skipped — read-side torn tolerance."""
+    last = None
+    try:
+        f = open(path)
+    except OSError:
+        return None
+    with f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "round":
+                r = rec.get("round")
+                if r is not None and (last is None or r > last):
+                    last = int(r)
+    return last
+
+
+class JSONLSink:
+    """One JSON object per line, appended to ``path``; each record is
+    serialised to its full line FIRST, then written with a single
+    ``write`` + flush — a crash between records leaves a clean file,
+    and a crash mid-write leaves at most one torn tail, which the
+    append-open truncates away (``recover_torn_tail``). When
+    ``process`` is given, every record is stamped with that jax
+    process index (multi-host shards stay attributable post-merge).
+
+    ``resume_after``: round records with ``round`` <= this id are
+    silently dropped — the resume path replays from the last
+    checkpoint, and bit-exact replay would otherwise duplicate the
+    rounds the previous run already recorded (pass
+    ``last_round_index(path)`` to keep ledger round ids monotone and
+    deduplicated across a crash/resume cycle)."""
+
+    def __init__(self, path: str, process=None, resume_after=None):
         self.path = path
         self.process = None if process is None else int(process)
+        self.resume_after = (None if resume_after is None
+                             else int(resume_after))
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
+        recover_torn_tail(path)
         self._f = open(path, "a")
 
     def write(self, rec):
+        if self.resume_after is not None \
+                and rec.get("kind") == "round" \
+                and rec.get("round") is not None \
+                and int(rec["round"]) <= self.resume_after:
+            return
         if self.process is not None:
             rec = dict(rec, process=self.process)
-        json.dump(rec, self._f, separators=(",", ":"),
-                  default=_json_default)
-        self._f.write("\n")
+        line = json.dumps(rec, separators=(",", ":"),
+                          default=_json_default) + "\n"
+        self._f.write(line)
         self._f.flush()
 
     def close(self):

@@ -175,8 +175,12 @@ def apply_mixup(batch, alpha, rng):
 
 
 def run_batches(model, opt, lr_scheduler, loader, args, training,
-                logger=None, epoch_fraction=1.0, mixup_rng=None):
-    """(reference cv_train.py:171-252)"""
+                logger=None, epoch_fraction=1.0, mixup_rng=None,
+                round_hook=None, epoch=0):
+    """(reference cv_train.py:171-252). ``round_hook(epoch)`` runs
+    after every completed round (round-cadence autosave,
+    runtime/checkpoint.py RoundAutosaver; it skips itself while
+    pipelined rounds are still in flight)."""
     if training:
         model.train(True)
         losses, accs = [], []
@@ -255,6 +259,8 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
                         return None
                 elif not process(metrics, i, w, lr_now):
                     return None
+                if round_hook is not None:
+                    round_hook(epoch)
                 if args.do_test:
                     break
             if not drain_rounds(model, pending, process, force=True):
@@ -287,9 +293,11 @@ def run_batches(model, opt, lr_scheduler, loader, args, training,
 
 
 def train(model, opt, lr_scheduler, train_loader, val_loader, args,
-          logger=None, timer=None, start_epoch=0, epoch_hook=None):
+          logger=None, timer=None, start_epoch=0, epoch_hook=None,
+          round_hook=None):
     """Epoch loop (reference cv_train.py:85-168). ``epoch_hook(ep)``
-    runs after each completed epoch (checkpointing)."""
+    runs after each completed epoch and ``round_hook(epoch)`` after
+    each completed round (checkpointing)."""
     from commefficient_tpu.telemetry.profiler import profile_epoch
     from commefficient_tpu.telemetry.sinks import TensorBoardSink
     from commefficient_tpu.utils import make_logdir
@@ -316,7 +324,8 @@ def train(model, opt, lr_scheduler, train_loader, val_loader, args,
                 out = run_batches(model, opt, lr_scheduler,
                                   train_loader, args, training=True,
                                   epoch_fraction=epoch_fraction,
-                                  mixup_rng=mixup_rng)
+                                  mixup_rng=mixup_rng,
+                                  round_hook=round_hook, epoch=epoch)
             if out is None:
                 print("NaN detected, aborting training")
                 return results
@@ -563,21 +572,36 @@ def main(argv=None):
         lr_scheduler = LambdaLR(opt, lambda x: lambda_step(x))
 
     from commefficient_tpu.runtime.checkpoint import setup_resume
-    start_epoch, epoch_hook = setup_resume(args, model, opt,
-                                           lr_scheduler, train_loader,
-                                           tag=args.model)
+    start_epoch, epoch_hook, round_hook = setup_resume(
+        args, model, opt, lr_scheduler, train_loader, tag=args.model)
 
-    results = train(model, opt, lr_scheduler, train_loader, val_loader,
-                    args, start_epoch=start_epoch,
-                    epoch_hook=epoch_hook)
+    from commefficient_tpu.utils import GracefulShutdown, sigterm_raises
+    interrupted = False
+    try:
+        with sigterm_raises():
+            results = train(model, opt, lr_scheduler, train_loader,
+                            val_loader, args, start_epoch=start_epoch,
+                            epoch_hook=epoch_hook,
+                            round_hook=round_hook)
+    except GracefulShutdown as e:
+        # crash safety: drop in-flight round state, close everything
+        # cleanly, and save NOTHING here — the last round-cadence
+        # autosave is the consistent resume point, and an end-of-run
+        # save now would capture a mid-round server state
+        print(f"interrupted ({e}); resume from the last autosave")
+        interrupted = True
+        results = []
+        model.interrupted()
     model.finalize()
     from commefficient_tpu.telemetry import registry
     registry.maybe_write_manifest(
         args, mesh_shape=dict(model.mesh.shape),
         extra={"trainer": "cv_train", "epochs": len(results),
+               "interrupted": interrupted,
                "diverged": bool(getattr(model, "diverged", False))})
 
-    if args.do_checkpoint and jax.process_index() == 0:
+    if args.do_checkpoint and not interrupted \
+            and jax.process_index() == 0:
         # params are replicated — one writer on a shared filesystem
         import os
         import pickle

@@ -154,8 +154,10 @@ def make_compute_loss_val(module, args):
     return compute_loss
 
 
-def run_batches(model, opt, lr_scheduler, loader, args, training):
-    """(reference gpt2_train.py:169-253)"""
+def run_batches(model, opt, lr_scheduler, loader, args, training,
+                round_hook=None, epoch=0):
+    """(reference gpt2_train.py:169-253). ``round_hook(epoch)`` runs
+    after every completed round (round-cadence autosave)."""
     if training:
         model.train(True)
         losses = []
@@ -196,6 +198,8 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
                         return None
                 elif not process(metrics, i, w):
                     return None
+                if round_hook is not None:
+                    round_hook(epoch)
                 if args.do_test:
                     break
             if not drain_rounds(model, pending, process, force=True):
@@ -226,7 +230,7 @@ def run_batches(model, opt, lr_scheduler, loader, args, training):
 
 def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
                args, logger=None, start_epoch=0, epoch_hook=None,
-               logdir=None):
+               round_hook=None, logdir=None):
     """(reference gpt2_train.py:115-147)"""
     from commefficient_tpu.telemetry.profiler import profile_epoch
     from commefficient_tpu.telemetry.sinks import TensorBoardSink
@@ -248,7 +252,9 @@ def train_gpt2(model, opt, lr_scheduler, train_loader, val_loader,
                                telemetry=tel):
                 train_loss = run_batches(model, opt, lr_scheduler,
                                          train_loader, args,
-                                         training=True)
+                                         training=True,
+                                         round_hook=round_hook,
+                                         epoch=epoch)
             if train_loss is None:
                 print("NaN detected, aborting")
                 model.diverged = True
@@ -441,9 +447,8 @@ def main(argv=None):
         return out
 
     from commefficient_tpu.runtime.checkpoint import setup_resume
-    start_epoch, epoch_hook = setup_resume(args, model, opt,
-                                           lr_scheduler, train_loader,
-                                           tag="gpt2")
+    start_epoch, epoch_hook, round_hook = setup_resume(
+        args, model, opt, lr_scheduler, train_loader, tag="gpt2")
 
     if args.eval_before_start and start_epoch == 0:
         # (reference gpt2_train.py:207 via --eval_before_start);
@@ -458,17 +463,31 @@ def main(argv=None):
     # computes log_dir once at startup, :278-283)
     from commefficient_tpu.utils import make_logdir
     logdir = make_logdir(args) if not args.do_test else None
-    results = train_gpt2(model, opt, lr_scheduler, train_loader,
-                         val_loader, args, start_epoch=start_epoch,
-                         epoch_hook=epoch_hook, logdir=logdir)
+    from commefficient_tpu.utils import GracefulShutdown, sigterm_raises
+    interrupted = False
+    try:
+        with sigterm_raises():
+            results = train_gpt2(model, opt, lr_scheduler,
+                                 train_loader, val_loader, args,
+                                 start_epoch=start_epoch,
+                                 epoch_hook=epoch_hook,
+                                 round_hook=round_hook, logdir=logdir)
+    except GracefulShutdown as e:
+        # crash safety: see cv_train.main — no save here; the last
+        # round-cadence autosave is the consistent resume point
+        print(f"interrupted ({e}); resume from the last autosave")
+        interrupted = True
+        results = []
+        model.interrupted()
     model.finalize()
     from commefficient_tpu.telemetry import registry
     registry.maybe_write_manifest(
         args, mesh_shape=dict(model.mesh.shape),
         extra={"trainer": "gpt2_train", "epochs": len(results),
+               "interrupted": interrupted,
                "diverged": bool(getattr(model, "diverged", False))})
     if logdir is not None and not getattr(model, "diverged", False) \
-            and jax.process_index() == 0:
+            and not interrupted and jax.process_index() == 0:
         # reference gpt2_train.py:146, 278-283: final model + tokenizer
         # saved HF-style into the run's logdir (skipped after a NaN
         # abort — diverged weights are not a final model)

@@ -7,12 +7,50 @@ Exp, TableLogger, TSVLogger, Timer, make_logdir).
 from __future__ import annotations
 
 import os
+import signal
+import threading
 from collections import namedtuple
+from contextlib import contextmanager
 from datetime import datetime
 
 import numpy as np
 
 from commefficient_tpu.telemetry import clock
+
+
+class GracefulShutdown(Exception):
+    """Raised in the main thread when a termination signal arrives
+    (``sigterm_raises``). Unwinds the round loop so the trainer can run
+    crash-safety cleanup (``FedModel.interrupted`` + ``finalize``)
+    instead of dying mid-write; the last round-cadence autosave plus
+    the ledger's torn-tail recovery make the run resumable."""
+
+    def __init__(self, signum: int):
+        super().__init__(f"received signal {signum}")
+        self.signum = signum
+
+
+@contextmanager
+def sigterm_raises(signums=(signal.SIGTERM,)):
+    """Install handlers that raise ``GracefulShutdown``; priors are
+    restored on exit. Degrades to a no-op outside the main thread
+    (where ``signal.signal`` is illegal) so tests can call trainer
+    main()s from worker threads."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def _handler(signum, frame):
+        raise GracefulShutdown(signum)
+
+    prev = {}
+    for s in signums:
+        prev[s] = signal.signal(s, _handler)
+    try:
+        yield
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
 
 
 class Logger:

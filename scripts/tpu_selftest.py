@@ -297,6 +297,54 @@ def scaling_smoke():
         shutil.rmtree(runs_dir, ignore_errors=True)
 
 
+def chaos_smoke():
+    """Byzantine sign-flip under --robust_agg median on the REAL
+    backend: a flipped minority must leave the robust fold's aggregate
+    at the honest gradient while the plain mean is dragged off by the
+    flipped mass — the engine guarantee the chaos-harness tests pin on
+    the CPU mesh, exercised here on hardware."""
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.core.rounds import (ClientStates,
+                                               build_client_round)
+    from commefficient_tpu.data.chaos import ChaosConfig, ChaosInjector
+
+    W, B, d = 8, 4, 1 << 14
+
+    def lin_loss(p, b):
+        n = jnp.maximum(jnp.sum(b["mask"]), 1.0)
+        loss = jnp.sum((b["c"] @ p) * b["mask"]) / n
+        return loss, (loss * 0.0,)
+
+    inj = ChaosInjector(ChaosConfig(seed=5, attack="sign_flip",
+                                    byzantine_ids=(1, 5)),
+                        num_clients=W)
+    transform = inj.transmit_transform()
+    c = np.random.RandomState(0).randn(1, 1, d).astype(np.float32)
+    batch = {"c": jnp.asarray(np.broadcast_to(c, (W, B, d))),
+             "mask": jnp.ones((W, B), jnp.float32)}
+    flat = jnp.zeros((d,), jnp.float32)
+    aggs = {}
+    for agg_mode in ("none", "median"):
+        cfg = Config(mode="uncompressed", error_type="none",
+                     local_momentum=0.0, num_workers=W,
+                     local_batch_size=B, seed=5, robust_agg=agg_mode)
+        cfg.grad_size = d
+        cr = jax.jit(build_client_round(cfg, lin_loss, B,
+                                        transmit_transform=transform))
+        res = cr(flat, ClientStates.init(cfg, W, flat), batch,
+                 jnp.arange(W, dtype=jnp.int32), jax.random.PRNGKey(0),
+                 1.0)
+        aggs[agg_mode] = np.asarray(res.aggregated)
+    honest = c[0, 0]
+    scale = np.linalg.norm(honest)
+    err_med = np.linalg.norm(aggs["median"] - honest) / scale
+    err_plain = np.linalg.norm(aggs["none"] - honest) / scale
+    # 2/8 flipped: plain mean = 0.5*honest (err 0.5); median = honest
+    assert err_med < 1e-4, err_med
+    assert err_plain > 0.25, err_plain
+    return f"median err {err_med:.1e}; plain mean err {err_plain:.2f}"
+
+
 def bench_throughput():
     """Headline bench must clear the BASELINE north-star (>= 8x)."""
     import json
@@ -319,6 +367,7 @@ def main():
     check("trace_smoke", trace_smoke)
     check("scaling_smoke", scaling_smoke)
     check("flash_attention_parity", flash_attention_parity)
+    check("chaos_smoke", chaos_smoke)
     check("bench_vs_baseline", bench_throughput)
     if FAILED:
         print(f"\n{len(FAILED)} check(s) failed: {FAILED}")

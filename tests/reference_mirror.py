@@ -26,6 +26,78 @@ def np_topk(v, k):
     return out
 
 
+# robust folds (mirror of core/robust.py) ------------------------------
+
+_TINY = 1e-12
+
+
+def np_masked_median(vals, alive):
+    """Coordinate-wise median over alive rows; same rank formula as
+    core/robust._masked_median (dead rows sort to +inf)."""
+    G = vals.shape[0]
+    s = np.sort(np.where(alive[:, None], vals, np.inf), axis=0)
+    k = int(np.sum(alive))
+    if k == 0:
+        return np.zeros(vals.shape[1])
+    lo = min(max((k - 1) // 2, 0), G - 1)
+    hi = min(k // 2, G - 1)
+    return 0.5 * (s[lo] + s[hi])
+
+
+def np_masked_trimmed_mean(vals, alive, trim_frac):
+    G = vals.shape[0]
+    s = np.sort(np.where(alive[:, None], vals, np.inf), axis=0)
+    k = int(np.sum(alive))
+    t = int(np.floor(trim_frac * k))
+    ranks = np.arange(G)[:, None]
+    wm = (ranks >= t) & (ranks < k - t)
+    kept = np.where(wm, s, 0.0).sum(axis=0)
+    denom = np.maximum(wm.sum(axis=0).astype(np.float64), 1.0)
+    return kept / denom
+
+
+def np_robust_fold(cfg, transmits, counts):
+    """Mirror of core/robust.robust_fold over a list of per-client
+    transmit arrays (already scaled by batch size) and their
+    datapoint counts. Returns (aggregated, fold_rejection_rate)."""
+    T = np.stack([np.asarray(t, np.float64).ravel() for t in transmits])
+    W = T.shape[0]
+    n = np.asarray(counts, np.float64)
+    alive = n > 0
+    total = max(float(n.sum()), 1.0)
+    plain = T.sum(axis=0) / total
+    g = T / np.maximum(n, 1.0)[:, None]
+
+    mode = cfg.robust_agg
+    if mode == "median":
+        groups = getattr(cfg, "robust_median_groups", 0)
+        if 1 < groups < W:
+            assert W % groups == 0, (W, groups)
+            gsum = T.reshape(groups, W // groups, -1).sum(axis=1)
+            gn = n.reshape(groups, W // groups).sum(axis=1)
+            galive = alive.reshape(groups, W // groups).any(axis=1)
+            gv = gsum / np.maximum(gn, 1.0)[:, None]
+        else:
+            gv, galive = g, alive
+        agg = np_masked_median(gv, galive)
+    elif mode == "trimmed":
+        agg = np_masked_trimmed_mean(g, alive, cfg.robust_trim_frac)
+    elif mode == "clip":
+        norms = np.sqrt(np.sum(g * g, axis=1))
+        if cfg.robust_clip_norm > 0:
+            tau = float(cfg.robust_clip_norm)
+        else:
+            tau = float(np_masked_median(norms[:, None], alive)[0])
+        scale = np.minimum(1.0, tau / np.maximum(norms, _TINY))
+        agg = np.sum(scale[:, None] * T, axis=0) / total
+    else:
+        raise ValueError(f"unknown robust_agg {mode!r}")
+
+    rej = (np.linalg.norm(plain - agg)
+           / max(np.linalg.norm(plain), _TINY))
+    return agg.reshape(np.shape(transmits[0])), float(rej)
+
+
 class MirrorFed:
     """Dense-mode mirror (uncompressed / true_topk / local_topk /
     fedavg). Sketch mode is exercised through the shared CountSketch op
@@ -202,15 +274,26 @@ class MirrorFed:
         self._dense_tt = []
         transmits = [self._client_transmit(cid, X, y, B)
                      for cid, X, y in clients]
-        agg = np.sum(transmits, axis=0) / total
+        robust = getattr(self.cfg, "robust_agg", "none") != "none"
+        rej = None
+        if robust:
+            agg, rej = np_robust_fold(
+                self.cfg, transmits, [len(y) for _, _, y in clients])
+        else:
+            agg = np.sum(transmits, axis=0) / total
         # sketch-late engine paths materialise DENSE per-client
         # transmits (the table appears only after the local sum), so
-        # the transmit-norm probes are over the dense vectors there
+        # the transmit-norm probes are over the dense vectors there;
+        # robust folds force per-client sketching, so their norm
+        # probes are back over the tables
         norm_src = (self._dense_tt
                     if (self.cfg.mode == "sketch" and self._dense_tt
-                        and self.cfg.max_grad_norm is None)
+                        and self.cfg.max_grad_norm is None
+                        and not robust)
                     else transmits)
         self.last_probes = self._client_probes(agg, norm_src)
+        if rej is not None:
+            self.last_probes["fold_rejection_rate"] = rej
         if self.cfg.mode == "sketch" and self._dense_tt:
             dense_agg = np.sum(self._dense_tt, axis=0) / total
             est = np.asarray(self.sketch.unsketch(

@@ -1,6 +1,13 @@
 """Full-state checkpoint/resume: an interrupted-and-resumed run must
 reproduce the uninterrupted run bit-for-bit (weights, server momentum/
-error, client states, data order)."""
+error, client states, data order) — including runs interrupted
+MID-EPOCH by a SIGTERM or an exception, which resume from the
+round-cadence autosave (``--checkpoint_every_rounds``)."""
+
+import glob
+import json
+import os
+import signal
 
 import numpy as np
 import pytest
@@ -134,6 +141,118 @@ def test_global_np_rng_and_loader_counter_roundtrip(tmp_path):
     load_checkpoint(path, model, opt, loader=fresh)
     np.testing.assert_array_equal(np.random.rand(3), after_save)
     assert fresh._round_counter == 7
+
+
+def _midrun_argv(d, epochs, extra=()):
+    """1 round per epoch (num_clients == num_workers), so ``--test``'s
+    one-round-per-epoch break coincides with the true epoch boundary
+    and a mid-run kill/resume replays whole rounds."""
+    return [
+        "--test", "--dataset_name", "Synthetic", "--iid",
+        "--mode", "sketch", "--error_type", "virtual",
+        "--local_momentum", "0", "--virtual_momentum", "0.9",
+        "--num_clients", "2", "--num_workers", "2",
+        "--local_batch_size", "4", "--num_epochs", str(epochs),
+        "--lr_scale", "0.1", "--pivot_epoch", "1",
+        "--checkpoint", "--checkpoint_path", str(d),
+        "--checkpoint_every", "1",
+        "--checkpoint_every_rounds", "2", "--checkpoint_keep", "2",
+        *extra,
+    ]
+
+
+# killed BETWEEN autosaves (cadence 2, autosave at round 2): the
+# resume replays round 3, exercising the ledger's replay dedup
+_KILL_ROUND = 3
+
+
+def _inject_round_failure(monkeypatch, kill_round, action):
+    """Wrap RoundAutosaver.__call__: run the real autosave logic,
+    then — once per process — kill the run at ``kill_round`` (either
+    a real SIGTERM to ourselves, which the trainer's sigterm_raises
+    turns into GracefulShutdown, or a raised exception)."""
+    from commefficient_tpu.runtime import checkpoint as ckpt
+    real = ckpt.RoundAutosaver.__call__
+    state = {"fired": False}
+
+    def wrapped(self, epoch):
+        real(self, epoch)
+        if not state["fired"] \
+                and int(self.model.round_index) >= kill_round:
+            state["fired"] = True
+            if action == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+            else:
+                raise RuntimeError("chaos: injected round failure")
+
+    monkeypatch.setattr(ckpt.RoundAutosaver, "__call__", wrapped)
+    return state
+
+
+@pytest.fixture(scope="module")
+def _uninterrupted_run(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cont")
+    cv_train.main(_midrun_argv(d, 6))
+    return _load_state(d), d
+
+
+def test_round_autosave_retention(_uninterrupted_run):
+    """--checkpoint_keep prunes round-stamped history snapshots to
+    the budget (newest kept)."""
+    _, d = _uninterrupted_run
+    snaps = sorted(os.path.basename(p) for p in
+                   glob.glob(os.path.join(str(d), "ckpt_ResNet9_r*.npz")))
+    assert len(snaps) == 2, snaps
+    rounds = [int(n.split("_r")[1].split(".")[0]) for n in snaps]
+    assert rounds == [4, 6]  # cadence-2 autosaves, oldest pruned
+
+
+@pytest.mark.parametrize("failure", ["sigterm", "exception"])
+def test_resume_after_midrun_failure_bit_exact(
+        tmp_path, monkeypatch, failure, _uninterrupted_run):
+    """Kill a run mid-epoch (SIGTERM or raised exception between
+    rounds); the last round-cadence autosave must be a consistent
+    resume point and the resumed run bit-exact vs uninterrupted,
+    with ledger round ids monotone and deduplicated."""
+    (cont_state, cont_meta), _ = _uninterrupted_run
+    crash_dir = tmp_path / "crash"
+    ledger = str(crash_dir / "led.jsonl")
+    extra = ("--ledger", ledger)
+
+    state = _inject_round_failure(monkeypatch, _KILL_ROUND, failure)
+    if failure == "sigterm":
+        # GracefulShutdown is caught inside main(): clean exit
+        cv_train.main(_midrun_argv(crash_dir, 6, extra))
+    else:
+        with pytest.raises(RuntimeError, match="injected round"):
+            cv_train.main(_midrun_argv(crash_dir, 6, extra))
+    assert state["fired"]
+    monkeypatch.undo()
+
+    # crash saved NOTHING past the last cadence autosave: no final
+    # model artifact, checkpoint meta at the autosaved round
+    assert not os.path.exists(str(crash_dir / "ResNet9.pkl"))
+    crash_meta = _load_state(crash_dir)[1]
+    assert crash_meta["round_index"] == _KILL_ROUND - 1
+
+    cv_train.main(_midrun_argv(crash_dir, 6, (*extra, "--resume")))
+    res_state, res_meta = _load_state(crash_dir)
+    assert res_meta["epoch"] == cont_meta["epoch"] == 6
+    assert res_meta["round_index"] == cont_meta["round_index"]
+    assert res_meta["opt_step_count"] == cont_meta["opt_step_count"]
+    assert set(cont_state) == set(res_state)
+    for k in cont_state:
+        np.testing.assert_array_equal(cont_state[k], res_state[k],
+                                      err_msg=k)
+    # the resumed run appended to the SAME ledger; replayed rounds
+    # were deduplicated (JSONLSink resume_after), ids stay monotone
+    with open(ledger) as f:
+        rounds = [rec["round"] for rec in map(json.loads, f)
+                  if rec.get("kind") == "round"
+                  and rec.get("round") is not None]
+    assert rounds == sorted(set(rounds)), rounds
+    assert rounds == list(range(rounds[0], rounds[-1] + 1))
+    assert rounds[-1] >= cont_meta["round_index"] - 1
 
 
 def test_gpt2_resume_round_trip(tmp_path):

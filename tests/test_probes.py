@@ -153,6 +153,75 @@ def test_probe_values_match_mirror(cfg_kw, client_keys, extra):
                 err_msg=f"probe {key}")
 
 
+DROPOUT_MODES = [
+    # fused fast path under dropout: the WD share must follow the
+    # alive-datapoint fraction (core/rounds.py _fused_local)
+    (dict(mode="sketch", error_type="virtual", virtual_momentum=0.9),
+     FUSED_KEYS | {"recovery_error"}, {"mass_coverage"}),
+    (dict(mode="true_topk", error_type="virtual",
+          virtual_momentum=0.9), FUSED_KEYS, {"mass_coverage"}),
+    (dict(mode="uncompressed", local_momentum=0.9), CLIENT_KEYS,
+     set()),
+    (dict(mode="local_topk", error_type="local", k=2), CLIENT_KEYS,
+     set()),
+    (dict(mode="fedavg", local_batch_size=-1, fedavg_batch_size=2,
+          num_fedavg_epochs=1), CLIENT_KEYS, set()),
+]
+
+
+@pytest.mark.parametrize("cfg_kw,client_keys,extra", DROPOUT_MODES)
+def test_dropout_round_probes_match_mirror(cfg_kw, client_keys, extra):
+    """Satellite of the chaos harness: a round with a DEAD slot
+    (dropout / loader padding, all-zero mask) must produce the same
+    probes as the mirror run over the alive clients only — the dead
+    slot contributes nothing to the aggregate (weight decay included)
+    and is excluded from the client-norm statistics. All five modes,
+    with weight_decay nonzero so the WD share is pinned too."""
+    cfg = make_cfg(weight_decay=0.01, dropout_prob=0.5, **cfg_kw)
+    rng = np.random.default_rng(11)
+    d = 8
+    w0 = rng.normal(size=d)
+    lr = 0.3
+    full = [_round_data(rng, d, (3, 2)) for _ in range(3)]
+    # round 1: client 1 is dropped (zero real samples -> all-zero mask)
+    dead_cid, dead_X, dead_Y = full[1][1]
+    full[1][1] = (dead_cid, dead_X[:0], dead_Y[:0])
+    alive_only = [[(c, X, Y) for c, X, Y in rnd if len(Y)]
+                  for rnd in full]
+    B = max(len(y) for rnd in full for _, _, y in rnd)
+    eng = run_engine_probes(cfg, w0, full, lr)
+    mir = run_mirror_probes(cfg, w0, alive_only, lr, B=B)
+    for e, m in zip(eng, mir):
+        assert set(e) == client_keys | SERVER_KEYS | extra, sorted(e)
+        for key in sorted(e):
+            np.testing.assert_allclose(
+                e[key], m[key], rtol=5e-4, atol=1e-5,
+                err_msg=f"probe {key}")
+
+
+@pytest.mark.parametrize("cfg_kw,client_keys,extra", DROPOUT_MODES)
+def test_fully_dropped_round_aggregate_is_zero(cfg_kw, client_keys,
+                                               extra):
+    """Zero-averaging semantics on a FULLY-dropped round: nobody
+    trained, so the aggregate must be exactly zero — in particular the
+    fused path's analytic weight-decay term must not keep decaying the
+    weights when every client's mask is zero."""
+    cfg = dataclasses.replace(
+        make_cfg(weight_decay=0.01, dropout_prob=0.5, **cfg_kw),
+        grad_size=8)
+    W, B, d = 2, 3, 8
+    client_round = jax.jit(build_client_round(cfg, linear_loss, B))
+    rng = np.random.default_rng(13)
+    batch = {"x": jnp.asarray(rng.normal(size=(W, B, d)), jnp.float32),
+             "y": jnp.asarray(rng.normal(size=(W, B)), jnp.float32),
+             "mask": jnp.zeros((W, B), jnp.float32)}
+    ps = jnp.asarray(rng.normal(size=d), jnp.float32)
+    res = client_round(ps, ClientStates.init(cfg, 4, ps), batch,
+                       jnp.arange(W, dtype=jnp.int32),
+                       jax.random.PRNGKey(0), jnp.float32(0.3))
+    np.testing.assert_array_equal(np.asarray(res.aggregated), 0.0)
+
+
 def test_recovery_error_is_zero_for_lossless_sketch():
     """A sketch with more bucket capacity than coordinates and
     k >= d recovers exactly -> recovery_error == 0 (up to fp32)."""
@@ -219,6 +288,24 @@ def test_probes_off_program_identical(mode, error_type):
     skew_cfg = dataclasses.replace(cfg, alarm_collective_skew=0.5)
     assert _lower_text(build_client_round(skew_cfg, linear_loss, 3),
                        skew_cfg) == default
+
+    # robust-aggregation / chaos-harness knobs at their inert values
+    # must be invisible too: --robust_agg none is a trace-time gate,
+    # transmit_transform=None (chaos off) is the identical build path,
+    # and the checkpoint/alarm cadences are host-only
+    inert_cfg = dataclasses.replace(
+        cfg, robust_agg="none", robust_trim_frac=0.2,
+        robust_clip_norm=5.0, robust_median_groups=2,
+        alarm_byzantine_ratio=4.0, alarm_fold_rejection=0.5,
+        checkpoint_every_rounds=3, checkpoint_keep=2)
+    assert _lower_text(
+        build_client_round(inert_cfg, linear_loss, 3,
+                           transmit_transform=None),
+        inert_cfg) == default
+    # an ACTIVE robust fold, by contrast, changes the program
+    med_cfg = dataclasses.replace(cfg, robust_agg="median")
+    assert _lower_text(build_client_round(med_cfg, linear_loss, 3),
+                       med_cfg) != default
 
     def _server_text(sr):
         ps = jax.ShapeDtypeStruct((8,), jnp.float32)
