@@ -127,19 +127,28 @@ def collective_summary(ops: List[CollectiveOp]) -> Dict:
             "total_bytes": sum(byte_totals.values())}
 
 
-def matching_reduce_bytes(ops: List[CollectiveOp], dtype: str,
-                          shape: Tuple[int, ...]) -> int:
-    """Total all-reduce bytes over result *components* of exactly this
-    dtype+shape — the uplink cross-check's selector. Summing (instead
-    of taking the first hit) makes an accidentally duplicated
-    aggregation reduce show up as 2x the ledger bytes."""
+def matching_collective_bytes(ops: List[CollectiveOp], kind: str,
+                              dtype: str,
+                              shape: Tuple[int, ...]) -> int:
+    """Total bytes over result *components* of exactly this dtype+shape
+    for one collective kind. Summing (instead of taking the first hit)
+    makes an accidentally duplicated op show up as 2x the expected
+    bytes. The 2D audit keys reduce-scatter output shards through here
+    the same way the 1-D audit keys the aggregation all-reduce."""
     total = 0
     for op in ops:
-        if op.kind != "all-reduce":
+        if op.kind != kind:
             continue
         total += sum(b for d, s, b in op.shapes
                      if d == dtype and s == tuple(shape))
     return total
+
+
+def matching_reduce_bytes(ops: List[CollectiveOp], dtype: str,
+                          shape: Tuple[int, ...]) -> int:
+    """All-reduce bytes of exactly this dtype+shape — the 1-D uplink
+    cross-check's selector."""
+    return matching_collective_bytes(ops, "all-reduce", dtype, shape)
 
 
 def host_transfer_lines(text: str) -> List[str]:

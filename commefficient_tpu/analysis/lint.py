@@ -315,6 +315,39 @@ def _check_chaos_confinement(rel, lines, tree):
     return hits
 
 
+# --- rule: inline-partition-spec ---------------------------------------
+
+
+_SPEC_NAMES = {"PartitionSpec", "NamedSharding"}
+
+
+def _check_inline_partition_spec(rel, lines, tree):
+    """PartitionSpec/NamedSharding literals outside parallel/: sharding
+    layout has ONE owner — parallel/mesh.py's sanctioned constructors
+    (client_spec, table_shard_spec, server_state_spec, ...). An inline
+    spec in core/ or runtime/ silently forks the layout the program
+    auditor and the 1/M memory accounting reason about."""
+    if _top(rel) == "parallel":
+        return []
+    hits = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.startswith("jax.sharding"):
+                for a in node.names:
+                    if a.name in _SPEC_NAMES:
+                        hits.append((
+                            node.lineno,
+                            f"from jax.sharding import {a.name} "
+                            "outside parallel/ — build specs through "
+                            "parallel.mesh"))
+        elif (isinstance(node, ast.Attribute)
+                and node.attr in _SPEC_NAMES):
+            hits.append((node.lineno,
+                         f"inline .{node.attr} outside parallel/ — "
+                         "build specs through parallel.mesh"))
+    return hits
+
+
 # --- rule: mutable-default-arg -----------------------------------------
 
 
@@ -357,6 +390,9 @@ ALL_RULES = [
     Rule("chaos-confinement",
          "data/chaos.py imported by a production module",
          _check_chaos_confinement),
+    Rule("inline-partition-spec",
+         "PartitionSpec/NamedSharding built outside parallel/",
+         _check_inline_partition_spec),
     Rule("mutable-default-arg",
          "mutable default argument",
          _check_mutable_default),

@@ -44,7 +44,10 @@ from commefficient_tpu.core.rounds import (ClientStates,
                                            build_server_round)
 from commefficient_tpu.core.server import ServerState
 from commefficient_tpu.parallel.mesh import (client_sharding, make_mesh,
-                                             replicated, shard_batch)
+                                             make_mesh2d,
+                                             model_axis_size, replicated,
+                                             server_state_sharding,
+                                             shard_batch)
 
 D = 64            # grad_size
 B = 2             # padded batch per client
@@ -52,6 +55,7 @@ NUM_CLIENTS = 16  # divisible by the 8-device mesh
 MESH_W = 8        # round fan-out on the mesh
 CHUNK_W = 4       # fan-out for the single-device chunked path
 CHUNK = 2
+MESH2D = (4, 2)   # clients x model layout for the 2D audit programs
 
 BASE_CFG = dict(local_momentum=0.0, virtual_momentum=0.0,
                 weight_decay=0.0, error_type="none", k=3,
@@ -63,7 +67,7 @@ BASE_CFG = dict(local_momentum=0.0, virtual_momentum=0.0,
 class ProgramSpec:
     name: str
     mode: str
-    path: str               # "fused" | "per_client" | "chunked"
+    path: str               # "fused" | "per_client" | "chunked" | "fused2d"
     cfg_kw: Dict
     probes: bool = False
     probe_recovery: bool = False
@@ -96,6 +100,11 @@ def build_specs() -> List[ProgramSpec]:
         ProgramSpec("sketch/fused+probes", "sketch", "fused",
                     dict(error_type="virtual", virtual_momentum=0.9),
                     probes=True, probe_recovery=True),
+        # the pod-scale 2D round: partial tables reduce-scattered over
+        # ``model``, the client-axis all-reduce carries only the
+        # (r, c/M) column shard
+        ProgramSpec("sketch/fused2d", "sketch", "fused2d",
+                    dict(error_type="virtual", virtual_momentum=0.9)),
     ]
     per_client_kw = {
         "sketch": dict(error_type="virtual", virtual_momentum=0.9,
@@ -194,7 +203,8 @@ def audit_client_program(spec: ProgramSpec, mesh=None,
     W = MESH_W if spec.use_mesh else CHUNK_W
     cfg = make_cfg(spec.mode, W, **spec.cfg_kw)
     if spec.use_mesh and mesh is None:
-        mesh = make_mesh(jax.devices())
+        mesh = (make_mesh2d(*MESH2D) if spec.path == "fused2d"
+                else make_mesh(jax.devices()))
     fn = build_client_round(cfg, _toy_loss, B,
                             mesh=mesh if spec.use_mesh else None,
                             probes=spec.probes,
@@ -211,16 +221,38 @@ def audit_client_program(spec: ProgramSpec, mesh=None,
                              entry.pop("compiled_aliases")}
 
     ledger = 4 * cfg.upload_floats_per_client
-    static = hlo.matching_reduce_bytes(ops, "f32", cfg.transmit_shape)
-    entry["uplink"] = {
-        "ledger_bytes_per_client": ledger,
-        "aggregate_allreduce_bytes": static,
-        # local_topk sends the dense masked vector over the ICI: the
-        # 4·k ledger figure is the logical uplink, bounded by the
-        # 4·d wire bytes. Everything else must match exactly.
-        "relation": ("bound" if spec.mode == "local_topk"
-                     else "exact"),
-    }
+    M = model_axis_size(mesh) if spec.use_mesh else 1
+    if M > 1:
+        # 2D emission: the client-axis all-reduce and the model-axis
+        # reduce-scatter both carry the (r, c/M) column shard — XLA
+        # sometimes flattens the shard to 1-D, so both layouts key
+        shard = (cfg.num_rows, cfg.num_cols // M)
+        static = sum(
+            hlo.matching_collective_bytes(ops, "all-reduce", "f32", s)
+            for s in (shard, (shard[0] * shard[1],)))
+        rs = sum(
+            hlo.matching_collective_bytes(ops, "reduce-scatter",
+                                          "f32", s)
+            for s in (shard, (shard[0] * shard[1],)))
+        entry["uplink"] = {
+            "ledger_bytes_per_client": ledger,
+            "model_shards": M,
+            "aggregate_allreduce_bytes": static,
+            "reduce_scatter_bytes": rs,
+            "relation": "sharded",
+        }
+    else:
+        static = hlo.matching_reduce_bytes(ops, "f32",
+                                           cfg.transmit_shape)
+        entry["uplink"] = {
+            "ledger_bytes_per_client": ledger,
+            "aggregate_allreduce_bytes": static,
+            # local_topk sends the dense masked vector over the ICI:
+            # the 4·k ledger figure is the logical uplink, bounded by
+            # the 4·d wire bytes. Everything else must match exactly.
+            "relation": ("bound" if spec.mode == "local_topk"
+                         else "exact"),
+        }
 
     failures = []
     don = entry["donation"]
@@ -246,6 +278,25 @@ def audit_client_program(spec: ProgramSpec, mesh=None,
             failures.append(
                 "single-device chunked program emits collectives: "
                 f"{entry['collectives']['counts']}")
+    elif M > 1:
+        if rs * M != ledger:
+            failures.append(
+                f"2D uplink: reduce-scatter shard bytes {rs} x {M} "
+                f"model shards != ledger bytes/client {ledger} — the "
+                "partial-table emission is not reduce-scattering the "
+                "(r, c/M) column shard")
+        if static * M != ledger:
+            failures.append(
+                f"2D uplink: client-axis all-reduce bytes {static} x "
+                f"{M} != ledger bytes/client {ledger} — the "
+                "aggregation must carry only the column shard")
+        full = hlo.matching_reduce_bytes(ops, "f32",
+                                         cfg.transmit_shape)
+        if full:
+            failures.append(
+                f"2D uplink: {full} bytes all-reduced at the FULL "
+                f"table shape {cfg.transmit_shape} — the model-axis "
+                "sharding is being undone on the wire")
     elif spec.mode == "local_topk":
         if not (static >= ledger):
             failures.append(
@@ -302,6 +353,86 @@ def audit_server_program(mode: str, donate: bool = True) -> Dict:
     return entry
 
 
+def audit_server_program_2d(donate: bool = True) -> Dict:
+    """Audit the 2D sketch server: momentum/EF column shards update
+    locally, the distributed top-k select rebuilds the full table with
+    exactly ONE table-sized all-gather (never an all-reduce of a
+    table-sized buffer — that would undo the 1/M memory claim on the
+    wire), and donation must stick on the sharded state."""
+    cfg = make_cfg("sketch", MESH_W, **SERVER_CFG_KW["sketch"])
+    mesh = make_mesh2d(*MESH2D)
+    fn = build_server_round(cfg, mesh=mesh)
+    jitted = jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+    state = ServerState.init(
+        cfg, sharding=server_state_sharding(mesh, cfg.transmit_shape))
+    args = (jnp.zeros((D,), jnp.float32), state,
+            jnp.ones(cfg.transmit_shape, jnp.float32),
+            jnp.float32(0.1))
+    entry = _audit_texts(jitted, args)
+    ops = entry.pop("_ops")
+    entry["donation"] = {"expected": 1 + _donated_leaves(args[1]),
+                         "marked": entry.pop("marked"),
+                         "compiled_aliases":
+                             entry.pop("compiled_aliases")}
+    r, c = cfg.transmit_shape
+    table_gathers = sum(
+        1 for op in ops if op.kind == "all-gather"
+        and any(d == "f32" and s in ((r, c), (r * c,))
+                for d, s, _b in op.shapes))
+    table_reduce = sum(
+        hlo.matching_collective_bytes(ops, "all-reduce", "f32", s)
+        for s in ((r, c), (r * c,)))
+    entry["table_traffic"] = {"all_gathers": table_gathers,
+                              "allreduce_bytes": table_reduce}
+    failures = []
+    don = entry["donation"]
+    if min(don["marked"], don["compiled_aliases"]) < don["expected"]:
+        failures.append(
+            f"donation: {don['marked']} marked / "
+            f"{don['compiled_aliases']} compiled-aliased of "
+            f"{don['expected']} donated server leaves — the sharded "
+            "momentum/EF tables must reuse their buffers")
+    if entry["transfers"]:
+        failures.append(f"host transfers: {entry['transfers'][:3]}")
+    if table_gathers != 1:
+        failures.append(
+            f"2D select must rebuild the table with exactly one "
+            f"(r, c) all-gather, found {table_gathers}")
+    if table_reduce:
+        failures.append(
+            f"{table_reduce} bytes all-reduced at table size in the "
+            "2D server — column shards must stay sharded")
+    if not entry["retrace_stable"]:
+        failures.append("nondeterministic 2D server trace")
+    entry.update(mode="sketch", path="server2d", probes=False,
+                 failures=failures)
+    return entry
+
+
+def audit_mesh_1x1_identity() -> Dict:
+    """``--mesh 1x1`` must build the SAME program as the 1-D default
+    (loc-stripped StableHLO fingerprint): the 2D plumbing may not tax
+    the single-device path with even one extra op."""
+    cfg = make_cfg("sketch", MESH_W,
+                   **dict(error_type="virtual", virtual_momentum=0.9))
+    args = _client_inputs(cfg, None)
+    texts = {}
+    for tag, mesh in (("1d", None), ("1x1", make_mesh2d(1, 1))):
+        fn = build_client_round(cfg, _toy_loss, B, mesh=mesh)
+        texts[tag] = jax.jit(fn).lower(*args).as_text()
+    fp_1d = hlo.fingerprint(texts["1d"])
+    fp_11 = hlo.fingerprint(texts["1x1"])
+    failures = []
+    if fp_1d != fp_11:
+        failures.append(
+            f"--mesh 1x1 lowers a different program than the 1-D "
+            f"default ({fp_1d[:12]} != {fp_11[:12]}) — the 2D branch "
+            "leaks into the single-device build")
+    return {"mode": "sketch", "path": "mesh1x1", "probes": False,
+            "fingerprint": fp_1d, "mesh1x1_fingerprint": fp_11,
+            "retrace_stable": True, "failures": failures}
+
+
 def audit_bf16_canary() -> Dict:
     """bf16 dtype discipline on a conv+dot canary: value_and_grad of a
     small bf16 model must lower with every contraction in bf16 —
@@ -347,11 +478,14 @@ def run_program_audit(server: bool = True) -> Dict:
     mesh = make_mesh(jax.devices())
     for spec in build_specs():
         report["programs"][spec.name] = audit_client_program(
-            spec, mesh=mesh)
+            spec, mesh=None if spec.path == "fused2d" else mesh)
     if server:
         for mode in SERVER_CFG_KW:
             report["programs"][f"{mode}/server"] = \
                 audit_server_program(mode)
+        report["programs"]["sketch/server2d"] = \
+            audit_server_program_2d()
+    report["programs"]["sketch/mesh1x1"] = audit_mesh_1x1_identity()
     report["programs"]["bf16_canary"] = audit_bf16_canary()
     report["failures"] = [
         f"{name}: {msg}"

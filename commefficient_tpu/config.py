@@ -151,7 +151,13 @@ class Config:
     noise_multiplier: float = 0.0
 
     # --- TPU-native additions (no reference equivalent) ---
-    mesh_shape: Optional[Sequence[int]] = None  # default: all local devices
+    # 2D pod mesh "CxM": C devices data-parallel over ``clients`` ×
+    # M devices sharding server state (sketch table columns, momentum,
+    # error feedback) over ``model`` — per-device server memory scales
+    # as 1/M. "" = the 1-D clients mesh over --num_devices. M > 1 is
+    # supported for the server-state modes (sketch, uncompressed);
+    # "1x1" compiles to exactly the single-device 1-D program.
+    mesh: str = ""
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # set bfloat16 for MXU throughput
     # lax.approx_max_k (recall approx_recall) for the index-producing
@@ -401,6 +407,12 @@ class Config:
             "--checkpoint_every_rounds must be >= 0 (0 = off)"
         assert self.checkpoint_keep >= 0, \
             "--checkpoint_keep must be >= 0"
+        if self.mesh:
+            import re
+            assert re.fullmatch(r"[0-9]+x[0-9]+", self.mesh.lower()), \
+                "--mesh must be CxM (e.g. 4x2)"
+            c, m = self.mesh2d
+            assert c >= 1 and m >= 1, "--mesh axes must be >= 1"
         if self.mode == "fedavg":
             assert self.local_batch_size == -1, \
                 "fedavg requires --local_batch_size -1"
@@ -452,6 +464,22 @@ class Config:
             assert self.error_type != "local", \
                 "local error accumulation is pointless uncompressed " \
                 "(fed_worker.py:223-224)"
+        if self.model_axis > 1:
+            # the model axis shards *server* state; only the modes
+            # whose server state is dense transmit-shaped buffers
+            # (sketch tables / uncompressed vectors) have anything to
+            # shard — the local-state modes keep their per-client rows
+            # on the clients axis
+            assert self.mode in ("sketch", "uncompressed"), \
+                "--mesh with model axis > 1 supports sketch and " \
+                "uncompressed modes only"
+            if self.mode == "sketch":
+                assert self.num_cols % self.model_axis == 0, \
+                    "--mesh model axis must divide --num_cols " \
+                    "(the sketch table shards by columns)"
+            assert self.client_chunk == 0, \
+                "--mesh with model axis > 1 is incompatible with " \
+                "--client_chunk (the chunked scan is single-device)"
         if self.robust_agg != "none":
             # robust folds need the round's per-client transmits
             # materialised at once; the chunked scan only ever holds
@@ -478,6 +506,22 @@ class Config:
         if self.num_clients is not None:
             return self.num_clients
         return NATURAL_NUM_CLIENTS.get(self.dataset_name)
+
+    @property
+    def mesh2d(self):
+        """Parsed --mesh "CxM" as (clients, model), or None for the
+        1-D default."""
+        if not self.mesh:
+            return None
+        c, m = (int(p) for p in self.mesh.lower().split("x"))
+        return (c, m)
+
+    @property
+    def model_axis(self) -> int:
+        """Model-axis size of the requested mesh (1 when unset or
+        1-D — the replicated-server-state layout)."""
+        shape = self.mesh2d
+        return shape[1] if shape else 1
 
     @property
     def transmit_shape(self):
@@ -605,6 +649,12 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--noise_multiplier", type=float, default=0.0)
 
     # TPU-native additions
+    parser.add_argument("--mesh", type=str, default="",
+                        help="2D pod mesh 'CxM': C devices "
+                        "data-parallel over clients x M devices "
+                        "sharding server state over model (sketch/"
+                        "uncompressed modes; per-device server memory "
+                        "~1/M). Default: 1-D clients mesh")
     parser.add_argument("--param_dtype", type=str, default="float32")
     parser.add_argument("--compute_dtype", type=str, default="float32")
     parser.add_argument("--approx_topk", action="store_true")

@@ -302,19 +302,53 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     tree_sketch = (cfg.mode == "sketch" and tree_loss is not None
                    and unravel is not None)
 
+    def _partial_table_emit(g):
+        """2D-mesh sketch emission for one model peer: sketch ONLY
+        this peer's contiguous ⌈d/M⌉ coordinate slice of the dense
+        gradient (slices are disjoint, so the model-axis SUM of the
+        partial tables is the sketch of the full gradient — the same
+        linearity identity the late-sketch path rests on), then one
+        reduce-scatter leaves each peer holding its (r, c/M) column
+        shard. Replaces replicate + all-reduce: per-link wire bytes
+        drop from 4·r·c to 4·r·c/M and no device ever materialises
+        the full table during emission. Tail-shard padding slots are
+        zero-valued (a scatter-add of 0 at a clamped index is a
+        no-op), so uneven d/M needs no special casing."""
+        from commefficient_tpu.parallel.mesh import (MODEL_AXIS,
+                                                     model_axis_size)
+        M = model_axis_size(mesh)
+        d = cfg.grad_size
+        n_loc = -(-d // M)
+        pad = n_loc * M - d
+        gp = jnp.pad(g, (0, pad)) if pad else g
+        start = (jax.lax.axis_index(MODEL_AXIS)
+                 * n_loc).astype(jnp.int32)
+        vals = jax.lax.dynamic_slice(gp, (start,), (n_loc,))
+        idx = start + jnp.arange(n_loc, dtype=jnp.int32)
+        vals = jnp.where(idx < d, vals, 0.0)
+        partial = sketch.sketch_sparse(jnp.minimum(idx, d - 1), vals)
+        return jax.lax.psum_scatter(partial, MODEL_AXIS,
+                                    scatter_dimension=1, tiled=True)
+
     def _fused_local(ps_weights, batch, total, n_shards,
-                     with_dense=False):
+                     with_dense=False, emit=None):
         """Fused backward over the clients in ``batch`` (all of them
         single-device; one device's shard under shard_map), already
         normalised by the GLOBAL datapoint total. The weight-decay
         term is split evenly across shards so the cross-shard sum
-        reconstructs (wd/num_workers)·p exactly once.
+        reconstructs (wd/num_workers)·p exactly once — ``n_shards``
+        is the number of CLIENT-axis shards (cross-shard sums are
+        psums over ``clients``; on a 2D mesh the model peers hold
+        coordinate-disjoint slices, never copies, so they must not
+        enter the split).
 
-        ``with_dense`` (probe cadence rounds only) appends the dense
-        flat gradient to the return — the recovery-error probe's
-        ground truth. On the tree-sketch path this materialises the
-        (d,) concatenation the fast path exists to avoid; that cost is
-        paid only in the probed program variant."""
+        ``emit`` (2D mesh only) replaces the transmit construction on
+        the dense flat gradient — the shard-local partial-sketch +
+        reduce-scatter above. The tree-sketch path materialises the
+        flat concatenation first in that case: coordinate slicing
+        needs the flat layout. ``with_dense`` (probe cadence rounds
+        only) appends the dense flat gradient to the return — the
+        recovery-error probe's ground truth."""
 
         def make_local_loss(fn):
             def local_loss(p):
@@ -368,6 +402,17 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                                   + coef * p.astype(jnp.float32)),
                     g_tree, tree)
             leaves = jax.tree_util.tree_leaves(g_tree)
+            if emit is not None:
+                # 2D emission needs the flat coordinate layout (each
+                # model peer sketches a contiguous slice) — the flat
+                # concatenation comes back, but the per-link payload
+                # still drops to (r, c/M)
+                flat = jnp.concatenate(
+                    [jnp.ravel(l).astype(jnp.float32)
+                     for l in leaves])
+                if with_dense:
+                    return emit(flat), metrics, flat
+                return emit(flat), metrics
             table = sketch.sketch_from_leaves(leaves)
             if with_dense:
                 return table, metrics, jnp.concatenate(
@@ -380,7 +425,10 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         if cfg.weight_decay != 0:
             # Σ_i (wd/num_workers)·p·n_i / total = (wd/num_workers)·p
             g = g + _wd_coef() * ps_weights
-        t = sketch.sketch(g) if cfg.mode == "sketch" else g
+        if emit is not None:
+            t = emit(g)
+        else:
+            t = sketch.sketch(g) if cfg.mode == "sketch" else g
         if with_dense:
             return t, metrics, g
         return t, metrics
@@ -391,17 +439,25 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         del rng, fedavg_lr
         W = client_ids.shape[0]
         total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        from commefficient_tpu.parallel.mesh import (client_axis_size,
+                                                     model_axis_size)
         ndev = mesh.devices.size if mesh is not None else 1
+        C = client_axis_size(mesh)
+        # 2D mesh sketch emission: partial-sketch + reduce-scatter
+        # over ``model`` — the aggregated table leaves the round
+        # column-sharded (parallel/mesh.table_shard_spec). Dense
+        # modes keep the replicated emission on any mesh shape (their
+        # server state shards under GSPMD instead, build_server_round)
+        shard2d = model_axis_size(mesh) > 1 and cfg.mode == "sketch"
         # recovery probe needs the dense aggregate next to the table;
         # in non-sketch fused modes the aggregate IS dense and there
         # is no recovery to measure
         want_dense = probe_recovery and cfg.mode == "sketch"
         dense_g = None
-        if ndev > 1 and W % ndev == 0:
-            from jax.sharding import PartitionSpec as P
-
-            from commefficient_tpu.parallel.mesh import (CLIENT_AXIS,
-                                                         shard_map)
+        if ndev > 1 and W % C == 0:
+            from commefficient_tpu.parallel.mesh import (
+                CLIENT_AXIS, client_spec, replicated_spec, shard_map,
+                table_shard_spec)
 
             def block(p, local_batch, tot):
                 # mark the replicated params as device-varying before
@@ -415,32 +471,42 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 else:
                     from commefficient_tpu.compat import pvary
                     p = pvary(p, CLIENT_AXIS)
+                emit = _partial_table_emit if shard2d else None
                 if want_dense:
                     # probed cadence round: the dense gradient crosses
                     # the ICI too — the one round where uncompressed
                     # traffic is the price of the ground-truth probe
                     t, metrics, g = _fused_local(p, local_batch, tot,
-                                                 ndev, with_dense=True)
+                                                 C, with_dense=True,
+                                                 emit=emit)
                     return (jax.lax.psum(t, CLIENT_AXIS),
                             jax.lax.psum(g, CLIENT_AXIS), metrics)
-                t, metrics = _fused_local(p, local_batch, tot, ndev)
+                t, metrics = _fused_local(p, local_batch, tot, C,
+                                          emit=emit)
                 # the round's ONE all-reduce (reference
                 # fed_worker.py:139-140 NCCL reduce): sketch tables in
-                # sketch mode — inter-chip traffic stays compressed
+                # sketch mode — inter-chip traffic stays compressed,
+                # and on a 2D mesh it runs on the already
+                # reduce-scattered (r, c/M) shard
                 return jax.lax.psum(t, CLIENT_AXIS), metrics
 
+            agg_spec = (table_shard_spec() if shard2d
+                        else replicated_spec())
             if want_dense:
                 aggregated, dense_g, metrics = shard_map(
                     block, mesh=mesh,
-                    in_specs=(P(), P(CLIENT_AXIS), P()),
-                    out_specs=(P(), P(), P(CLIENT_AXIS)))(
-                        ps_weights, batch, total)
+                    in_specs=(replicated_spec(), client_spec(),
+                              replicated_spec()),
+                    out_specs=(agg_spec, replicated_spec(),
+                               client_spec()))(ps_weights, batch,
+                                               total)
             else:
                 aggregated, metrics = shard_map(
                     block, mesh=mesh,
-                    in_specs=(P(), P(CLIENT_AXIS), P()),
-                    out_specs=(P(), P(CLIENT_AXIS)))(ps_weights, batch,
-                                                     total)
+                    in_specs=(replicated_spec(), client_spec(),
+                              replicated_spec()),
+                    out_specs=(agg_spec, client_spec()))(ps_weights,
+                                                         batch, total)
         elif want_dense:
             aggregated, metrics, dense_g = _fused_local(
                 ps_weights, batch, total, 1, with_dense=True)
@@ -480,6 +546,9 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
 
         chunk = getattr(cfg, "client_chunk", 0)
         ndev = mesh.devices.size if mesh is not None else 1
+        from commefficient_tpu.parallel.mesh import model_axis_size
+        shard2d_late = (model_axis_size(mesh) > 1
+                        and cfg.mode == "sketch" and sketch_late)
         if 0 < chunk < W and ndev == 1:
             return _client_round_chunked(ps_weights, client_states,
                                          batch, client_ids, rngs,
@@ -511,7 +580,9 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                                               probes=probes)
         elif sketch_late:
             aggregated = _sketch_after_local_sum(
-                sketch, transmit, mesh) / total
+                sketch, transmit, mesh,
+                emit=_partial_table_emit if shard2d_late else None
+            ) / total
         else:
             aggregated = jnp.sum(transmit, axis=0) / total
 
@@ -716,22 +787,33 @@ def _round_bn_stats(stats_fn, ps_weights, batch):
     return mean_stats, jnp.sum(n)
 
 
-def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh):
+def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh,
+                            emit=None):
     """(W, d) dense transmits -> (r, c) summed table: per-device local
-    dense sum, one sketch per device, psum of tables over the mesh."""
+    dense sum, one sketch per device, psum of tables over the mesh.
+    ``emit`` (2D mesh, sketch mode) replaces the full per-device
+    sketch with the partial-slice sketch + reduce-scatter over
+    ``model`` (build_client_round._partial_table_emit); the returned
+    table is then column-sharded (parallel/mesh.table_shard_spec)."""
+    from commefficient_tpu.parallel.mesh import (CLIENT_AXIS,
+                                                 client_axis_size,
+                                                 replicated_spec,
+                                                 shard_map, spec,
+                                                 table_shard_spec)
     W = transmit.shape[0]
-    if mesh is not None and W % mesh.devices.size == 0 \
+    if mesh is not None and W % client_axis_size(mesh) == 0 \
             and mesh.devices.size > 1:
-        from jax.sharding import PartitionSpec as P
-        from commefficient_tpu.parallel.mesh import CLIENT_AXIS, shard_map
 
-        def block(local):  # (W/n_dev, d) on each device
-            table = sketch.sketch(jnp.sum(local, axis=0))
+        def block(local):  # (W/C, d) on each client-axis shard
+            g = jnp.sum(local, axis=0)
+            table = sketch.sketch(g) if emit is None else emit(g)
             return jax.lax.psum(table, CLIENT_AXIS)
 
-        return shard_map(block, mesh=mesh,
-                         in_specs=P(CLIENT_AXIS, None),
-                         out_specs=P())(transmit)
+        return shard_map(
+            block, mesh=mesh,
+            in_specs=spec(CLIENT_AXIS, None),
+            out_specs=(replicated_spec() if emit is None
+                       else table_shard_spec()))(transmit)
     return sketch.sketch(jnp.sum(transmit, axis=0))
 
 
@@ -896,7 +978,8 @@ def build_val_fn(cfg: Config, loss_fn: Callable,
     return val_shards
 
 
-def build_server_round(cfg: Config, probes: bool = False) -> Callable:
+def build_server_round(cfg: Config, probes: bool = False,
+                       mesh=None) -> Callable:
     """Returns jit-able ``server_round(ps_weights, server_state,
     aggregated, lr, client_velocities, client_ids, noise_rng) ->
     (new_ps_weights, new_server_state, new_client_velocities,
@@ -913,6 +996,14 @@ def build_server_round(cfg: Config, probes: bool = False) -> Callable:
     dict (core/server.py server_update) — so the default arity stays
     five and probes-off callers build a bit-identical program.
 
+    ``mesh`` with a ``model`` axis of size > 1 (parallel/mesh
+    make_mesh2d) switches to the model-sharded server programs: the
+    shard-mapped distributed-select step for sketch mode
+    (core/server.py sketched_update_2d), GSPMD sharding constraints
+    for uncompressed — same signature, same return arity. Any other
+    mesh (None, 1-D, ``Cx1``) builds today's replicated program,
+    HLO-identical to a build without the parameter.
+
     Covers FedOptimizer.step (fed_aggregator.py:431-460) including
     true_topk's masking of participating clients' local velocities at
     the global top-k coordinates (fed_aggregator.py:530-535) — done
@@ -921,6 +1012,13 @@ def build_server_round(cfg: Config, probes: bool = False) -> Callable:
     """
     cfg.validate_runtime()
     sketch = args2sketch(cfg)
+    from commefficient_tpu.parallel.mesh import model_axis_size
+    if model_axis_size(mesh) > 1:
+        if cfg.mode == "sketch":
+            return _build_server_round_2d_sketch(cfg, sketch, mesh,
+                                                 probes)
+        assert cfg.mode == "uncompressed", cfg.mode  # config gate
+        return _build_server_round_2d_dense(cfg, mesh, probes)
 
     def server_round(ps_weights, server_state: ServerState, aggregated,
                      lr, client_velocities=None, client_ids=None,
@@ -962,6 +1060,86 @@ def build_server_round(cfg: Config, probes: bool = False) -> Callable:
             rows = rows * res.client_velocity_keep.astype(rows.dtype)
             new_vel = client_velocities.at[client_ids].set(rows)
         out = (new_ps, res.state, new_vel, res.weight_update,
+               res.support)
+        return out + (res.probes,) if probes else out
+
+    return server_round
+
+
+def _build_server_round_2d_sketch(cfg: Config, sketch: CountSketch,
+                                  mesh, probes: bool) -> Callable:
+    """Model-sharded FetchSGD server round: shard_map over the full 2D
+    mesh with the (r, c) state/aggregate column-sharded over ``model``
+    (replicated over ``clients`` — the block is client-invariant).
+    The body is core/server.py sketched_update_2d: shard-local
+    momentum/error accumulation, one table all-gather, distributed
+    threshold-select recovery. The dense weight update, support, and
+    probe scalars come back identical on every peer (deterministic
+    functions of all-gathered data), so they exit replicated; the new
+    state exits on its column shards — per-device server state stays
+    1/M across rounds."""
+    from commefficient_tpu.core.server import sketched_update_2d
+    from commefficient_tpu.parallel.mesh import (MODEL_AXIS,
+                                                 model_axis_size,
+                                                 replicated_spec,
+                                                 shard_map,
+                                                 table_shard_spec)
+    M = model_axis_size(mesh)
+    ts, rs = table_shard_spec(), replicated_spec()
+
+    def body(state, agg, lr):
+        res = sketched_update_2d(cfg, sketch, agg, state, lr,
+                                 MODEL_AXIS, M, probes=probes)
+        out = (res.weight_update, res.state, res.support)
+        return out + ((res.probes,) if probes else ())
+
+    out_specs = (rs, ServerState(ts, ts), (rs, rs))
+    if probes:
+        out_specs = out_specs + (rs,)
+    step = shard_map(body, mesh=mesh,
+                     in_specs=(ServerState(ts, ts), ts, rs),
+                     out_specs=out_specs)
+
+    def server_round(ps_weights, server_state: ServerState, aggregated,
+                     lr, client_velocities=None, client_ids=None,
+                     noise_rng=None):
+        del client_ids, noise_rng  # sketch mode uses neither
+        out = step(server_state, aggregated,
+                   jnp.asarray(lr, jnp.float32))
+        weight_update, new_state, support = out[:3]
+        new_ps = ps_weights - weight_update
+        ret = (new_ps, new_state, client_velocities, weight_update,
+               support)
+        return ret + (out[3],) if probes else ret
+
+    return server_round
+
+
+def _build_server_round_2d_dense(cfg: Config, mesh,
+                                 probes: bool) -> Callable:
+    """Model-sharded uncompressed server round: the 1-D math verbatim
+    (it is elementwise in d) with GSPMD sharding constraints — the
+    momentum buffer is pinned model-sharded so per-device server state
+    stays 1/M, and the update is pinned replicated where it meets the
+    replicated params. No shard_map needed: XLA partitions the
+    elementwise chain along the constraint."""
+    from commefficient_tpu.parallel.mesh import (replicated,
+                                                 server_state_sharding)
+    state_sh = server_state_sharding(mesh, cfg.transmit_shape)
+    repl = replicated(mesh)
+
+    def server_round(ps_weights, server_state: ServerState, aggregated,
+                     lr, client_velocities=None, client_ids=None,
+                     noise_rng=None):
+        del client_ids
+        res: ServerUpdate = server_update(cfg, aggregated, server_state,
+                                          lr, None, noise_rng,
+                                          probes=probes)
+        new_state = jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(x, state_sh),
+            res.state)
+        upd = jax.lax.with_sharding_constraint(res.weight_update, repl)
+        out = (ps_weights - upd, new_state, client_velocities, upd,
                res.support)
         return out + (res.probes,) if probes else out
 

@@ -40,13 +40,15 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from commefficient_tpu.compat import axis_size
 from commefficient_tpu.models.gpt2 import (GPT2Config, GPT2DoubleHeads,
                                            lm_nll_sums_chunked,
                                            token_nll)
-from commefficient_tpu.parallel.mesh import CLIENT_AXIS, shard_map
+from commefficient_tpu.parallel.mesh import (CLIENT_AXIS, client_spec,
+                                             replicated_spec, shard_map,
+                                             spec)
 
 SEQ_AXIS = "seq"
 
@@ -181,13 +183,13 @@ def build_sp_gpt2_round(cfg: GPT2Config, mesh: Mesh,
         # reassembles the global (W,) vector
         return g / n_clients, losses * w
 
-    tok = P(CLIENT_AXIS, None, None, SEQ_AXIS)
-    per_client = P(CLIENT_AXIS)
+    tok = spec(CLIENT_AXIS, None, None, SEQ_AXIS)
+    per_client = client_spec()
     fn = shard_map(
         block, mesh=mesh,
-        in_specs=(P(), tok, tok, tok, per_client, per_client,
-                  per_client),
-        out_specs=(P(), per_client))
+        in_specs=(replicated_spec(), tok, tok, tok, per_client,
+                  per_client, per_client),
+        out_specs=(replicated_spec(), per_client))
 
     def round_fn(flat_params, batch):
         return fn(flat_params, batch["input_ids"],

@@ -32,10 +32,19 @@ class ServerState(NamedTuple):
     Verror: jax.Array
 
     @staticmethod
-    def init(cfg: Config) -> "ServerState":
+    def init(cfg: Config, sharding=None) -> "ServerState":
+        """``sharding`` (a NamedSharding from
+        parallel/mesh.server_state_sharding) places the buffers
+        model-sharded on a 2D mesh so per-device server memory scales
+        as 1/``model``; None keeps the replicated 1-D layout."""
         shape = cfg.transmit_shape
-        return ServerState(jnp.zeros(shape, jnp.float32),
-                           jnp.zeros(shape, jnp.float32))
+
+        def z():
+            buf = jnp.zeros(shape, jnp.float32)
+            return buf if sharding is None else jax.device_put(
+                buf, sharding)
+
+        return ServerState(z(), z())
 
 
 class ServerUpdate(NamedTuple):
@@ -307,5 +316,107 @@ def _sketched(cfg, sketched_grad, state, lr, sketch, noise_rng,
         # of materialising the dense (d,) vector
         return ServerUpdate(None, new_state, None, support,
                             probes=pr)
+    return ServerUpdate(update * lr, new_state, None, support,
+                        probes=pr)
+
+
+def _psum_l2(x, axis_name) -> jax.Array:
+    return jnp.sqrt(jax.lax.psum(jnp.sum(jax.lax.square(x)),
+                                 axis_name))
+
+
+def sketched_update_2d(cfg: Config, sketch: CountSketch,
+                       sketched_grad_loc: jax.Array,
+                       state: ServerState, lr,
+                       axis_name: str, n_model: int,
+                       probes: bool = False) -> ServerUpdate:
+    """Shard-local FetchSGD server step for the 2D ``clients`` ×
+    ``model`` mesh — runs INSIDE shard_map with the sketch table's
+    columns sharded over ``axis_name`` (``n_model`` peers, c/M columns
+    each). Momentum and error-feedback accumulation stay shard-local,
+    so per-device server state and the accumulate FLOPs scale as 1/M.
+    Recovery re-materialises the full (r, c) table once per round (one
+    tiled all-gather, 4·r·c bytes on the wire) and then runs as a
+    distributed select: each peer estimates only its own contiguous
+    d/M coordinate slice (``estimates_at``, bit-identical per
+    coordinate to the rolled ``estimates``), the global k-th value is
+    agreed via psum'd radix histograms, and the k winners are gathered
+    (``distributed_threshold_mask_1d``). The selected set — hence the
+    dense update, the support, and the re-sketch keep mask — matches
+    the 1-D ``_sketched`` selection (lowest-index tie-break, same set
+    as ``lax.top_k``)."""
+    assert cfg.error_type in ("none", "virtual", "local")
+    if cfg.error_type == "local":
+        assert cfg.virtual_momentum == 0
+    elif cfg.error_type == "virtual":
+        assert cfg.local_momentum == 0
+
+    d = cfg.grad_size
+    k = min(cfg.k, d)
+    Vvel = sketched_grad_loc + cfg.virtual_momentum * state.Vvelocity
+    if cfg.error_type == "local":
+        Verr = Vvel
+    elif cfg.error_type == "virtual":
+        Verr = state.Verror + Vvel
+    else:  # "none": zero updates forever, like the 1-D path
+        Verr = state.Verror
+
+    table = jax.lax.all_gather(Verr, axis_name, axis=1, tiled=True)
+
+    # shard-local estimates over this peer's coordinate slice
+    # [p·⌈d/M⌉, (p+1)·⌈d/M⌉); tail-shard padding slots are masked out
+    # of the selection population, not zeroed into it
+    p = jax.lax.axis_index(axis_name)
+    n_loc = -(-d // n_model)
+    start = (p * n_loc).astype(jnp.int32)
+    gidx = start + jnp.arange(n_loc, dtype=jnp.int32)
+    valid = gidx < d
+    est = sketch.estimates_at(table, jnp.minimum(gidx, d - 1))
+    est = jnp.where(valid, est, 0.0)
+
+    from commefficient_tpu.ops.topk import distributed_threshold_mask_1d
+    take = distributed_threshold_mask_1d(jax.lax.square(est), k,
+                                         axis_name, valid=valid)
+    # candidate extraction: pack this shard's winners into k slots
+    # (index d = "empty"), gather all M·k slots, compact to exactly k —
+    # the distributed mask selects exactly k coordinates globally
+    pos = jnp.nonzero(take, size=k, fill_value=0)[0]
+    n_take = jnp.sum(take.astype(jnp.int32))
+    slot_ok = jnp.arange(k) < n_take
+    cand_idx = jnp.where(slot_ok, start + pos.astype(jnp.int32), d)
+    cand_val = jnp.where(slot_ok, est[pos], 0.0)
+    cand_idx = jax.lax.all_gather(cand_idx, axis_name, tiled=True)
+    cand_val = jax.lax.all_gather(cand_val, axis_name, tiled=True)
+    sel = jnp.nonzero(cand_idx < d, size=k, fill_value=0)[0]
+    idx = jnp.minimum(cand_idx[sel], d - 1)  # ascending global order
+    vals = cand_val[sel]
+
+    dense_mass = (jax.lax.square(CountSketch.l2estimate(table))
+                  if probes else None)
+    update = jnp.zeros(d, jnp.float32).at[idx].add(
+        vals, mode="promise_in_bounds", unique_indices=True,
+        indices_are_sorted=True)
+    support = _lr_scaled_support(idx, vals, lr)
+
+    # re-sketch the recovered update, slice this peer's columns, mask
+    st = sketch.sketch_sparse(idx, vals)
+    c_loc = Verr.shape[1]
+    st_loc = jax.lax.dynamic_slice(st, (0, p * c_loc),
+                                   (st.shape[0], c_loc))
+    keep = st_loc == 0
+    if cfg.error_type == "virtual":
+        Verr = jnp.where(keep, Verr, 0.0)
+    Vvel = jnp.where(keep, Vvel, 0.0)
+    if cfg.error_type == "local":
+        Verr = Vvel
+    new_state = ServerState(Vvel, Verr)
+
+    pr = None
+    if probes:
+        pr = {"update_norm": _l2(update * lr),
+              "momentum_norm": _psum_l2(Vvel, axis_name),
+              "residual_norm": _psum_l2(Verr, axis_name),
+              "mass_coverage": _coverage(
+                  jnp.sum(jax.lax.square(vals)), dense_mass)}
     return ServerUpdate(update * lr, new_state, None, support,
                         probes=pr)

@@ -460,6 +460,26 @@ class CountSketch:
         pos = jnp.arange(self._padded_d, dtype=jnp.int32)
         return jnp.where(pos < self.d, est_full, 0.0)
 
+    def estimates_at(self, table: jax.Array,
+                     idx: jax.Array) -> jax.Array:
+        """Median-of-rows estimates for an arbitrary int32 index
+        vector — the gather-based dual of ``estimates()``. Element i
+        of row ``row`` in the rolled path reads
+        ``table[row, (i % c + rot[row, i // c]) % c]`` times the sign
+        bit, which is exactly the (bucket, sign) pair ``hashes()``
+        produces, so this is bit-identical per coordinate to
+        ``estimates(table)[idx]`` (same float32 products, same
+        median) while doing O(r·n) work instead of O(r·d). Used by
+        the 2D server round, where each model peer estimates only its
+        own coordinate slice of the gathered table. Indices must be
+        in [0, padded_d); padded-tail indices return garbage, so
+        callers mask them out themselves."""
+        assert table.shape == (self.r, self.c), table.shape
+        buckets, signs = self.hashes(idx)
+        vals = jnp.take_along_axis(
+            table, buckets.astype(jnp.int32), axis=1) * signs
+        return jnp.median(vals, axis=0)
+
     @partial(jax.jit, static_argnums=(0, 2, 3, 4))
     def unsketch(self, table: jax.Array, k: int,
                  with_support: bool = False,

@@ -1,7 +1,11 @@
 from commefficient_tpu.parallel.mesh import (  # noqa: F401
+    client_axis_size,
     client_sharding,
     make_mesh,
+    make_mesh2d,
+    model_axis_size,
     replicated,
+    server_state_sharding,
 )
 from commefficient_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

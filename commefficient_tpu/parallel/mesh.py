@@ -43,11 +43,95 @@ else:
     shard_map = _functools.partial(_shard_map, check_rep=False)
 
 CLIENT_AXIS = "clients"
+MODEL_AXIS = "model"
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devices), (CLIENT_AXIS,))
+
+
+def make_mesh2d(n_clients: int, n_model: int,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """``clients`` × ``model`` mesh for pod-scale rounds: client
+    fwd/bwd stays data-parallel over ``clients`` while server state
+    (sketch table columns, momentum, error feedback) shards over
+    ``model`` so per-device server memory scales as 1/``model``.
+    ``--mesh 1x1`` and ``Mx1`` shapes keep the model axis at size 1,
+    which every consumer treats as "replicated exactly like the 1-D
+    mesh" — the compiled program is identical."""
+    devices = list(devices) if devices is not None else jax.devices()
+    need = n_clients * n_model
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {n_clients}x{n_model} needs {need} devices, "
+            f"have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(n_clients, n_model)
+    return Mesh(arr, (CLIENT_AXIS, MODEL_AXIS))
+
+
+def client_axis_size(mesh: Mesh) -> int:
+    """Devices along ``clients`` — the divisor for batch sharding and
+    client-state padding (NOT ``mesh.devices.size``, which overcounts
+    on a 2D mesh)."""
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get(CLIENT_AXIS, mesh.devices.size))
+
+
+def model_axis_size(mesh: Mesh) -> int:
+    """Devices along ``model`` (1 for 1-D meshes / None): the server
+    state shard count. All 2D-specific code gates on this being > 1."""
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get(MODEL_AXIS, 1))
+
+
+# ---------------------------------------------------------------------------
+# Sanctioned PartitionSpec constructors. Everything outside parallel/
+# must build specs through these (the ``inline-partition-spec`` lint
+# rule, analysis/lint.py) so sharding layout has one source of truth.
+
+def client_spec() -> P:
+    """Leading axis sharded over ``clients`` (batches, client state)."""
+    return P(CLIENT_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def spec(*axes) -> P:
+    """Generic escape hatch for composed layouts (e.g. the
+    ``clients`` × ``seq`` specs in core/rounds_sp.py). Prefer the
+    named constructors for anything that is server state."""
+    return P(*axes)
+
+
+def table_shard_spec() -> P:
+    """Count-sketch table (r, c): rows replicated, columns sharded
+    over ``model`` — every model peer owns a c/M column slice of all
+    r rows, so shard-local bucket reads stay contiguous."""
+    return P(None, MODEL_AXIS)
+
+
+def server_state_spec(transmit_shape) -> P:
+    """Server momentum / error-feedback buffers, shaped like the
+    transmit: (r, c) sketch tables shard columns over ``model``;
+    (d,) dense vectors shard the coordinate axis over ``model``."""
+    if len(transmit_shape) == 2:
+        return table_shard_spec()
+    return P(MODEL_AXIS)
+
+
+def server_state_sharding(mesh: Mesh, transmit_shape) -> NamedSharding:
+    """NamedSharding for ServerState leaves: model-sharded when the
+    mesh has a model axis of size > 1, replicated otherwise (exactly
+    the 1-D layout). NamedSharding pads uneven dims internally, so
+    (d,) vectors need no divisibility."""
+    if model_axis_size(mesh) <= 1:
+        return replicated(mesh)
+    return NamedSharding(mesh, server_state_spec(transmit_shape))
 
 
 def first_local_device() -> jax.Device:
@@ -148,8 +232,9 @@ def padded_rows(num_clients: int, mesh: Mesh) -> int:
     NamedSharding rejects non-divisible dims, so round up to the mesh
     size (padded rows are never indexed — client ids < num_clients).
     Single source of truth for ClientStates.init and checkpoint
-    restore."""
-    n = mesh.devices.size
+    restore. On a 2D mesh only the ``clients`` axis divides the
+    leading dim (rows are replicated over ``model``)."""
+    n = client_axis_size(mesh)
     return -(-num_clients // n) * n
 
 
@@ -164,7 +249,7 @@ def shard_batch(mesh: Mesh, tree):
     load-balanced; pick num_workers divisible by the device count for
     full throughput. The fallback warns once per W so the perf cliff
     is never silent (round-1 review, "mesh-shape perf cliffs")."""
-    n = mesh.devices.size
+    n = client_axis_size(mesh)
 
     def put(x):
         if x.shape[0] % n == 0:

@@ -47,8 +47,11 @@ from commefficient_tpu.core.rounds import (ClientStates,
 from commefficient_tpu.core.server import ServerState
 from commefficient_tpu.telemetry import build_telemetry, clock, trace
 from commefficient_tpu.ops.vec import flatten_params
-from commefficient_tpu.parallel import make_mesh
-from commefficient_tpu.parallel.mesh import client_sharding, shard_batch
+from commefficient_tpu.parallel import make_mesh, make_mesh2d
+from commefficient_tpu.parallel.mesh import (client_sharding,
+                                             model_axis_size,
+                                             server_state_sharding,
+                                             shard_batch)
 
 # the most recently constructed FedModel; lets FedOptimizer(args) find
 # its runtime without an explicit handle — honest parity with the
@@ -116,7 +119,13 @@ class FedModel:
                         "multi-host pods the mesh must span every "
                         "process's devices (leave it at -1)")
                 devices = devices[: args.num_devices]
-            mesh = make_mesh(devices)
+            mesh2d = getattr(args, "mesh2d", None)
+            # --mesh CxM: the pod-scale 2D mesh (clients × model).
+            # Cx1 shapes behave exactly like the 1-D mesh (every 2D
+            # code path gates on model_axis_size > 1); 1x1 compiles
+            # the single-device program
+            mesh = (make_mesh2d(*mesh2d, devices) if mesh2d
+                    else make_mesh(devices))
         self.mesh = mesh
 
         num_clients = args.num_clients
@@ -286,6 +295,8 @@ class FedModel:
             process_index=topo["process_index"],
             process_count=topo["process_count"],
             clientstore=self.clientstore,
+            mesh_shape={str(k): int(v)
+                        for k, v in dict(self.mesh.shape).items()},
             plan=round_plan(args))
 
         _CURRENT_MODEL = self
@@ -817,14 +828,26 @@ class FedOptimizer:
                 v[group["index"]] = 1.0
                 inds.append(jnp.asarray(v))
             self._lr_indicators = inds
-        self.server_state = ServerState.init(self.args)
+        # 2D mesh: server momentum/error buffers are created (and the
+        # server round built) model-sharded — per-device server state
+        # is 1/M from the first round, never resharded from a
+        # replicated allocation. Cx1/1-D meshes keep today's exact
+        # replicated construction.
+        mesh = self.model.mesh
+        sharded = model_axis_size(mesh) > 1
+        self.server_state = ServerState.init(
+            self.args,
+            sharding=(server_state_sharding(mesh,
+                                            self.args.transmit_shape)
+                      if sharded else None))
         # donate weights + server state: both are replaced by the
         # round's outputs and the stale buffers are never read again —
         # at GPT-2 scale that's ~1 GB of peak HBM saved per step
         self._probes = int(getattr(self.args, "probe_period", 0)
                            or 0) > 0
         self._server_round = jax.jit(
-            build_server_round(self.args, probes=self._probes),
+            build_server_round(self.args, probes=self._probes,
+                               mesh=mesh if sharded else None),
             donate_argnums=(0, 1))
         self._noise_rng = jax.random.PRNGKey(self.args.seed + 1)
         self._step_count = 0

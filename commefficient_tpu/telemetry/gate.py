@@ -135,19 +135,42 @@ def metrics_from_records(records) -> Dict[str, Dict]:
     return out
 
 
-def topology_key(device_count=None, process_count=None) -> str:
+def mesh_suffix(mesh_shape) -> str:
+    """Canonical key fragment for a run's mesh layout: ``m<C>x<M>``
+    for a genuinely 2D (clients x model) mesh, ``""`` for the 1-D
+    layouts every pre-mesh run used — so existing ``d<D>p<P>`` pins
+    keep matching 1-D runs unchanged, and only mesh-sharded runs get
+    (and require) their own entry. Accepts the ledger/manifest dict
+    form ({"clients": C, "model": M}) or a (C, M) pair."""
+    if not mesh_shape:
+        return ""
+    if isinstance(mesh_shape, dict):
+        c = int(mesh_shape.get("clients", 0) or 0)
+        m = int(mesh_shape.get("model", 0) or 0)
+    else:
+        c, m = (int(x) for x in tuple(mesh_shape)[:2])
+    if m <= 1:
+        return ""
+    return f"m{c}x{m}"
+
+
+def topology_key(device_count=None, process_count=None,
+                 mesh_shape=None) -> str:
     """Baseline entry key for one topology point. ``d<D>p<P>`` when
-    both counts are known, :data:`ANY_TOPOLOGY` otherwise — unknown
+    both counts are known — suffixed ``m<C>x<M>`` for 2D-mesh runs
+    (a 4x2 and an 8x1 run on the same 8 chips are different programs,
+    not one noise band) — :data:`ANY_TOPOLOGY` otherwise: unknown
     topologies form their own bucket rather than silently matching a
     counted one."""
     if device_count is None or process_count is None:
         return ANY_TOPOLOGY
-    return f"d{int(device_count)}p{int(process_count)}"
+    return (f"d{int(device_count)}p{int(process_count)}"
+            f"{mesh_suffix(mesh_shape)}")
 
 
 def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                         device_count=None, process_count=None,
-                        config_hash: str = "") -> Dict:
+                        config_hash: str = "", mesh_shape=None) -> Dict:
     entry = {"ts": clock.wall(), "source": source, "metrics": metrics}
     if device_count is not None:
         entry["device_count"] = int(device_count)
@@ -155,18 +178,24 @@ def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
         entry["process_count"] = int(process_count)
     if config_hash:
         entry["config_hash"] = config_hash
+    if mesh_suffix(mesh_shape):
+        entry["mesh_shape"] = (dict(mesh_shape)
+                               if isinstance(mesh_shape, dict)
+                               else list(mesh_shape))
     return entry
 
 
 def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
                   extra: Dict = None, device_count=None,
-                  process_count=None, config_hash: str = "") -> Dict:
+                  process_count=None, config_hash: str = "",
+                  mesh_shape=None) -> Dict:
     """A fresh schema-2 baseline holding one topology entry."""
-    key = topology_key(device_count, process_count)
+    key = topology_key(device_count, process_count, mesh_shape)
     base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
             "topologies": {key: make_topology_entry(
                 metrics, source=source, device_count=device_count,
-                process_count=process_count, config_hash=config_hash)}}
+                process_count=process_count, config_hash=config_hash,
+                mesh_shape=mesh_shape)}}
     if extra:
         base.update(extra)
     return base
@@ -188,7 +217,8 @@ def migrate_baseline(baseline: Dict) -> Dict:
 
 def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
                     source: str = "", device_count=None,
-                    process_count=None, config_hash: str = "") -> Dict:
+                    process_count=None, config_hash: str = "",
+                    mesh_shape=None) -> Dict:
     """Insert/replace ONE topology's entry, leaving every other
     topology point untouched — how the gate CLI re-captures the
     8-device headline without disturbing the single-chip one.
@@ -197,20 +227,24 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
         {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
          "topologies": {}}
     base["topologies"] = dict(base.get("topologies", {}))
-    key = topology_key(device_count, process_count)
+    key = topology_key(device_count, process_count, mesh_shape)
     base["topologies"][key] = make_topology_entry(
         metrics, source=source, device_count=device_count,
-        process_count=process_count, config_hash=config_hash)
+        process_count=process_count, config_hash=config_hash,
+        mesh_shape=mesh_shape)
     base["ts"] = clock.wall()
     return base
 
 
 def baseline_entry(baseline: Dict, device_count=None,
-                   process_count=None):
+                   process_count=None, mesh_shape=None):
     """The topology entry ``compare`` gates against, or None when the
-    baseline has no entry for this topology. Schema-1 baselines
-    resolve for ANY topology (their historical, topology-blind
-    behaviour — re-capture to get keyed guarding)."""
+    baseline has no entry for this topology. A 2D-mesh run resolves
+    its exact ``d<D>p<P>m<C>x<M>`` entry first and falls back to the
+    mesh-blind ``d<D>p<P>`` pin (pins captured before mesh keying
+    existed keep gating until re-captured — migration, not a hole).
+    Schema-1 baselines resolve for ANY topology (their historical,
+    topology-blind behaviour — re-capture to get keyed guarding)."""
     schema = baseline.get("schema")
     if schema not in READABLE_BASELINE_SCHEMAS:
         raise ValueError(
@@ -219,8 +253,13 @@ def baseline_entry(baseline: Dict, device_count=None,
     if schema == 1:
         return {"source": baseline.get("source", ""),
                 "metrics": baseline.get("metrics", {})}
-    return baseline.get("topologies", {}).get(
-        topology_key(device_count, process_count))
+    topologies = baseline.get("topologies", {})
+    entry = topologies.get(
+        topology_key(device_count, process_count, mesh_shape))
+    if entry is None and mesh_suffix(mesh_shape):
+        entry = topologies.get(
+            topology_key(device_count, process_count))
+    return entry
 
 
 def _threshold(base_entry: Dict, rel_tol: float, mad_k: float):
@@ -231,7 +270,7 @@ def _threshold(base_entry: Dict, rel_tol: float, mad_k: float):
 def compare(baseline: Dict, metrics: Dict[str, Dict],
             rel_tol: float = REL_TOL,
             mad_k: float = MAD_K, device_count=None,
-            process_count=None) -> Dict:
+            process_count=None, mesh_shape=None) -> Dict:
     """Gate ``metrics`` against ``baseline``'s entry for this
     topology. Returns::
 
@@ -244,8 +283,9 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
     0.1 ms for ms-metrics, 100 µs for s-metrics). Raises ValueError
     when the baseline has no entry for this topology — an ungated
     topology point must fail loudly, not pass silently."""
-    key = topology_key(device_count, process_count)
-    entry = baseline_entry(baseline, device_count, process_count)
+    key = topology_key(device_count, process_count, mesh_shape)
+    entry = baseline_entry(baseline, device_count, process_count,
+                           mesh_shape)
     if entry is None:
         have = ", ".join(sorted(baseline.get("topologies", {}))) \
             or "none"

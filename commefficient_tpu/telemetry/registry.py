@@ -94,13 +94,26 @@ def run_topology(manifest: dict) -> tuple:
             int(pc) if pc is not None else None)
 
 
+def run_mesh_shape(manifest: dict):
+    """The run's recorded mesh layout ({axis: size} dict) or None —
+    pre-mesh manifests and 1-D runs record nothing here."""
+    shape = manifest.get("mesh_shape")
+    return dict(shape) if isinstance(shape, dict) else None
+
+
 def run_key(manifest: dict) -> tuple:
     """(config_hash, device_count, process_count): two runs are
     comparable — diffable by the report, gateable against one
     baseline entry — only when ALL three match. Config hash alone is
     not an identity: the same config on 1 vs 8 devices is a scaling
-    experiment, not a regression."""
-    return (manifest.get("config_hash") or "",) + run_topology(manifest)
+    experiment, not a regression. 2D-mesh runs append their
+    ``m<C>x<M>`` fragment (a 4x2 and an 8x1 program on the same chips
+    are different experiments); 1-D runs keep the historical 3-tuple,
+    so old manifests stay comparable to each other."""
+    from commefficient_tpu.telemetry.gate import mesh_suffix
+    key = (manifest.get("config_hash") or "",) + run_topology(manifest)
+    suffix = mesh_suffix(run_mesh_shape(manifest))
+    return key + (suffix,) if suffix else key
 
 
 def write_manifest(runs_dir: str = "runs", *, args=None,

@@ -18,11 +18,16 @@ cumsum sizes, fd behavior) and times:
   --store_budget_mb arena, reporting ``clients_resident_max_local_topk``
   (peak arena rows — the store's working set, independent of the
   population).
+- arrival: round throughput under a data/chaos.py seeded arrival
+  process (correlated dropout bursts + straggler stalls) vs the calm
+  loader — the host-side cost of ragged rounds, with the replayed
+  schedule's burst/alive statistics.
 
 Usage:  python scripts/host_scale_bench.py [--persona_clients 17568]
         [--emnist_writers 3500] [--emnist_images 20] [--workdir DIR]
-        [--only all|persona|emnist|clientstore]
+        [--only all|persona|emnist|clientstore|arrival]
         [--store_scale_clients 1000000] [--store_budget_mb 4]
+        [--arrival_rounds 40] [--arrival_burst_start 0.2]
 
 Results are recorded in BENCHMARKS.md ("Host data-plane at natural
 scale" and "Host client store").
@@ -247,6 +252,116 @@ def bench_clientstore(matched_clients, scale_clients, budget_bytes,
     return out
 
 
+def bench_arrival(num_clients, n_rounds, seed, burst_start,
+                  burst_stop, drop_frac, straggler_every,
+                  straggler_delay_s, dim=64):
+    """Round throughput under a REALISTIC arrival process.
+
+    Every other bench feeds full, punctual rounds; real federated
+    rounds arrive ragged — correlated dropout bursts ("rack went
+    dark") and periodic straggler stalls. This drives the same small
+    sketch workload through a data/chaos.py seeded schedule (the
+    two-state Markov burst chain + straggler sleeps, replayable from
+    one seed) and reports the throughput delta vs the calm loader
+    plus the arrival statistics the schedule actually produced —
+    the host-side cost of raggedness, separated from device math
+    (dead slots are masked, so the compiled program is identical)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.data.chaos import (ChaosConfig,
+                                              ChaosInjector)
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+
+    W, B = 8, 2
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    def make_loader(rng):
+        for r in range(n_rounds + 1):
+            ids = rng.choice(num_clients, W,
+                             replace=False).astype(np.int32)
+            yield {"client_ids": ids,
+                   "x": rng.randn(W, B, dim).astype(np.float32),
+                   "y": rng.randn(W, B).astype(np.float32),
+                   "mask": np.ones((W, B), np.float32)}
+
+    def run(chaos):
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9, k=8,
+                     num_rows=3, num_cols=64, num_workers=W,
+                     local_batch_size=B, num_clients=num_clients,
+                     seed=seed)
+        model = FedModel(None, {"w": jnp.zeros((dim,), jnp.float32)},
+                         loss, cfg, padded_batch_size=B)
+        opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+        loader = make_loader(np.random.RandomState(seed))
+        if chaos is not None:
+            loader = chaos.wrap_loader(loader)
+        alive = []
+        first = next(iter(loader))
+        model(first)  # warmup round: jit compile
+        opt.step()
+        jax.block_until_ready(model.ps_weights)
+        t0 = time.time()
+        for batch in loader:
+            alive.append(float(batch["mask"].any(axis=1).mean()))
+            model(batch)
+            opt.step()
+        jax.block_until_ready(model.ps_weights)
+        dt = (time.time() - t0) / max(len(alive), 1)
+        model.finalize()
+        return dt, alive
+
+    calm_s, _ = run(None)
+    chaos_cfg = ChaosConfig(seed=seed,
+                            burst_start_prob=burst_start,
+                            burst_stop_prob=burst_stop,
+                            burst_drop_frac=drop_frac,
+                            straggler_every=straggler_every,
+                            straggler_delay_s=straggler_delay_s)
+    chaos_s, alive = run(ChaosInjector(chaos_cfg, num_clients))
+
+    # arrival statistics of the replayed schedule
+    ragged = [a for a in alive if a < 1.0]
+    burst_rounds, bursts, in_burst = 0, 0, False
+    longest, cur = 0, 0
+    for a in alive:
+        if a < 1.0:
+            burst_rounds += 1
+            cur += 1
+            if not in_burst:
+                bursts += 1
+            in_burst = True
+            longest = max(longest, cur)
+        else:
+            in_burst, cur = False, 0
+    return {
+        "arrival_rounds": len(alive),
+        "arrival_seed": seed,
+        "arrival_calm_round_ms": round(calm_s * 1e3, 2),
+        "arrival_chaos_round_ms": round(chaos_s * 1e3, 2),
+        "arrival_overhead_pct": round(
+            (chaos_s / calm_s - 1.0) * 100, 1),
+        "arrival_burst_count": bursts,
+        "arrival_burst_rounds": burst_rounds,
+        "arrival_longest_burst": longest,
+        "arrival_alive_frac_min": round(min(alive), 3) if alive
+        else 1.0,
+        "arrival_alive_frac_mean": round(
+            sum(alive) / max(len(alive), 1), 3),
+        "arrival_dropped_client_rounds": round(
+            sum(1.0 - a for a in ragged) * W),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--persona_clients", type=int, default=17568)
@@ -254,13 +369,23 @@ def main():
     ap.add_argument("--emnist_images", type=int, default=20)
     ap.add_argument("--workdir", type=str, default=None)
     ap.add_argument("--only", type=str, default="all",
-                    choices=("all", "persona", "emnist", "clientstore"))
+                    choices=("all", "persona", "emnist", "clientstore",
+                             "arrival"))
     ap.add_argument("--store_matched_clients", type=int, default=4096)
     ap.add_argument("--store_scale_clients", type=int,
                     default=1_000_000)
     ap.add_argument("--store_budget_mb", type=int, default=4)
     ap.add_argument("--store_rounds", type=int, default=20)
     ap.add_argument("--store_dim", type=int, default=256)
+    ap.add_argument("--arrival_clients", type=int, default=256)
+    ap.add_argument("--arrival_rounds", type=int, default=40)
+    ap.add_argument("--arrival_seed", type=int, default=0)
+    ap.add_argument("--arrival_burst_start", type=float, default=0.2)
+    ap.add_argument("--arrival_burst_stop", type=float, default=0.5)
+    ap.add_argument("--arrival_drop_frac", type=float, default=0.5)
+    ap.add_argument("--arrival_straggler_every", type=int, default=10)
+    ap.add_argument("--arrival_straggler_delay_s", type=float,
+                    default=0.05)
     ap.add_argument("--ledger", type=str, default="",
                     help="append the result as a telemetry JSONL "
                     "bench record (stdout line unchanged)")
@@ -280,6 +405,13 @@ def main():
                 args.store_matched_clients, args.store_scale_clients,
                 args.store_budget_mb << 20, args.store_rounds,
                 args.store_dim))
+        if args.only in ("all", "arrival"):
+            out.update(bench_arrival(
+                args.arrival_clients, args.arrival_rounds,
+                args.arrival_seed, args.arrival_burst_start,
+                args.arrival_burst_stop, args.arrival_drop_frac,
+                args.arrival_straggler_every,
+                args.arrival_straggler_delay_s))
     finally:
         if args.workdir is None:
             shutil.rmtree(root, ignore_errors=True)

@@ -65,18 +65,25 @@ def load_ledger_records(path):
 
 
 def resolve_topology(manifest=None, records=(), device_count=None,
-                     process_count=None):
-    """The run's (device_count, process_count) for baseline keying:
-    CLI overrides win, then the run manifest, then the ledger's meta
-    record (``num_devices``; pre-fleet metas never recorded a process
-    count — those ran the single-process path, so 1). (None, None)
-    when nothing knows — such runs gate under the ``any`` bucket."""
+                     process_count=None, mesh_shape=None):
+    """The run's (device_count, process_count, mesh_shape) for
+    baseline keying: CLI overrides win, then the run manifest, then
+    the ledger's meta record (``num_devices``; pre-fleet metas never
+    recorded a process count — those ran the single-process path, so
+    1). (None, None, None) when nothing knows — such runs gate under
+    the ``any`` bucket. ``mesh_shape`` follows the same chain: a CLI
+    "CxM" string, the manifest's recorded dict, or the meta record's
+    ``mesh_shape``; 1-D runs resolve to None (their key is the
+    historical mesh-less one)."""
     dc, pc = device_count, process_count
+    ms = parse_mesh_shape(mesh_shape)
     if manifest is not None:
         mdc, mpc = registry.run_topology(manifest)
         dc = mdc if dc is None else dc
         pc = mpc if pc is None else pc
-    if dc is None or pc is None:
+        if ms is None:
+            ms = registry.run_mesh_shape(manifest)
+    if dc is None or pc is None or ms is None:
         for rec in records:
             if rec.get("kind") != "meta":
                 continue
@@ -86,9 +93,19 @@ def resolve_topology(manifest=None, records=(), device_count=None,
                     pc = int(rec.get("process_count") or 1)
             elif pc is None and rec.get("process_count") is not None:
                 pc = int(rec["process_count"])
-            if dc is not None and pc is not None:
+            if ms is None and isinstance(rec.get("mesh_shape"), dict):
+                ms = dict(rec["mesh_shape"])
+            if dc is not None and pc is not None and ms is not None:
                 break
-    return dc, pc
+    return dc, pc, ms
+
+
+def parse_mesh_shape(mesh_shape):
+    """"CxM" -> {"clients": C, "model": M}; dicts/None pass through."""
+    if mesh_shape is None or isinstance(mesh_shape, dict):
+        return mesh_shape
+    c, m = (int(p) for p in str(mesh_shape).lower().split("x"))
+    return {"clients": c, "model": m}
 
 
 def main(argv=None):
@@ -129,6 +146,11 @@ def main(argv=None):
     ap.add_argument("--process_count", type=int, default=None,
                     help="override the run's process count for "
                          "baseline keying")
+    ap.add_argument("--mesh_shape", default=None,
+                    help="override the run's 2D mesh layout "
+                         "(\"CxM\", e.g. 4x2) for baseline keying "
+                         "(normally read from the manifest / ledger "
+                         "meta; 1-D runs need nothing)")
     args = ap.parse_args(argv)
 
     ledger = args.ledger
@@ -143,7 +165,9 @@ def main(argv=None):
         dc, pc = registry.run_topology(manifest)
         print(f"run: {mpath} (config {manifest.get('config_hash', '')[:8]}, "
               f"git {manifest.get('git_sha', '')[:8]}, "
-              f"topology {gate.topology_key(dc, pc)}) -> {ledger}")
+              f"topology "
+              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest))}"
+              f") -> {ledger}")
     if ledger is None:
         ap.error("one of --ledger / --runs_dir is required")
 
@@ -152,24 +176,30 @@ def main(argv=None):
     if not metrics:
         print(f"{ledger}: no gateable metrics (empty ledger?)")
         return 1
-    dc, pc = resolve_topology(manifest, records,
-                              args.device_count, args.process_count)
-    topo = gate.topology_key(dc, pc)
+    dc, pc, ms = resolve_topology(manifest, records,
+                                  args.device_count,
+                                  args.process_count, args.mesh_shape)
+    topo = gate.topology_key(dc, pc, ms)
     print(f"{ledger}: {len(metrics)} metric(s) extracted "
           f"(topology {topo})")
     chash = (manifest or {}).get("config_hash", "")
 
     verdict = None
     existing = None
+    # a write-only invocation gates against the file it is about to
+    # overwrite; --check gates against the committed --baseline
+    gate_path = (args.write_baseline
+                 if args.write_baseline and not args.check
+                 else args.baseline)
     if args.check or (args.write_baseline
-                      and os.path.exists(args.baseline)
+                      and os.path.exists(gate_path)
                       and not args.force):
-        if not os.path.exists(args.baseline):
-            print(f"baseline {args.baseline} missing — capture one "
+        if not os.path.exists(gate_path):
+            print(f"baseline {gate_path} missing — capture one "
                   "with --write-baseline first")
             return 1
-        existing = gate.load_baseline(args.baseline)
-        entry = gate.baseline_entry(existing, dc, pc)
+        existing = gate.load_baseline(gate_path)
+        entry = gate.baseline_entry(existing, dc, pc, ms)
         if entry is None and args.write_baseline and not args.check:
             # first capture of a NEW topology point: nothing to gate
             # this run against, other points stay untouched
@@ -190,7 +220,8 @@ def main(argv=None):
             verdict = gate.compare(existing, metrics,
                                    rel_tol=args.rel_tol,
                                    mad_k=args.mad_k,
-                                   device_count=dc, process_count=pc)
+                                   device_count=dc, process_count=pc,
+                                   mesh_shape=ms)
             print(gate.render_verdict(verdict))
 
     if args.write_baseline:
@@ -206,7 +237,7 @@ def main(argv=None):
             gate.update_baseline(existing or {}, metrics,
                                  source=os.path.abspath(ledger),
                                  device_count=dc, process_count=pc,
-                                 config_hash=chash),
+                                 config_hash=chash, mesh_shape=ms),
             args.write_baseline)
         print(f"baseline[{topo}] -> {args.write_baseline}")
 

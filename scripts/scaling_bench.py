@@ -5,6 +5,8 @@
         --rounds 8 --runs_dir runs
     python scripts/scaling_bench.py --multihost        # adds a
                                                        # 2-process point
+    python scripts/scaling_bench.py --device_counts 1,8 \
+        --mesh_shapes 8x1,4x2,2x4,1x8                  # 2D-mesh sweep
 
 Each point runs the SAME small FetchSGD round workload (so every
 manifest shares one config hash) in a fresh subprocess pinned to N
@@ -24,6 +26,15 @@ scaling, the gap to 1.0 is what the collective fraction + skew columns
 explain. ``scripts/telemetry_report.py --runs_dir runs`` renders the
 curve; ``scripts/perf_gate.py`` gates each point against its own
 topology-keyed baseline entry.
+
+``--mesh_shapes`` appends one point per 2D (clients x model) mesh
+layout (core/rounds 2D round: column-sharded sketch table,
+reduce-scatter emission, distributed top-k select). Each shape gets
+its own manifest whose ``mesh_shape`` extends the perf-gate topology
+key (``d<D>p<P>m<C>x<M>``), so a 4x2 point and a 2x4 point on the
+same 8 chips are guarded independently. Shapes whose device product
+exceeds the host's cores still run — virtual CPU devices make e.g. a
+32-device ``8x4`` layout a (slow but honest) dryrun.
 
 ``--multihost`` appends a 2-process point via the
 scripts/multihost_smoke.py launcher pattern (free-port coordinator,
@@ -85,7 +96,7 @@ def worker(args):
                  local_momentum=0.0, virtual_momentum=0.9,
                  num_workers=W, local_batch_size=B,
                  num_clients=W * 2, dataset_name="CIFAR10", seed=0,
-                 k=16, num_rows=3, num_cols=256)
+                 k=16, num_rows=3, num_cols=256, mesh=args.mesh)
     cfg.ledger = args.ledger
     cfg.do_profile = True
 
@@ -145,9 +156,12 @@ def worker(args):
             skew = dt_rec.get("skew") or {}
             if skew.get("max_enter_delta_s") is not None:
                 skews.append(skew["max_enter_delta_s"])
+    mesh_shape = {str(k): int(v)
+                  for k, v in dict(model.mesh.shape).items()}
     point = {
         "device_count": int(jax.device_count()),
         "process_count": int(jax.process_count()),
+        "mesh_shape": mesh_shape,
         "clients_per_s": round(clients_per_s, 2),
         "parallel_efficiency": round(eff, 3),
         "collective_fraction": round(
@@ -159,6 +173,7 @@ def worker(args):
         args.runs_dir, args=cfg, ledger=args.ledger,
         bench={"clients_per_s": {"value": point["clients_per_s"],
                                  "unit": "clients/s"}},
+        mesh_shape=mesh_shape,
         extra={"scaling": point})
     print(POINT_TAG + json.dumps(point), flush=True)
     print(f"manifest -> {manifest}", file=sys.stderr)
@@ -166,12 +181,14 @@ def worker(args):
 
 
 def _run_point(n, args, ref, stamp, extra_cmd=(), extra_env=None,
-               nproc=1):
+               nproc=1, tag=""):
     """Spawn worker subprocess(es) for one topology point; returns
-    (point dict, ledger path) or raises RuntimeError."""
+    (point dict, ledger path) or raises RuntimeError. ``tag``
+    disambiguates same-device-count points (two mesh shapes on the
+    same chip count must not share a ledger)."""
     os.makedirs(os.path.join(args.runs_dir, "scaling"), exist_ok=True)
     ledger = os.path.join(args.runs_dir, "scaling",
-                          f"scale_{stamp}_d{n}p{nproc}.jsonl")
+                          f"scale_{stamp}_d{n}p{nproc}{tag}.jsonl")
     dpp = n // nproc
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
            "--devices", str(n), "--rounds", str(args.rounds),
@@ -253,6 +270,11 @@ def main(argv=None):
                          f"{W} workers)")
     ap.add_argument("--rounds", type=int, default=ROUNDS_DEFAULT)
     ap.add_argument("--runs_dir", default="runs")
+    ap.add_argument("--mesh_shapes", default="",
+                    help="comma-separated 2D mesh layouts to append "
+                         "as extra points (e.g. 8x1,4x2,2x4,1x8); "
+                         "each CxM point runs on C*M virtual devices "
+                         f"and C must divide {W} workers")
     ap.add_argument("--multihost", action="store_true",
                     help="append a 2-process point (2 devices per "
                          "process) and merge its ledger shards")
@@ -263,6 +285,7 @@ def main(argv=None):
                     help=argparse.SUPPRESS)
     ap.add_argument("--devices", type=int, default=1,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--mesh", default="", help=argparse.SUPPRESS)
     ap.add_argument("--ledger", default="", help=argparse.SUPPRESS)
     ap.add_argument("--ref_clients_per_s", type=float, default=0.0,
                     help=argparse.SUPPRESS)
@@ -283,17 +306,38 @@ def main(argv=None):
     for n in counts:
         if W % n:
             ap.error(f"device count {n} does not divide {W} workers")
+    shapes = [s.strip() for s in args.mesh_shapes.split(",")
+              if s.strip()]
+    for s in shapes:
+        c, m = (int(p) for p in s.lower().split("x"))
+        if W % c:
+            ap.error(f"mesh shape {s}: clients axis {c} does not "
+                     f"divide {W} workers")
     stamp = int(time.time())
     points, ref = [], None
+
+    def show(label, point):
+        print(f"{label}: {point['clients_per_s']} clients/s, "
+              f"eff {point['parallel_efficiency']:.2f}, "
+              f"collective {point['collective_fraction'] * 100:.1f}%, "
+              f"skew max {point['max_skew_s']} s", flush=True)
+
     for n in counts:
         point, _ = _run_point(n, args, ref, stamp)
         if ref is None:
             ref = (point["clients_per_s"], n)
         points.append(point)
-        print(f"d{n}p1: {point['clients_per_s']} clients/s, "
-              f"eff {point['parallel_efficiency']:.2f}, "
-              f"collective {point['collective_fraction'] * 100:.1f}%, "
-              f"skew max {point['max_skew_s']} s", flush=True)
+        show(f"d{n}p1", point)
+
+    for s in shapes:
+        c, m = (int(p) for p in s.lower().split("x"))
+        point, _ = _run_point(c * m, args, ref, stamp,
+                              extra_cmd=["--mesh", s.lower()],
+                              tag=f"m{c}x{m}")
+        if ref is None:
+            ref = (point["clients_per_s"], c * m)
+        points.append(point)
+        show(f"d{c * m}p1 mesh {c}x{m}", point)
 
     if args.multihost:
         point, ledger = _run_point(4, args, ref, stamp, nproc=2)

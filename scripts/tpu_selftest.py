@@ -297,6 +297,81 @@ def scaling_smoke():
         shutil.rmtree(runs_dir, ignore_errors=True)
 
 
+def mesh2d_smoke():
+    """2D clients x model mesh on the REAL backend: the pod-scale
+    sketch round (partial tables reduce-scattered over ``model``,
+    column-sharded server momentum/EF, distributed top-k select) must
+    match the 1-D oracle round on this hardware, with per-device
+    server shards at 1/M of the table. The mesh shape adapts to the
+    attached topology (model axis 2 whenever the device count is
+    even)."""
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.core.rounds import (ClientStates,
+                                               build_client_round,
+                                               build_server_round)
+    from commefficient_tpu.core.server import ServerState
+    from commefficient_tpu.parallel.mesh import (client_sharding,
+                                                 make_mesh2d,
+                                                 model_axis_size,
+                                                 server_state_sharding)
+
+    n = jax.device_count()
+    m = 2 if n % 2 == 0 else 1
+    c = n // m
+    W, B, d = 2 * c, 2, 1 << 12
+    cfg = Config(mode="sketch", error_type="virtual",
+                 local_momentum=0.0, virtual_momentum=0.9,
+                 weight_decay=5e-4, num_workers=W, local_batch_size=B,
+                 k=64, num_rows=3, num_cols=512, seed=21,
+                 mesh=f"{c}x{m}")
+    cfg.grad_size = d
+    cfg.validate_runtime()
+
+    def lin_loss(p, b):
+        nm = jnp.maximum(jnp.sum(b["mask"]), 1.0)
+        loss = jnp.sum((b["c"] @ p) * b["mask"]) / nm
+        return loss, (loss * 0.0,)
+
+    rng = np.random.RandomState(0)
+    batch = {"c": jnp.asarray(rng.randn(W, B, d).astype(np.float32)),
+             "mask": jnp.ones((W, B), jnp.float32)}
+    flat = jnp.zeros((d,), jnp.float32).at[0].set(0.5)
+
+    def run(mesh):
+        two_d = mesh is not None and model_axis_size(mesh) > 1
+        cr = jax.jit(build_client_round(cfg, lin_loss, B, mesh=mesh))
+        sr = jax.jit(build_server_round(
+            cfg, mesh=mesh if two_d else None))
+        ss = ServerState.init(
+            cfg, sharding=(server_state_sharding(mesh,
+                                                 cfg.transmit_shape)
+                           if two_d else None))
+        ps, cs = flat, ClientStates.init(cfg, W, flat)
+        b = batch
+        if mesh is not None:
+            b = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, client_sharding(mesh)), b)
+        for r in range(2):
+            res = cr(ps, cs, b, jnp.arange(W, dtype=jnp.int32),
+                     jax.random.PRNGKey(r), 1.0)
+            cs = res.client_states
+            ps, ss, _, _, _ = sr(ps, ss, res.aggregated,
+                                 jnp.float32(0.1))
+        return np.asarray(ps), np.asarray(ss.Vvelocity), ss
+
+    ps2, vel2, ss2 = run(make_mesh2d(c, m))
+    ps1, vel1, _ = run(None)
+    scale = max(float(np.abs(ps1).max()), 1e-6)
+    err = float(np.abs(ps2 - ps1).max()) / scale
+    assert err < 1e-4, err
+    np.testing.assert_allclose(vel2, vel1, rtol=0, atol=1e-4)
+    if m > 1:
+        shapes = {tuple(s.data.shape)
+                  for s in ss2.Verror.addressable_shards}
+        assert shapes == {(cfg.num_rows, cfg.num_cols // m)}, shapes
+    return f"mesh {c}x{m}: params rel err {err:.1e}"
+
+
 def chaos_smoke():
     """Byzantine sign-flip under --robust_agg median on the REAL
     backend: a flipped minority must leave the robust fold's aggregate
@@ -366,6 +441,7 @@ def main():
     check("audit_smoke", audit_smoke)
     check("trace_smoke", trace_smoke)
     check("scaling_smoke", scaling_smoke)
+    check("mesh2d_smoke", mesh2d_smoke)
     check("flash_attention_parity", flash_attention_parity)
     check("chaos_smoke", chaos_smoke)
     check("bench_vs_baseline", bench_throughput)

@@ -40,6 +40,7 @@ FAST_MODULES = {
     "test_ops",
     "test_accounting",
     "test_audit",
+    "test_mesh2d",
     "test_sharding",
     "test_data_breadth",
     "test_telemetry",

@@ -61,6 +61,19 @@ def test_collective_inventory_parses_shapes_and_async():
     assert hlo.matching_reduce_bytes(ops, "f32", (64,)) == 256
 
 
+def test_matching_collective_bytes_keys_on_kind():
+    text = ("  %rs = f32[2,8]{1,0} reduce-scatter(f32[2,16]{1,0} %t), "
+            "dimensions={1}\n"
+            "  %ar = f32[2,8]{1,0} all-reduce(f32[2,8]{1,0} %u)\n")
+    ops = hlo.collective_inventory(text)
+    assert hlo.matching_collective_bytes(
+        ops, "reduce-scatter", "f32", (2, 8)) == 64
+    assert hlo.matching_collective_bytes(
+        ops, "all-reduce", "f32", (2, 8)) == 64
+    assert hlo.matching_collective_bytes(
+        ops, "reduce-scatter", "f32", (2, 16)) == 0
+
+
 def test_compiled_alias_count_handles_nested_braces():
     assert hlo.compiled_alias_count(COMPILED_SNIPPET) == 2
     assert hlo.compiled_alias_count("HloModule jit_g, entry=...") == 0
@@ -128,6 +141,11 @@ SEEDED = {
         def lanes():
             return [d.id for d in jax.devices()] + jax.local_devices()
         """, "raw-devices"),
+    "core/speccy.py": ("""
+        from jax.sharding import PartitionSpec as P
+        def layout(mesh):
+            return P("clients")
+        """, "inline-partition-spec"),
 }
 
 
@@ -194,6 +212,30 @@ def test_module_level_numpy_in_ops_is_fine(tmp_path):
 def test_repo_lint_is_clean():
     assert unwaived(run_lint()) == [], \
         "unwaived lint violations in the package"
+
+
+def test_partition_spec_attribute_form_fires(tmp_path):
+    # the attribute spelling (jax.sharding.NamedSharding(...)) must be
+    # caught too, not just the from-import
+    p = tmp_path / "core" / "attr_spec.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import jax.sharding\n"
+                 "def place(mesh, x):\n"
+                 "    s = jax.sharding.NamedSharding(mesh, None)\n"
+                 "    return s\n")
+    hits = unwaived(run_lint(
+        root=tmp_path, rules=[RULES_BY_NAME["inline-partition-spec"]]))
+    assert len(hits) == 1 and hits[0].line == 3
+
+
+def test_partition_spec_allowed_in_parallel(tmp_path):
+    p = tmp_path / "parallel" / "mesh.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("from jax.sharding import NamedSharding, "
+                 "PartitionSpec as P\n")
+    assert run_lint(root=tmp_path,
+                    rules=[RULES_BY_NAME["inline-partition-spec"]]) \
+        == []
 
 
 # --- program audit: regression fixtures --------------------------------
@@ -267,6 +309,31 @@ def test_ledger_bytes_agree_with_accounting_formula(audit_report):
         else:
             assert entry["uplink"]["ledger_bytes_per_client"] == \
                 4 * cfg.grad_size
+
+
+def test_2d_sketch_uplink_shards_by_model_axis(audit_report):
+    """The pod-scale cross-check: on the clients x model mesh both the
+    reduce-scatter (partial tables -> column shards) and the
+    client-axis all-reduce carry exactly ledger/M bytes — the 2D round
+    never moves the full table over a single link."""
+    up = audit_report["programs"]["sketch/fused2d"]["uplink"]
+    assert up["relation"] == "sharded"
+    m = up["model_shards"]
+    assert m > 1
+    assert up["reduce_scatter_bytes"] * m == \
+        up["ledger_bytes_per_client"]
+    assert up["aggregate_allreduce_bytes"] * m == \
+        up["ledger_bytes_per_client"]
+
+
+def test_2d_server_gathers_table_once(audit_report):
+    tt = audit_report["programs"]["sketch/server2d"]["table_traffic"]
+    assert tt == {"all_gathers": 1, "allreduce_bytes": 0}
+
+
+def test_mesh_1x1_is_hlo_identical_to_1d(audit_report):
+    entry = audit_report["programs"]["sketch/mesh1x1"]
+    assert entry["fingerprint"] == entry["mesh1x1_fingerprint"]
 
 
 def test_local_topk_wire_bytes_bound_ledger(audit_report):

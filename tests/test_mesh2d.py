@@ -1,0 +1,259 @@
+"""2D-mesh (clients x model) correctness: a pod-scale round that
+shards the sketch table, momentum and error-feedback state by columns
+over the ``model`` axis must reproduce the 1-D clients-only round to
+float tolerance (bit-identical where the mode permits) — the sharded
+server is an implementation detail, never a semantics change."""
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.core.rounds import (ClientStates, args2sketch,
+                                           build_client_round,
+                                           build_server_round)
+from commefficient_tpu.core.server import ServerState
+from commefficient_tpu.ops.topk import distributed_threshold_mask_1d
+from commefficient_tpu.parallel.mesh import (MODEL_AXIS,
+                                             client_sharding,
+                                             make_mesh2d,
+                                             model_axis_size,
+                                             server_state_sharding,
+                                             shard_map, spec)
+
+from test_modes import linear_loss
+from test_sharding import _batch, _setup
+
+import pytest
+
+
+def _run_rounds(cfg, mesh, n_rounds=3, seed=5, per_client=False,
+                ids_fn=None):
+    """Drive ``n_rounds`` full rounds; returns final params, server
+    momentum/error state and the last round's (globally gathered)
+    aggregate. ``mesh=None`` is the 1-D oracle; ``per_client``
+    disqualifies the fused path via the microbatch no-op (same trick
+    as test_sharding.TestFusedMeshPath)."""
+    run_cfg = cfg
+    if per_client:
+        run_cfg = dataclasses.replace(cfg, microbatch_size=3)
+    cr = jax.jit(build_client_round(run_cfg, linear_loss, 3,
+                                    mesh=mesh))
+    two_d = mesh is not None and model_axis_size(mesh) > 1
+    sr = jax.jit(build_server_round(run_cfg,
+                                    mesh=mesh if two_d else None))
+    d = cfg.grad_size
+    ps = jnp.zeros(d, jnp.float32).at[0].set(0.5)
+    cs = ClientStates.init(cfg, 16, ps)
+    ss = ServerState.init(
+        cfg, sharding=(server_state_sharding(mesh, cfg.transmit_shape)
+                       if two_d else None))
+    agg = None
+    for r in range(n_rounds):
+        batch, ids = _batch(seed=seed + r)
+        if ids_fn is not None:
+            batch, ids = ids_fn(batch, ids)
+        if mesh is not None:
+            sh = client_sharding(mesh)
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sh), batch)
+        res = cr(ps, cs, batch, ids, jax.random.PRNGKey(r), 1.0)
+        cs = res.client_states
+        agg = res.aggregated
+        ps, ss, _, _, _ = sr(ps, ss, res.aggregated, jnp.float32(0.01))
+    return (np.asarray(ps), np.asarray(ss.Vvelocity),
+            np.asarray(ss.Verror), np.asarray(agg), cs)
+
+
+def _assert_state_close(a, b, tol=1e-6):
+    for x, y in zip(a[:4], b[:4]):
+        np.testing.assert_allclose(x, y, rtol=0, atol=tol)
+
+
+class TestDistributedSelect:
+    def test_threshold_mask_matches_topk_with_ties(self, devices):
+        """The shard-local candidate extraction + global k-th-key
+        agreement must select exactly the lax.top_k set — including
+        the lowest-global-index tie-break and a ragged last shard
+        (d not divisible by the model axis)."""
+        d, k, M = 37, 7, 8
+        n_loc = -(-d // M)
+        rng = np.random.RandomState(3)
+        sq = np.abs(rng.randn(d)).astype(np.float32)
+        sq[5] = sq[21] = sq[30] = 1.7  # forced three-way tie
+        pad = n_loc * M - d
+        sq_p = np.pad(sq, (0, pad))
+        valid = (np.arange(n_loc * M) < d)
+
+        mesh = make_mesh2d(1, M)
+
+        def body(sq_loc, valid_loc):
+            return distributed_threshold_mask_1d(
+                sq_loc, k, MODEL_AXIS, valid=valid_loc)
+
+        mask = shard_map(
+            body, mesh=mesh,
+            in_specs=(spec(MODEL_AXIS), spec(MODEL_AXIS)),
+            out_specs=spec(MODEL_AXIS),
+        )(jnp.asarray(sq_p), jnp.asarray(valid))
+        got = set(np.nonzero(np.asarray(mask))[0].tolist())
+        want = set(np.asarray(
+            jax.lax.top_k(jnp.asarray(sq), k)[1]).tolist())
+        assert got == want
+        assert len(got) == k
+
+    def test_estimates_at_bit_identical(self, devices):
+        """Point queries into the gathered table must agree bit-for-
+        bit with the rolled full-table estimate — the 2D select sees
+        exactly what the 1-D unsketch would."""
+        cfg = _setup("sketch")
+        sk = args2sketch(cfg)
+        rng = np.random.RandomState(11)
+        table = jnp.asarray(
+            rng.randn(cfg.num_rows, cfg.num_cols).astype(np.float32))
+        idx = jnp.arange(cfg.grad_size, dtype=jnp.int32)
+        full = np.asarray(sk.estimates(table))[:cfg.grad_size]
+        point = np.asarray(sk.estimates_at(table, idx))
+        np.testing.assert_array_equal(full, point)
+
+
+class TestMesh2DParity:
+    @pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+    def test_sketch_matches_1d_oracle(self, devices, shape):
+        cfg = _setup("sketch", weight_decay=5e-4)
+        ref = _run_rounds(cfg, None)
+        got = _run_rounds(cfg, make_mesh2d(*shape))
+        _assert_state_close(ref, got)
+
+    @pytest.mark.parametrize("shape", [(4, 2), (1, 8)])
+    def test_uncompressed_matches_1d_oracle(self, devices, shape):
+        cfg = _setup("uncompressed", error_type="none",
+                     virtual_momentum=0.9, weight_decay=5e-4)
+        ref = _run_rounds(cfg, None)
+        got = _run_rounds(cfg, make_mesh2d(*shape))
+        _assert_state_close(ref, got)
+
+    def test_mesh_cx1_matches_1d_oracle(self, devices):
+        """A Cx1 mesh is the existing 1-D program — the 2D plumbing
+        must be a strict superset, not a fork."""
+        cfg = _setup("sketch")
+        ref = _run_rounds(cfg, None)
+        got = _run_rounds(cfg, make_mesh2d(8, 1))
+        _assert_state_close(ref, got)
+
+    def test_robust_fold_parity_2d(self, devices):
+        """Robust folds run on the per-client early-sketch path; the
+        2D server must consume the replicated folded table unchanged."""
+        cfg = _setup("sketch", robust_agg="trimmed",
+                     robust_trim_frac=0.25)
+        ref = _run_rounds(cfg, None, per_client=True)
+        got = _run_rounds(cfg, make_mesh2d(4, 2), per_client=True)
+        _assert_state_close(ref, got)
+
+    def test_dead_slots_parity_2d(self, devices):
+        """Dropout pads (id-0 sentinel slots with an all-zero mask)
+        must stay inert on the 2D late-sketch per-client path exactly
+        as on 1-D — no state race, no aggregate contribution."""
+        def kill_last(batch, ids):
+            batch = dict(batch)
+            batch["mask"] = batch["mask"].at[-2:].set(0.0)
+            ids = ids.at[-2:].set(0)
+            return batch, ids
+
+        cfg = _setup("sketch")
+        ref = _run_rounds(cfg, None, per_client=True,
+                          ids_fn=kill_last)
+        got = _run_rounds(cfg, make_mesh2d(4, 2), per_client=True,
+                          ids_fn=kill_last)
+        _assert_state_close(ref, got)
+
+    def test_server_state_shards_one_over_m(self, devices):
+        """The headline memory claim: per-device momentum/EF table
+        shards are 1/M of the global table."""
+        cfg = _setup("sketch")
+        mesh = make_mesh2d(2, 4)
+        ss = ServerState.init(
+            cfg, sharding=server_state_sharding(mesh,
+                                                cfg.transmit_shape))
+        r, c = cfg.num_rows, cfg.num_cols
+        for buf in (ss.Vvelocity, ss.Verror):
+            shapes = {tuple(s.data.shape)
+                      for s in buf.addressable_shards}
+            assert shapes == {(r, c // 4)}, shapes
+
+
+class TestCompiled2D:
+    def _lowered(self, cfg, mesh, seed=12):
+        batch, ids = _batch(seed=seed)
+        fused = build_client_round(cfg, linear_loss,
+                                   batch["x"].shape[1], mesh=mesh)
+        ps = jnp.zeros(cfg.grad_size, jnp.float32)
+        cs = ClientStates.init(cfg, 16, ps)
+        if mesh is not None and mesh.devices.size > 1:
+            sh = client_sharding(mesh)
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sh), batch)
+        return jax.jit(fused).lower(ps, cs, batch, ids,
+                                    jax.random.PRNGKey(0),
+                                    jnp.float32(1.0))
+
+    def test_reduce_scatter_and_sharded_allreduce(self, devices):
+        """The 2D fused round's table traffic: a reduce-scatter over
+        ``model`` (partial tables -> column shards) and a client-axis
+        all-reduce of the (r, c/M) SHARD — never of the full (r, c)
+        table or a (W, d) gradient buffer."""
+        cfg = _setup("sketch")
+        txt = self._lowered(cfg, make_mesh2d(4, 2)).compile().as_text()
+        assert re.search(r"reduce-scatter(-start)?\(", txt), \
+            "2D sketch emission must lower to a real reduce-scatter"
+        ars = [l for l in txt.splitlines()
+               if re.search(r"all-reduce(-start)?\(", l)]
+        r, c = cfg.num_rows, cfg.num_cols
+        shard = [l for l in ars if f"f32[{r},{c // 2}]" in l
+                 or f"f32[{r * c // 2}]" in l]
+        assert len(shard) == 1, "\n".join(ars)
+        assert not any(f"f32[{r},{c}]" in l for l in ars)
+        assert not any(f"f32[{8 * cfg.grad_size}]" in l or
+                       f"f32[8,{cfg.grad_size}]" in l for l in ars)
+
+    def test_server2d_gathers_once(self, devices):
+        """The distributed select rebuilds the full table with ONE
+        table-sized all-gather; no all-reduce of table-sized buffers."""
+        cfg = _setup("sketch")
+        mesh = make_mesh2d(4, 2)
+        sr = build_server_round(cfg, mesh=mesh)
+        ss = ServerState.init(
+            cfg, sharding=server_state_sharding(mesh,
+                                                cfg.transmit_shape))
+        ps = jnp.zeros(cfg.grad_size, jnp.float32)
+        agg = jnp.zeros(cfg.transmit_shape, jnp.float32)
+        txt = jax.jit(sr).lower(ps, ss, agg,
+                                jnp.float32(0.01)).compile().as_text()
+        r, c = cfg.num_rows, cfg.num_cols
+        ags = [l for l in txt.splitlines()
+               if re.search(r"all-gather(-start)?\(", l)
+               and f"f32[{r},{c}]" in l]
+        assert len(ags) == 1, txt
+
+    def test_mesh_1x1_lowering_identical_to_1d(self, devices):
+        """--mesh 1x1 must build the SAME program as the 1-D default
+        (loc-stripped StableHLO fingerprint) — no 2D tax on the
+        single-device path."""
+        from commefficient_tpu.analysis.hlo import fingerprint
+        cfg = _setup("sketch")
+        one_d = self._lowered(cfg, None).as_text()
+        mesh11 = self._lowered(cfg, make_mesh2d(1, 1)).as_text()
+        assert fingerprint(one_d) == fingerprint(mesh11)
+
+
+def test_config_mesh_validation():
+    cfg = _setup("sketch", mesh="4x2")
+    assert cfg.mesh2d == (4, 2) and cfg.model_axis == 2
+    with pytest.raises(AssertionError):
+        _setup("true_topk", mesh="4x2").validate_runtime()
+    with pytest.raises(AssertionError):
+        # 32 cols % 3 != 0
+        _setup("sketch", mesh="2x3").validate_runtime()

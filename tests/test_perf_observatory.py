@@ -1095,6 +1095,49 @@ class TestTopologyGate:
         with pytest.raises(ValueError, match="schema"):
             gate.baseline_entry({"schema": 99}, 1, 1)
 
+    def test_mesh_shape_extends_topology_key(self):
+        """2D-mesh runs key separately per shape; 1-D layouts keep
+        the historical mesh-less key (v2 pins stay valid)."""
+        ms = {"clients": 4, "model": 2}
+        assert gate.topology_key(8, 1, ms) == "d8p1m4x2"
+        assert gate.topology_key(8, 1, {"clients": 8, "model": 1}) \
+            == "d8p1"
+        assert gate.topology_key(8, 1, None) == "d8p1"
+        assert gate.topology_key(None, None, ms) == gate.ANY_TOPOLOGY
+        base = gate.make_baseline(
+            {"span:a:ms": _metric(10.0)}, device_count=8,
+            process_count=1, mesh_shape=ms)
+        assert sorted(base["topologies"]) == ["d8p1m4x2"]
+        assert base["topologies"]["d8p1m4x2"]["mesh_shape"] == ms
+        # distinct shapes on the same chips are distinct entries
+        base = gate.update_baseline(
+            base, {"span:a:ms": _metric(7.0)}, device_count=8,
+            process_count=1, mesh_shape={"clients": 2, "model": 4})
+        assert sorted(base["topologies"]) == ["d8p1m2x4", "d8p1m4x2"]
+        verdict = gate.compare(base, {"span:a:ms": _metric(10.5)},
+                               device_count=8, process_count=1,
+                               mesh_shape=ms)
+        assert verdict["topology"] == "d8p1m4x2"
+        assert verdict["regressions"] == []
+
+    def test_mesh_run_falls_back_to_meshless_pin(self):
+        """A pin captured before mesh keying existed keeps gating a
+        2D run (migration), but an exact mesh-keyed entry wins."""
+        base = gate.make_baseline(
+            {"span:a:ms": _metric(10.0)}, device_count=8,
+            process_count=1)
+        ms = {"clients": 4, "model": 2}
+        entry = gate.baseline_entry(base, 8, 1, ms)
+        assert entry is not None and "mesh_shape" not in entry
+        base = gate.update_baseline(
+            base, {"span:a:ms": _metric(5.0)}, device_count=8,
+            process_count=1, mesh_shape=ms)
+        assert gate.baseline_entry(base, 8, 1, ms)["metrics"][
+            "span:a:ms"]["median"] == pytest.approx(5.0)
+        # the 1-D key never sees the mesh entry
+        assert gate.baseline_entry(base, 8, 1)["metrics"][
+            "span:a:ms"]["median"] == pytest.approx(10.0)
+
     def test_cli_topology_cycle(self, tmp_path, capsys):
         """One baseline file guards several topology points
         independently: a regression at d4p1 fails ONLY d4p1, and a
@@ -1139,13 +1182,34 @@ class TestTopologyGate:
             f.write(json.dumps(rec) + "\n")
         records = pg.load_ledger_records(ledger)
         # pre-fleet metas never recorded process_count: defaults to 1
-        assert pg.resolve_topology(None, records) == (8, 1)
+        assert pg.resolve_topology(None, records) == (8, 1, None)
         # CLI overrides win
         assert pg.resolve_topology(None, records,
                                    device_count=2,
-                                   process_count=2) == (2, 2)
+                                   process_count=2) == (2, 2, None)
         manifest = {"device_count": 16, "process_count": 4}
-        assert pg.resolve_topology(manifest, records) == (16, 4)
+        assert pg.resolve_topology(manifest, records) == (16, 4, None)
+
+    def test_resolve_mesh_shape_chain(self, tmp_path):
+        """Mesh layout resolution: CLI "CxM" wins, then the manifest
+        dict, then the ledger meta record; 1-D runs stay None."""
+        pg = _load_perf_gate()
+        ledger = str(tmp_path / "mesh.jsonl")
+        with open(ledger, "w") as f:
+            f.write(json.dumps({
+                "schema": 1, "kind": "meta", "ts": 0.0,
+                "num_devices": 8,
+                "mesh_shape": {"clients": 4, "model": 2}}) + "\n")
+        records = pg.load_ledger_records(ledger)
+        assert pg.resolve_topology(None, records) == \
+            (8, 1, {"clients": 4, "model": 2})
+        manifest = {"device_count": 8, "process_count": 1,
+                    "mesh_shape": {"clients": 2, "model": 4}}
+        assert pg.resolve_topology(manifest, records)[2] == \
+            {"clients": 2, "model": 4}
+        assert pg.resolve_topology(manifest, records,
+                                   mesh_shape="8x1")[2] == \
+            {"clients": 8, "model": 1}
 
 
 # --- registry topology keys -------------------------------------------
@@ -1161,6 +1225,12 @@ class TestRegistryTopologyKeys:
         assert registry.run_topology({}) == (None, None)
         assert registry.run_key({"config_hash": "c"}) != \
             registry.run_key(m)
+        # 2D-mesh runs get their own comparability key; 1-D runs
+        # keep the historical 3-tuple
+        m2 = dict(m, mesh_shape={"clients": 4, "model": 2})
+        assert registry.run_key(m2) == ("c", 8, 2, "m4x2")
+        m1 = dict(m, mesh_shape={"clients": 8, "model": 1})
+        assert registry.run_key(m1) == registry.run_key(m)
 
     def test_manifest_records_live_topology(self, tmp_path):
         ledger = str(tmp_path / "a.jsonl")
