@@ -96,14 +96,19 @@ def expected_round_seconds(total_flops: float,
 def build_cost_model(stablehlo_text: str, *, backend: str,
                      device_kind: str = "", n_devices: int = 1,
                      allreduce_payload_bytes: float = 0.0,
+                     wire_dtype: str = "f32",
                      label: str = "") -> Dict:
     """One round's roofline expectation from its lowered module text.
 
-    ``allreduce_payload_bytes`` is the round's aggregation payload
-    (for sketch: the 4-byte f32 table, ``4 r c``; dense modes:
-    ``4 grad_size``) — passed in rather than re-derived from compiled
-    HLO so the profiled run doesn't pay a second full compile.
-    Returns a JSON-able dict the telemetry meta record carries."""
+    ``allreduce_payload_bytes`` is the round's aggregation payload at
+    its WIRE dtype (``Config.upload_wire_bytes_per_client``: sketch
+    tables at the --sketch_dtype width + per-row f32 scales, dense
+    modes ``4 grad_size``) — passed in rather than re-derived from
+    compiled HLO so the profiled run doesn't pay a second full
+    compile. ``wire_dtype`` tags the record so a quantized run's
+    collective floor is attributable without re-deriving it from the
+    byte count. Returns a JSON-able dict the telemetry meta record
+    carries."""
     flops = flop_inventory(stablehlo_text)
     spec = chip_spec(backend, device_kind)
     exp = expected_round_seconds(flops["total_flops"],
@@ -119,6 +124,7 @@ def build_cost_model(stablehlo_text: str, *, backend: str,
         "conv_flops": flops["conv_flops"],
         "flops_by_dtype": flops["by_dtype"],
         "allreduce_payload_bytes": float(allreduce_payload_bytes),
+        "wire_dtype": wire_dtype,
         "wire_bytes_per_chip": exp["wire_bytes_per_chip"],
         "compute_floor_s": exp["compute_s"],
         "collective_floor_s": exp["collective_s"],

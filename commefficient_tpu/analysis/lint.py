@@ -348,6 +348,47 @@ def _check_inline_partition_spec(rel, lines, tree):
     return hits
 
 
+# --- rule: byte-literal -------------------------------------------------
+
+
+_BYTE_WIDTH_LITERALS = {1, 2, 4, 8, 1.0, 2.0, 4.0, 8.0}
+
+
+def _check_byte_literal(rel, lines, tree):
+    """Inline byte-width multiplies (``n * 4``) in accounting code on
+    the host path (runtime/, telemetry/): every one of them silently
+    hard-codes f32 on the wire, which is exactly the bug class the
+    quantized sketch work removed. Byte math must go through
+    ``accounting.bytes_of(shape, dtype)`` / ``dtype_bytes`` so a
+    --sketch_dtype change reprices every ledger entry at once. Only
+    statements whose source mentions "bytes" are in scope — scalar
+    math like momentum constants is untouched."""
+    if _top(rel) not in ("runtime", "telemetry"):
+        return []
+    hits = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Mult)):
+            continue
+        lit = None
+        for side in (node.left, node.right):
+            if (isinstance(side, ast.Constant)
+                    and type(side.value) in (int, float)
+                    and side.value in _BYTE_WIDTH_LITERALS):
+                lit = side.value
+        if lit is None:
+            continue
+        ctx = " ".join(
+            lines[node.lineno - 1:(node.end_lineno or node.lineno)])
+        if "bytes" not in ctx.lower():
+            continue
+        hits.append((node.lineno,
+                     f"inline byte-width literal * {lit} in "
+                     "accounting code — use accounting.bytes_of/"
+                     "dtype_bytes so the wire dtype prices it"))
+    return hits
+
+
 # --- rule: mutable-default-arg -----------------------------------------
 
 
@@ -393,6 +434,9 @@ ALL_RULES = [
     Rule("inline-partition-spec",
          "PartitionSpec/NamedSharding built outside parallel/",
          _check_inline_partition_spec),
+    Rule("byte-literal",
+         "inline byte-width multiply in runtime/telemetry accounting",
+         _check_byte_literal),
     Rule("mutable-default-arg",
          "mutable default argument",
          _check_mutable_default),

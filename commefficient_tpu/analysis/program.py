@@ -8,11 +8,15 @@ FetchSGD line promises about the compiled program:
 * **collective inventory** — op counts and byte totals per collective
   kind, with the transmit-aggregation all-reduce cross-checked against
   the telemetry ledger's uplink accounting
-  (``4 * cfg.upload_floats_per_client`` per client) to exact integer
-  equality for sketch / true_topk / uncompressed / fedavg. local_topk
-  is the documented exception: the mesh reduces the DENSE masked
-  vector over the ICI (4·d bytes) while the logical uplink is 4·k —
-  the audit asserts the bound instead;
+  (``cfg.upload_wire_bytes_per_client``: the table at the
+  ``--sketch_dtype`` wire width + per-row f32 scales where the dtype
+  carries them) to exact integer equality for sketch / true_topk /
+  uncompressed / fedavg. The quantized programs additionally prove the
+  table collective compiled at the wire dtype (s8/f8e4m3fn/bf16) and
+  that no f32 table-shaped all-reduce remains. local_topk is the
+  documented exception: the mesh reduces the DENSE masked vector over
+  the ICI (4·d bytes) while the logical uplink is 4·k — the audit
+  asserts the bound instead;
 * **no host transfers** — no infeed/outfeed/send/recv/host callbacks
   anywhere in the round program (the only device→host crossing is the
   ``metrics_host`` scalar fetch, which lives OUTSIDE the compiled
@@ -105,6 +109,23 @@ def build_specs() -> List[ProgramSpec]:
         # (r, c/M) column shard
         ProgramSpec("sketch/fused2d", "sketch", "fused2d",
                     dict(error_type="virtual", virtual_momentum=0.9)),
+        # quantized wire programs: the table collective must compile
+        # at the wire dtype (s8/f8e4m3fn/bf16) with, for the scaled
+        # dtypes, exactly one (r, 1) f32 rowmax pmax riding along —
+        # the dtype-aware ledger cross-check proves the compiled
+        # bytes equal the accounting to the byte
+        ProgramSpec("sketch/quant8", "sketch", "fused",
+                    dict(error_type="virtual", virtual_momentum=0.9,
+                         sketch_dtype="int8")),
+        ProgramSpec("sketch/quantfp8", "sketch", "fused",
+                    dict(error_type="virtual", virtual_momentum=0.9,
+                         sketch_dtype="fp8")),
+        ProgramSpec("sketch/quantbf16", "sketch", "fused",
+                    dict(error_type="virtual", virtual_momentum=0.9,
+                         sketch_dtype="bf16")),
+        ProgramSpec("sketch/quant2d", "sketch", "fused2d",
+                    dict(error_type="virtual", virtual_momentum=0.9,
+                         sketch_dtype="int8")),
     ]
     per_client_kw = {
         "sketch": dict(error_type="virtual", virtual_momentum=0.9,
@@ -220,33 +241,71 @@ def audit_client_program(spec: ProgramSpec, mesh=None,
                          "compiled_aliases":
                              entry.pop("compiled_aliases")}
 
-    ledger = 4 * cfg.upload_floats_per_client
+    # dtype-aware ledger cross-check: the ledger bills the table at
+    # the wire dtype plus (for the scaled dtypes) one f32 row scale
+    # per row; the compiled program must carry EXACTLY that — the
+    # table collective at wire width and the (r, 1) f32 rowmax pmax.
+    # One backend caveat: XLA CPU's collective runtime sums s8
+    # natively but PROMOTES bf16 all-reduces to f32 and f8 to f16
+    # (all-reduce-promotion pass) — on those wires the audit accepts
+    # the promoted dtype, normalises its bytes back to wire width for
+    # the ledger equality, and records the promotion so the TPU
+    # audit (native bf16 collectives) can pin the real width.
+    wire = getattr(cfg, "sketch_dtype", "f32")
+    wire_hlo = {"f32": "f32", "bf16": "bf16", "int8": "s8",
+                "fp8": "f8e4m3fn"}[wire]
+    promoted_ok = {"f32": ("f32",), "int8": ("s8",),
+                   "bf16": ("bf16", "f32"),
+                   "fp8": ("f8e4m3fn", "f16", "f32")}[wire]
+
+    def _wire_bytes(kind, shapes):
+        """(bytes normalised to wire width, matched hlo dtype) of the
+        first dtype — native first, then promoted — with a matching
+        ``kind`` collective at any of ``shapes``."""
+        for dt in promoted_ok:
+            raw = sum(hlo.matching_collective_bytes(ops, kind, dt, s)
+                      for s in dict.fromkeys(tuple(s) for s in shapes))
+            if raw:
+                factor = (hlo.DTYPE_BYTES[dt]
+                          // hlo.DTYPE_BYTES[wire_hlo])
+                return raw // factor, dt
+        return 0, wire_hlo
+
+    ledger = int(cfg.upload_wire_bytes_per_client)
+    scale_shapes = ((cfg.num_rows, 1), (cfg.num_rows,))
+    scale = (sum(
+        hlo.matching_collective_bytes(ops, "all-reduce", "f32", s)
+        for s in scale_shapes) if wire in ("int8", "fp8") else 0)
     M = model_axis_size(mesh) if spec.use_mesh else 1
     if M > 1:
         # 2D emission: the client-axis all-reduce and the model-axis
         # reduce-scatter both carry the (r, c/M) column shard — XLA
         # sometimes flattens the shard to 1-D, so both layouts key
         shard = (cfg.num_rows, cfg.num_cols // M)
-        static = sum(
-            hlo.matching_collective_bytes(ops, "all-reduce", "f32", s)
-            for s in (shard, (shard[0] * shard[1],)))
-        rs = sum(
-            hlo.matching_collective_bytes(ops, "reduce-scatter",
-                                          "f32", s)
-            for s in (shard, (shard[0] * shard[1],)))
+        shard_shapes = (shard, (shard[0] * shard[1],))
+        static, static_dt = _wire_bytes("all-reduce", shard_shapes)
+        rs, rs_dt = _wire_bytes("reduce-scatter", shard_shapes)
         entry["uplink"] = {
             "ledger_bytes_per_client": ledger,
             "model_shards": M,
+            "wire_dtype": wire,
+            "compiled_dtype": static_dt,
             "aggregate_allreduce_bytes": static,
             "reduce_scatter_bytes": rs,
+            "scale_allreduce_bytes": scale,
             "relation": "sharded",
         }
     else:
-        static = hlo.matching_reduce_bytes(ops, "f32",
-                                           cfg.transmit_shape)
+        static, static_dt = _wire_bytes(
+            "all-reduce", (cfg.transmit_shape,
+                           (int(np.prod(cfg.transmit_shape)),)))
+        rs_dt = static_dt
         entry["uplink"] = {
             "ledger_bytes_per_client": ledger,
+            "wire_dtype": wire,
+            "compiled_dtype": static_dt,
             "aggregate_allreduce_bytes": static,
+            "scale_allreduce_bytes": scale,
             # local_topk sends the dense masked vector over the ICI:
             # the 4·k ledger figure is the logical uplink, bounded by
             # the 4·d wire bytes. Everything else must match exactly.
@@ -279,34 +338,55 @@ def audit_client_program(spec: ProgramSpec, mesh=None,
                 "single-device chunked program emits collectives: "
                 f"{entry['collectives']['counts']}")
     elif M > 1:
-        if rs * M != ledger:
+        if rs * M + scale != ledger:
             failures.append(
                 f"2D uplink: reduce-scatter shard bytes {rs} x {M} "
-                f"model shards != ledger bytes/client {ledger} — the "
+                f"model shards + {scale} scale bytes != ledger "
+                f"bytes/client {ledger} ({wire} wire) — the "
                 "partial-table emission is not reduce-scattering the "
-                "(r, c/M) column shard")
-        if static * M != ledger:
+                "quantized (r, c/M) column shard")
+        if static * M + scale != ledger:
             failures.append(
                 f"2D uplink: client-axis all-reduce bytes {static} x "
-                f"{M} != ledger bytes/client {ledger} — the "
-                "aggregation must carry only the column shard")
-        full = hlo.matching_reduce_bytes(ops, "f32",
+                f"{M} + {scale} scale bytes != ledger bytes/client "
+                f"{ledger} ({wire} wire) — the aggregation must carry "
+                "only the quantized column shard")
+        full = hlo.matching_reduce_bytes(ops, wire_hlo,
                                          cfg.transmit_shape)
         if full:
             failures.append(
                 f"2D uplink: {full} bytes all-reduced at the FULL "
                 f"table shape {cfg.transmit_shape} — the model-axis "
                 "sharding is being undone on the wire")
+        if wire != "f32" and hlo.matching_reduce_bytes(
+                ops, "f32", cfg.transmit_shape):
+            failures.append(
+                "2D uplink: an f32 table-shaped all-reduce in the "
+                f"{wire}-wire program — the table is crossing the ICI "
+                "unquantized")
+        if wire != "f32" and hlo.matching_collective_bytes(
+                ops, "reduce-scatter", "f32", shard) and rs_dt != "f32":
+            failures.append(
+                "2D uplink: an f32 shard-shaped reduce-scatter beside "
+                f"the {wire} wire path — double traffic")
     elif spec.mode == "local_topk":
         if not (static >= ledger):
             failures.append(
                 f"uplink: dense wire bytes {static} < logical ledger "
                 f"bytes {ledger}")
-    elif static != ledger:
-        failures.append(
-            f"uplink: aggregation all-reduce bytes {static} != ledger "
-            f"bytes/client {ledger} "
-            f"(shape {cfg.transmit_shape})")
+    else:
+        if static + scale != ledger:
+            failures.append(
+                f"uplink: aggregation all-reduce bytes {static} + "
+                f"{scale} scale bytes != ledger bytes/client {ledger} "
+                f"({wire} wire, shape {cfg.transmit_shape})")
+        if (wire != "f32" and static_dt != "f32"
+                and hlo.matching_reduce_bytes(ops, "f32",
+                                              cfg.transmit_shape)):
+            failures.append(
+                f"uplink: an f32 table-shaped all-reduce beside the "
+                f"{wire} ({static_dt}) wire path — the table is "
+                "crossing the ICI unquantized")
     entry.update(mode=spec.mode, path=spec.path, probes=spec.probes,
                  failures=failures)
     return entry

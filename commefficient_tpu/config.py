@@ -17,6 +17,8 @@ MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
 ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
 ROBUST_AGGS = ("none", "median", "trimmed", "clip")
+SKETCH_DTYPES = ("f32", "bf16", "int8", "fp8")
+DOWNLINK_ENCODINGS = ("dense", "delta")
 
 # dataset -> num classes (reference utils.py:37-44)
 FED_DATASETS = {
@@ -228,6 +230,27 @@ class Config:
     # explicit value when moving sketch-mode checkpoints across
     # platforms.
     sketch_rot_lanes: int = -1
+    # wire dtype of the uplinked sketch table (ops/quant.py): "f32"
+    # (default; the program compiles bit-identical to a build without
+    # the flag), "bf16" (plain cast, summed in bf16 on the wire),
+    # "int8"/"fp8" (per-row scales: each shard quantizes against its
+    # local row maxabs, then harmonizes onto the pmax'd global row
+    # scale with summation headroom so the wire-dtype psum cannot
+    # overflow). Emission accumulates in f32; the server dequantizes
+    # before momentum/error feedback so optimizer state stays f32.
+    # Count-sketch is mean-zero and tolerant of coarse quantization
+    # (FedSKETCH; arXiv:1903.04488) — int8 cuts uplink ~4x at a
+    # recovery-error cost well inside the probe alarm band on the
+    # reference config (README compression-modes table).
+    sketch_dtype: str = "f32"
+    # downlink encoding of the broadcast update: "dense" ships the
+    # changed coordinates as f32 (reference-shaped); "delta" ships
+    # (idx:int32, val:wire_dtype) pairs plus a round-delta bitmap
+    # naming the indices repeated from the previous round's support,
+    # so a client that saw round t-1 pays 1 bit instead of 4 bytes
+    # per repeated index. Accounting-level encoding: the compiled
+    # round program is unchanged (runtime/fed_model.py).
+    downlink_encoding: str = "dense"
     # scan the round's client fan-out in chunks of this many clients
     # (0 = all at once): caps live per-client intermediates at
     # chunk x d — the memory lever for large-W rounds of the local-
@@ -407,6 +430,10 @@ class Config:
             "--checkpoint_every_rounds must be >= 0 (0 = off)"
         assert self.checkpoint_keep >= 0, \
             "--checkpoint_keep must be >= 0"
+        assert self.sketch_dtype in SKETCH_DTYPES, \
+            "--sketch_dtype must be f32|bf16|int8|fp8"
+        assert self.downlink_encoding in DOWNLINK_ENCODINGS, \
+            "--downlink_encoding must be dense|delta"
         if self.mesh:
             import re
             assert re.fullmatch(r"[0-9]+x[0-9]+", self.mesh.lower()), \
@@ -444,6 +471,13 @@ class Config:
             if self.mode in ("sketch", "uncompressed") \
                     and self.error_type == "local":
                 self.error_type = "virtual"
+        if self.sketch_dtype != "f32":
+            # the wire dtype quantizes the sketch table; the other
+            # modes transmit dense/top-k floats whose accounting and
+            # server fold never route through the table quantizer
+            assert self.mode == "sketch", \
+                "--sketch_dtype != f32 requires --mode sketch " \
+                "(only the sketch table has a quantized wire path)"
         if self.mode == "sketch":
             # sketched SGD with local error/momentum is undefined: we
             # can't know which part of a sketch is "error"
@@ -543,6 +577,27 @@ class Config:
             "sketch": self.num_rows * self.num_cols,
             "fedavg": self.grad_size,
         }[self.mode]
+
+    @property
+    def upload_wire_bytes_per_client(self) -> float:
+        """Bytes uploaded per participating client per round, at the
+        wire dtype: the quantized sketch table plus (int8/fp8) its
+        per-row f32 scales; every other mode ships f32."""
+        from commefficient_tpu import accounting
+        if self.mode == "sketch":
+            return accounting.sketch_wire_bytes(
+                self.num_rows, self.num_cols, self.sketch_dtype)
+        return accounting.bytes_of(self.upload_floats_per_client, "f32")
+
+    @property
+    def downlink_value_bytes(self) -> int:
+        """Bytes per broadcast value on the downlink: wire width
+        under --downlink_encoding delta (values ship quantized), f32
+        under dense."""
+        from commefficient_tpu import accounting
+        if self.downlink_encoding == "delta":
+            return accounting.dtype_bytes(self.sketch_dtype)
+        return accounting.dtype_bytes("f32")
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -691,6 +746,24 @@ def build_parser(default_lr: Optional[float] = None,
                         "at large-d Pallas-eligible geometries, else "
                         "0; 0 = force full granularity); speeds the "
                         "Pallas kernels' rolls, see BENCHMARKS.md")
+    parser.add_argument("--sketch_dtype", type=str, default="f32",
+                        choices=list(SKETCH_DTYPES),
+                        help="wire dtype of the uplinked sketch "
+                        "table (sketch mode): f32 (bit-identical "
+                        "program to a build without the flag), bf16, "
+                        "or int8/fp8 with per-row scales — emission "
+                        "stays f32, the table quantizes before the "
+                        "all-reduce/reduce-scatter, the server "
+                        "dequantizes before momentum/error feedback")
+    parser.add_argument("--downlink_encoding", type=str,
+                        default="dense",
+                        choices=list(DOWNLINK_ENCODINGS),
+                        help="downlink byte encoding: dense f32 "
+                        "coordinates, or delta — (idx:int32, "
+                        "val:wire_dtype) pairs plus a bitmap over "
+                        "the previous round's support for repeated "
+                        "indices (accounting-level; the compiled "
+                        "program is unchanged)")
     parser.add_argument("--client_chunk", type=int, default=0,
                         help="scan the round's client fan-out in "
                         "chunks of this many clients (0 = all at "

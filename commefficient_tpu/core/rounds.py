@@ -167,6 +167,11 @@ def round_plan(cfg: Config) -> dict:
         "client_chunk": int(getattr(cfg, "client_chunk", 0)),
         "clientstore": getattr(cfg, "clientstore", "device"),
     }
+    plan["sketch_dtype"] = getattr(cfg, "sketch_dtype", "f32")
+    plan["downlink_encoding"] = getattr(cfg, "downlink_encoding",
+                                        "dense")
+    plan["upload_wire_bytes_per_client"] = float(
+        cfg.upload_wire_bytes_per_client)
     if cfg.mode == "sketch":
         plan["sketch"] = {"rows": int(cfg.num_rows),
                           "cols": int(cfg.num_cols),
@@ -302,6 +307,28 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     tree_sketch = (cfg.mode == "sketch" and tree_loss is not None
                    and unravel is not None)
 
+    # Quantized wire path (--sketch_dtype, ops/quant.py): a trace-time
+    # gate like probes/robust — at the default "f32" none of the
+    # branches below are traced and the round program stays
+    # bit-identical (pinned by test_quant_f32_program_identical).
+    wire = getattr(cfg, "sketch_dtype", "f32")
+    quantized = cfg.mode == "sketch" and wire != "f32"
+
+    def _quantize_for_collective(t, axes, n_addends):
+        """Local f32 table -> (wire-dtype table, shared scale) ready
+        for a wire-dtype psum/psum_scatter (parallel/wire.py owns the
+        mesh-facing crossing; ops/quant.py the algebra)."""
+        from commefficient_tpu.parallel import wire as wirex
+        return wirex.quantize_for_collective(t, wire, axes, n_addends)
+
+    def _qdq_local(t):
+        """Single-shard wire crossing: quantize at full range,
+        immediately dequantize (n_addends=1 — harmonize is an exact
+        identity, so this matches the NumPy mirror bit-for-bit)."""
+        from commefficient_tpu.ops import quant
+        q, scale = quant.quantize_table(t, wire)
+        return quant.dequantize(q, scale)
+
     def _partial_table_emit(g):
         """2D-mesh sketch emission for one model peer: sketch ONLY
         this peer's contiguous ⌈d/M⌉ coordinate slice of the dense
@@ -327,6 +354,21 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         idx = start + jnp.arange(n_loc, dtype=jnp.int32)
         vals = jnp.where(idx < d, vals, 0.0)
         partial = sketch.sketch_sparse(jnp.minimum(idx, d - 1), vals)
+        if quantized:
+            # quantize the shard-local partial BEFORE the collective:
+            # the reduce-scatter moves wire-dtype bytes (r·c·wb per
+            # link instead of 4·r·c) and the full-width f32 table
+            # still never materialises. Headroom covers every addend
+            # the downstream chain sums in wire dtype: M partials in
+            # the scatter x C client shards in the following psum.
+            from commefficient_tpu.parallel import wire as wirex
+            from commefficient_tpu.parallel.mesh import (
+                CLIENT_AXIS, client_axis_size)
+            C = client_axis_size(mesh)
+            q, scale = _quantize_for_collective(
+                partial, (CLIENT_AXIS, MODEL_AXIS),
+                C * M)
+            return wirex.wire_reduce_scatter(q, MODEL_AXIS), scale
         return jax.lax.psum_scatter(partial, MODEL_AXIS,
                                     scatter_dimension=1, tiled=True)
 
@@ -459,6 +501,21 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 CLIENT_AXIS, client_spec, replicated_spec, shard_map,
                 table_shard_spec)
 
+            def _client_psum(t):
+                """The table's client-axis all-reduce — in wire dtype
+                on the quantized path (the table crosses the ICI at
+                wire width; dequantized right after, so the server
+                only ever sees f32)."""
+                if not quantized:
+                    return jax.lax.psum(t, CLIENT_AXIS)
+                from commefficient_tpu.parallel import wire as wirex
+                if shard2d:
+                    q, scale = t  # emit quantized + reduce-scattered
+                else:
+                    q, scale = _quantize_for_collective(
+                        t, (CLIENT_AXIS,), C)
+                return wirex.wire_allreduce(q, scale, CLIENT_AXIS)
+
             def block(p, local_batch, tot):
                 # mark the replicated params as device-varying before
                 # differentiating: otherwise shard_map's transpose
@@ -479,7 +536,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                     t, metrics, g = _fused_local(p, local_batch, tot,
                                                  C, with_dense=True,
                                                  emit=emit)
-                    return (jax.lax.psum(t, CLIENT_AXIS),
+                    return (_client_psum(t),
                             jax.lax.psum(g, CLIENT_AXIS), metrics)
                 t, metrics = _fused_local(p, local_batch, tot, C,
                                           emit=emit)
@@ -488,7 +545,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 # sketch mode — inter-chip traffic stays compressed,
                 # and on a 2D mesh it runs on the already
                 # reduce-scattered (r, c/M) shard
-                return jax.lax.psum(t, CLIENT_AXIS), metrics
+                return _client_psum(t), metrics
 
             agg_spec = (table_shard_spec() if shard2d
                         else replicated_spec())
@@ -510,9 +567,16 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         elif want_dense:
             aggregated, metrics, dense_g = _fused_local(
                 ps_weights, batch, total, 1, with_dense=True)
+            if quantized:
+                aggregated = _qdq_local(aggregated)
         else:
             aggregated, metrics = _fused_local(ps_weights, batch,
                                                total, 1)
+            if quantized:
+                # single-shard wire crossing: quantize-dequantize the
+                # aggregated table at full range (exactly the NumPy
+                # mirror's np_quantize_table/np_dequantize_table)
+                aggregated = _qdq_local(aggregated)
         pr = None
         if probes:
             pr = _agg_probes(aggregated)
@@ -570,6 +634,15 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             transmit = transmit_transform(transmit, batch, real_ids,
                                           rng)
 
+        if quantized and not sketch_late:
+            # per-client uploads (the clipped / robust early-sketch
+            # paths materialise per-client tables): each client's
+            # table crosses the wire quantized at full range and the
+            # server dequantizes before the fold — a dead client's
+            # all-zero table survives exactly (scale guard in
+            # ops/quant.py)
+            transmit = jax.vmap(_qdq_local)(transmit)
+
         # Σ_clients transmit, ÷ total datapoints — one all-reduce
         # (reference fed_worker.py:131-140 + fed_aggregator.py:328-334)
         total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
@@ -581,8 +654,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         elif sketch_late:
             aggregated = _sketch_after_local_sum(
                 sketch, transmit, mesh,
-                emit=_partial_table_emit if shard2d_late else None
-            ) / total
+                emit=_partial_table_emit if shard2d_late else None,
+                wire=wire) / total
         else:
             aggregated = jnp.sum(transmit, axis=0) / total
 
@@ -662,6 +735,9 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 per_client, in_axes=(None, 0, 0, 0, 0, 0, None)
             )(ps_weights, _some(vel_r, chunk), _some(err_r, chunk),
               _some(wt_r, chunk), batch_c, rngs_c, fedavg_lr)
+            if quantized and not sketch_late:
+                # same per-client wire crossing as the unchunked path
+                transmit = jax.vmap(_qdq_local)(transmit)
             states = ClientStates(
                 _scatter(states.velocities, ids_c, new_vel),
                 _scatter(states.errors, ids_c, new_err),
@@ -694,6 +770,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 (jnp.zeros((sketch.r, sketch.c), jnp.float32),
                  client_states),
                 (ids_p, rngs_p, batch_p))
+            if quantized:
+                table = _qdq_local(table)
             aggregated = table / total
         else:
             # dense accumulator: transmit_shape covers both dense (d,)
@@ -709,7 +787,10 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 (jnp.zeros(init_shape, jnp.float32), client_states),
                 (ids_p, rngs_p, batch_p))
             if sketch_late:
-                aggregated = sketch.sketch(acc) / total
+                table = sketch.sketch(acc)
+                if quantized:
+                    table = _qdq_local(table)
+                aggregated = table / total
                 dense_g = acc / total
             else:
                 aggregated = acc / total
@@ -788,13 +869,17 @@ def _round_bn_stats(stats_fn, ps_weights, batch):
 
 
 def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh,
-                            emit=None):
+                            emit=None, wire="f32"):
     """(W, d) dense transmits -> (r, c) summed table: per-device local
     dense sum, one sketch per device, psum of tables over the mesh.
     ``emit`` (2D mesh, sketch mode) replaces the full per-device
     sketch with the partial-slice sketch + reduce-scatter over
     ``model`` (build_client_round._partial_table_emit); the returned
-    table is then column-sharded (parallel/mesh.table_shard_spec)."""
+    table is then column-sharded (parallel/mesh.table_shard_spec).
+    ``wire`` != "f32" quantizes the table before the collective
+    (ops/quant.py — the collective payload drops to wire width) and
+    dequantizes after; with an ``emit``, the emit closure already did
+    the quantize + reduce-scatter and hands back ``(q, scale)``."""
     from commefficient_tpu.parallel.mesh import (CLIENT_AXIS,
                                                  client_axis_size,
                                                  replicated_spec,
@@ -803,9 +888,18 @@ def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh,
     W = transmit.shape[0]
     if mesh is not None and W % client_axis_size(mesh) == 0 \
             and mesh.devices.size > 1:
+        C = client_axis_size(mesh)
 
         def block(local):  # (W/C, d) on each client-axis shard
             g = jnp.sum(local, axis=0)
+            if wire != "f32":
+                from commefficient_tpu.parallel import wire as wirex
+                if emit is None:
+                    q, scale = wirex.quantize_for_collective(
+                        sketch.sketch(g), wire, (CLIENT_AXIS,), C)
+                else:
+                    q, scale = emit(g)
+                return wirex.wire_allreduce(q, scale, CLIENT_AXIS)
             table = sketch.sketch(g) if emit is None else emit(g)
             return jax.lax.psum(table, CLIENT_AXIS)
 
@@ -814,7 +908,11 @@ def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh,
             in_specs=spec(CLIENT_AXIS, None),
             out_specs=(replicated_spec() if emit is None
                        else table_shard_spec()))(transmit)
-    return sketch.sketch(jnp.sum(transmit, axis=0))
+    table = sketch.sketch(jnp.sum(transmit, axis=0))
+    if wire != "f32":
+        from commefficient_tpu.ops import quant
+        return quant.dequantize(*quant.quantize_table(table, wire))
+    return table
 
 
 def _state_ids(client_ids, batch):

@@ -396,6 +396,37 @@ class CountSketch:
         return jax.vmap(one_row)(jnp.arange(self.r, dtype=jnp.uint32),
                                  rot_dev)
 
+    def sketch_quantized(self, v: jax.Array, wire: str):
+        """Dense (d,) vector -> (wire-dtype (r, c) table, (r, 1) f32
+        rowmax): the fused emit + local-quantize wire path. On the
+        Pallas backend the f32 table only ever exists in the kernel's
+        VMEM scratch (ops/sketch_pallas.sketch_quant_pallas); other
+        backends sketch then quantize (same algebra, ops/quant.py
+        quantize_local), so the two paths agree exactly on a given
+        table. Callers harmonize the result onto the shared global
+        scale before the wire collective (core/rounds.py)."""
+        from commefficient_tpu.ops.quant import quantize_local
+        if wire == "bf16":
+            # scale-free cast — nothing to fuse
+            return quantize_local(self.sketch(v), wire)
+        backend = self._resolve_backend()
+        if backend in ("pallas", "pallas_interpret"):
+            from commefficient_tpu.ops.sketch_pallas import \
+                sketch_quant_pallas
+            assert v.shape == (self.d,), v.shape
+            vp = jnp.pad(v.astype(jnp.float32),
+                         (0, self._padded_d - self.d))
+            _, sign_seed = self._seeds()
+            sgn = (self._packed_signs_traced()
+                   if self._packed_sign_kernels else None)
+            return sketch_quant_pallas(
+                vp, jnp.asarray(self._rotations()), self.c, self.r,
+                int(sign_seed), wire,
+                backend == "pallas_interpret",
+                one_mix=self._one_mix_signs,
+                rot_step=self.rot_lanes, sgn=sgn)
+        return quantize_local(self.sketch(v), wire)
+
     # --- recovery --------------------------------------------------------
 
     def estimates(self, table: jax.Array,

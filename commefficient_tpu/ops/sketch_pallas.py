@@ -284,6 +284,111 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+def sketch_quant_pallas(vp, rot, c: int, r: int, sign_seed: int,
+                        wire: str = "int8", interpret: bool = False,
+                        lanes: int | None = None, one_mix: bool = False,
+                        rot_step: int = 0, sgn=None):
+    """Fused emit + quantize: ``sketch_pallas`` whose f32 table lives
+    ONLY in a VMEM scratch accumulator — after the last chunk the
+    kernel computes each row's maxabs, quantizes the row at full wire
+    range against it (ops/quant.py ``quantize_local`` semantics,
+    bit-identical math), and writes the wire-dtype table + per-row
+    f32 maxabs. The full-width f32 table never reaches HBM: on the
+    model-sharded 2D path the shard-local tile leaves the kernel at
+    wire width, ready for the harmonize + reduce-scatter that follows
+    (core/rounds.py ``_quantize_for_collective`` does the same
+    harmonize on this kernel's outputs, so fused and unfused paths
+    share one quantization algebra).
+
+    Returns ``(q, rowmax)``: q (r, c) in the wire dtype, rowmax
+    (r, 1) f32. ``wire`` is "int8" or "fp8" (bf16 has no scale and is
+    a plain cast of ``sketch_pallas``'s output — nothing to fuse)."""
+    from commefficient_tpu.ops.quant import QMAX, wire_jnp_dtype
+    assert wire in QMAX, wire
+    qmax = QMAX[wire]
+    out_dtype = wire_jnp_dtype(wire)
+    L = lanes or _pick_lanes(c)
+    assert L is not None and c % L == 0
+    S = c // L
+    m = vp.size // c
+    seed = np.uint32(sign_seed)
+    sublane = rot_step > 0 and rot_step % L == 0
+    packed = sgn is not None
+
+    def kernel(rot_ref, v_ref, *refs):
+        if packed:
+            sgn_ref, q_ref, rm_ref, acc_ref = refs
+        else:
+            sgn_ref, (q_ref, rm_ref, acc_ref) = None, refs
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        chunk = v_ref[:]
+        flips = _flips_for_chunk(
+            t, sgn_ref[:] if packed else None,
+            one_mix, seed, c, S, L, r)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
+        for row in range(r):
+            signed = _apply_flip(chunk, flips[row])
+            if sublane:
+                rolled = pltpu.roll(signed, rot_ref[row, t] // L,
+                                    axis=0)
+            else:
+                rolled = _roll1d(signed, rot_ref[row, t], S, L, lane)
+            sl = slice(row * S, (row + 1) * S)
+            acc_ref[sl, :] = acc_ref[sl, :] + rolled
+
+        @pl.when(t == m - 1)
+        def _():
+            for row in range(r):
+                sl = slice(row * S, (row + 1) * S)
+                block = acc_ref[sl, :]
+                rm = jnp.max(jnp.abs(block))
+                # identical scale algebra to quantize_local: full
+                # range against the local rowmax, zero-row guard 1.0
+                s = jnp.where(rm > 0.0, rm / qmax, 1.0)
+                if wire == "int8":
+                    q = jnp.clip(jnp.round(block / s), -qmax, qmax)
+                    q_ref[sl, :] = q.astype(out_dtype)
+                else:
+                    # explicit f16 intermediate, matching
+                    # quant._to_fp8 bit-for-bit on every backend
+                    q_ref[sl, :] = (block / s).astype(
+                        jnp.float16).astype(out_dtype)
+                rm_ref[row, :] = jnp.full((L,), rm, jnp.float32)
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((S, L), lambda t: (t, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [rot.astype(jnp.int32),
+                vp.astype(jnp.float32).reshape(m * S, L)]
+    if packed:
+        in_specs.append(pl.BlockSpec((S, L), lambda t: (t, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(sgn.reshape(m * S, L))
+    q, rm = pl.pallas_call(
+        kernel,
+        grid=(m,),
+        in_specs=in_specs,
+        out_specs=(pl.BlockSpec((r * S, L), lambda t: (0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((r, L), lambda t: (0, 0),
+                                memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((r * S, L), out_dtype),
+                   jax.ShapeDtypeStruct((r, L), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((r * S, L), jnp.float32)],
+        compiler_params=_compiler_params(4 * r * c),
+        interpret=interpret,
+    )(*operands)
+    return q.reshape(r, c), rm[:, :1]
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
 def estimates_pallas(table, rot, c: int, r: int, sign_seed: int,
                      interpret: bool = False, lanes: int | None = None,
                      one_mix: bool = False, valid: int | None = None,

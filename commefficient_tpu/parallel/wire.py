@@ -1,0 +1,50 @@
+"""Wire-dtype collective crossings over the device mesh
+(``--sketch_dtype``).
+
+``ops/quant.py`` owns the quantization *algebra* — scales, summation
+headroom, rounding; this module owns where that algebra meets the
+*mesh*: which axes the row maxima are pmax'd over, which collective
+moves the wire-dtype payload, and the dequantize on the far side.
+``core/rounds.py`` routes every quantized wire crossing through here,
+so the collective-facing surface the static auditor matches against
+(`analysis/program.py`: the wire-dtype psum/psum_scatter plus exactly
+one (r, 1) f32 rowmax pmax) has a single owner, like the sharding
+specs in ``parallel/mesh.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from commefficient_tpu.ops import quant
+
+
+def quantize_for_collective(table: jax.Array, wire: str, axes,
+                            n_addends: int):
+    """Local f32 table -> ``(wire-dtype table, shared scale)`` ready
+    for a wire-dtype psum/psum_scatter over ``axes``: local-quantize
+    at full range, pmax the rowmax over the participating mesh axes
+    (the (r, 1) f32 side-channel the ledger counts), harmonize onto
+    the shared scale with ``n_addends`` summation headroom. bf16 is
+    scale-free (scale None)."""
+    q, rowmax = quant.quantize_local(table, wire)
+    grm = (quant.global_rowmax_over(rowmax, axes)
+           if rowmax is not None else None)
+    return quant.harmonize(q, rowmax, grm, wire, n_addends)
+
+
+def wire_allreduce(q: jax.Array, scale, axis_name) -> jax.Array:
+    """The table's aggregation all-reduce at wire width: psum the
+    quantized table over ``axis_name`` and dequantize on the far side
+    — downstream (server momentum/EF) only ever sees f32."""
+    return quant.dequantize(jax.lax.psum(q, axis_name), scale)
+
+
+def wire_reduce_scatter(q: jax.Array, axis_name,
+                        scatter_dimension: int = 1) -> jax.Array:
+    """The 2D emission's model-axis crossing: sum partial tables and
+    leave each peer its column shard — at wire width when ``q`` is
+    quantized (r·c·wb/M per link instead of 4·r·c/M)."""
+    return jax.lax.psum_scatter(q, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)

@@ -21,10 +21,14 @@ Per-client communication accounting (the reference's distinctive
 observability feature, fed_aggregator.py:171-196, 240-300) is kept,
 with one simplification: instead of a deque of historical weight
 vectors, we track per-coordinate ``last_updated`` round indices (from
-the server update's support), so a returning client's download bytes =
-4 * #{coords updated since it last participated}. Identical to the
+the server update's support), so a returning client's download bytes
+cover #{coords updated since it last participated} at the configured
+downlink width (``accounting.py``; dense f32, or ``--downlink_encoding
+delta``'s (idx, val) pairs + repeat bitmap). Identical to the
 reference's count except for exact value-reversion collisions
 (measure-zero) and without the deque's staleness clamp approximation.
+Uploads bill at the wire dtype: ``--sketch_dtype int8`` tables cost
+r x c bytes + r f32 row scales, not 4 x r x c.
 """
 
 from __future__ import annotations
@@ -35,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from commefficient_tpu import accounting
 from commefficient_tpu.clientstore import (HostClientStore,
                                            StorePrefetcher,
                                            resolve_clientstore,
@@ -251,6 +256,14 @@ class FedModel:
         self.client_last_seen = np.full(num_clients, -1, np.int64)
         self._update_round = 0
         self._rebuild_round_counts()
+        # --downlink_encoding delta bookkeeping: the latest update's
+        # support indices (None = dense/all coords), how many of them
+        # repeat the update before it, and that previous update's
+        # support size (the bitmap a round-fresh client holds)
+        self._prev_support_idx: Optional[np.ndarray] = np.zeros(
+            0, np.int64)
+        self._repeat_count = 0
+        self._bitmap_bits = 0
 
         # --pipeline_depth > 1: rounds are dispatched without waiting
         # for their metrics/accounting; the host runs ahead of the
@@ -677,8 +690,9 @@ class FedModel:
                 backend=jax.default_backend(),
                 device_kind=getattr(dev0, "device_kind", ""),
                 n_devices=n_dev,
-                allreduce_payload_bytes=(
-                    4.0 * self.args.upload_floats_per_client),
+                allreduce_payload_bytes=float(
+                    self.args.upload_wire_bytes_per_client),
+                wire_dtype=getattr(self.args, "sketch_dtype", "f32"),
                 label=(f"{self.args.mode}/{self.clientstore}/"
                        f"{n_dev}dev"))
             self._cost_model = cost
@@ -704,19 +718,36 @@ class FedModel:
         docstring; reference fed_aggregator.py:171-196, 240-300).
         ``mask`` (W, B) derives which clients completed the round:
         dropped clients (--dropout_prob) downloaded weights but
-        uploaded nothing."""
+        uploaded nothing. All byte widths route through
+        ``accounting`` — uploads at the sketch wire dtype, downloads
+        dense-f32 or delta-coded per --downlink_encoding."""
         download_bytes = np.zeros(self.num_clients)
         suffix = np.cumsum(self._round_counts[::-1])[::-1]
         q = self.client_last_seen[ids_np] + 2
         changed = np.where(
             q < len(suffix), suffix[np.minimum(q, len(suffix) - 1)], 0)
-        download_bytes[ids_np] = 4.0 * changed
+        if getattr(self.args, "downlink_encoding", "dense") == "delta":
+            wire = getattr(self.args, "sketch_dtype", "f32")
+            # a client that saw the PREVIOUS broadcast holds its
+            # support list, so repeats delta-code against it; anyone
+            # staler downloads every changed coord as (idx, val)
+            fresh = (self.client_last_seen[ids_np]
+                     == self._update_round - 1)
+            download_bytes[ids_np] = [
+                accounting.delta_downlink_bytes(
+                    c, self._repeat_count, self._bitmap_bits, wire,
+                    have_prev=bool(hp))
+                for c, hp in zip(changed, fresh)]
+        else:
+            download_bytes[ids_np] = changed * accounting.bytes_of(
+                1, "f32")
         self.client_last_seen[ids_np] = self._update_round
         upload_bytes = np.zeros(self.num_clients)
         up_ids = ids_np
         if mask is not None:
             up_ids = ids_np[np.asarray(mask).sum(axis=1) > 0]
-        upload_bytes[up_ids] = 4.0 * self.args.upload_floats_per_client
+        upload_bytes[up_ids] = float(
+            self.args.upload_wire_bytes_per_client)
         return download_bytes, upload_bytes
 
     def _call_val(self, batch):
@@ -774,6 +805,7 @@ class FedModel:
             self.last_updated[:] = r
             self._round_counts[:] = 0
             self._round_counts[r + 1] = self.args.grad_size
+            self._note_delta_support(None)
             return
         if isinstance(support, tuple):
             idx = np.asarray(support[0])
@@ -788,6 +820,28 @@ class FedModel:
         np.subtract.at(self._round_counts, old, 1)
         self._round_counts[r + 1] += len(idx)
         self.last_updated[idx] = r
+        self._note_delta_support(idx)
+
+    def _note_delta_support(self, idx):
+        """Roll the --downlink_encoding delta bookkeeping forward one
+        update: how many of this update's support indices repeat the
+        previous update's (those ship as bitmap bits, not int32
+        indices, to a client that saw the previous broadcast), and
+        the previous support's size (the bitmap's bit count).
+        ``idx=None`` means a dense update (every coordinate)."""
+        prev = self._prev_support_idx
+        d = int(self.args.grad_size)
+        prev_n = d if prev is None else len(prev)
+        if idx is None:
+            self._repeat_count = prev_n
+        elif prev is None:
+            self._repeat_count = len(idx)
+        else:
+            self._repeat_count = int(np.intersect1d(
+                idx, prev, assume_unique=False).size)
+        self._bitmap_bits = prev_n
+        self._prev_support_idx = (None if idx is None
+                                  else np.asarray(idx, np.int64))
 
 
 def drain_rounds(model, pending, process, force):

@@ -30,9 +30,11 @@ Metrics extracted from a ledger (``metrics_from_records``):
 
 Baselines are **topology-keyed** (schema 2): one committed
 ``perf_baseline.json`` holds an independent metrics entry per
-``(device_count, process_count)`` point, so the 8-device headline is
-guarded by an 8-device reference and can never be "regressed" by
-comparison against a single-chip run. Schema-1 baselines (one flat,
+``(device_count, process_count)`` point — suffixed ``m<C>x<M>`` for
+2D-mesh runs and ``q<dtype>`` for quantized-wire runs — so the
+8-device headline is guarded by an 8-device reference and can never
+be "regressed" by comparison against a single-chip run, and an int8
+wire is never compared against an f32 one. Schema-1 baselines (one flat,
 topology-blind metrics dict) remain readable: they resolve for any
 topology, exactly as they always did, until re-captured.
 
@@ -154,23 +156,41 @@ def mesh_suffix(mesh_shape) -> str:
     return f"m{c}x{m}"
 
 
+def wire_suffix(wire_dtype) -> str:
+    """Canonical key fragment for a run's uplink wire dtype:
+    ``q<dtype>`` for quantized sketches (``qint8``, ``qbf16``,
+    ``qfp8``), ``""`` for f32/unknown — so every pre-quantization pin
+    keeps matching f32 runs unchanged, and a quantized run gets (and
+    REQUIRES) its own entry. An int8 round moves ~4x fewer collective
+    bytes than the f32 reference; letting it resolve an f32 pin would
+    make the gate read the dtype change as a giant perf swing in both
+    directions."""
+    if not wire_dtype or str(wire_dtype) == "f32":
+        return ""
+    return f"q{wire_dtype}"
+
+
 def topology_key(device_count=None, process_count=None,
-                 mesh_shape=None) -> str:
+                 mesh_shape=None, wire_dtype=None) -> str:
     """Baseline entry key for one topology point. ``d<D>p<P>`` when
     both counts are known — suffixed ``m<C>x<M>`` for 2D-mesh runs
     (a 4x2 and an 8x1 run on the same 8 chips are different programs,
-    not one noise band) — :data:`ANY_TOPOLOGY` otherwise: unknown
-    topologies form their own bucket rather than silently matching a
-    counted one."""
+    not one noise band) and ``q<dtype>`` for quantized-wire runs
+    (int8 vs f32 collectives are different experiments) —
+    :data:`ANY_TOPOLOGY` otherwise: unknown topologies form their own
+    bucket rather than silently matching a counted one. Quantized
+    runs with unknown counts still split off (``any-q<dtype>``)."""
     if device_count is None or process_count is None:
-        return ANY_TOPOLOGY
+        w = wire_suffix(wire_dtype)
+        return f"{ANY_TOPOLOGY}-{w}" if w else ANY_TOPOLOGY
     return (f"d{int(device_count)}p{int(process_count)}"
-            f"{mesh_suffix(mesh_shape)}")
+            f"{mesh_suffix(mesh_shape)}{wire_suffix(wire_dtype)}")
 
 
 def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                         device_count=None, process_count=None,
-                        config_hash: str = "", mesh_shape=None) -> Dict:
+                        config_hash: str = "", mesh_shape=None,
+                        wire_dtype=None) -> Dict:
     entry = {"ts": clock.wall(), "source": source, "metrics": metrics}
     if device_count is not None:
         entry["device_count"] = int(device_count)
@@ -182,20 +202,23 @@ def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
         entry["mesh_shape"] = (dict(mesh_shape)
                                if isinstance(mesh_shape, dict)
                                else list(mesh_shape))
+    if wire_suffix(wire_dtype):
+        entry["wire_dtype"] = str(wire_dtype)
     return entry
 
 
 def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
                   extra: Dict = None, device_count=None,
                   process_count=None, config_hash: str = "",
-                  mesh_shape=None) -> Dict:
+                  mesh_shape=None, wire_dtype=None) -> Dict:
     """A fresh schema-2 baseline holding one topology entry."""
-    key = topology_key(device_count, process_count, mesh_shape)
+    key = topology_key(device_count, process_count, mesh_shape,
+                       wire_dtype)
     base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
             "topologies": {key: make_topology_entry(
                 metrics, source=source, device_count=device_count,
                 process_count=process_count, config_hash=config_hash,
-                mesh_shape=mesh_shape)}}
+                mesh_shape=mesh_shape, wire_dtype=wire_dtype)}}
     if extra:
         base.update(extra)
     return base
@@ -218,7 +241,7 @@ def migrate_baseline(baseline: Dict) -> Dict:
 def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
                     source: str = "", device_count=None,
                     process_count=None, config_hash: str = "",
-                    mesh_shape=None) -> Dict:
+                    mesh_shape=None, wire_dtype=None) -> Dict:
     """Insert/replace ONE topology's entry, leaving every other
     topology point untouched — how the gate CLI re-captures the
     8-device headline without disturbing the single-chip one.
@@ -227,22 +250,28 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
         {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
          "topologies": {}}
     base["topologies"] = dict(base.get("topologies", {}))
-    key = topology_key(device_count, process_count, mesh_shape)
+    key = topology_key(device_count, process_count, mesh_shape,
+                       wire_dtype)
     base["topologies"][key] = make_topology_entry(
         metrics, source=source, device_count=device_count,
         process_count=process_count, config_hash=config_hash,
-        mesh_shape=mesh_shape)
+        mesh_shape=mesh_shape, wire_dtype=wire_dtype)
     base["ts"] = clock.wall()
     return base
 
 
 def baseline_entry(baseline: Dict, device_count=None,
-                   process_count=None, mesh_shape=None):
+                   process_count=None, mesh_shape=None,
+                   wire_dtype=None):
     """The topology entry ``compare`` gates against, or None when the
     baseline has no entry for this topology. A 2D-mesh run resolves
     its exact ``d<D>p<P>m<C>x<M>`` entry first and falls back to the
     mesh-blind ``d<D>p<P>`` pin (pins captured before mesh keying
     existed keep gating until re-captured — migration, not a hole).
+    Quantized-wire runs get NO such fallback: an int8 run must never
+    resolve an f32 pin — the dtype changes the collective bytes ~4x,
+    so cross-dtype comparison is a category error, not noise. An
+    ungated quantized topology stays None (compare raises loudly).
     Schema-1 baselines resolve for ANY topology (their historical,
     topology-blind behaviour — re-capture to get keyed guarding)."""
     schema = baseline.get("schema")
@@ -255,10 +284,13 @@ def baseline_entry(baseline: Dict, device_count=None,
                 "metrics": baseline.get("metrics", {})}
     topologies = baseline.get("topologies", {})
     entry = topologies.get(
-        topology_key(device_count, process_count, mesh_shape))
+        topology_key(device_count, process_count, mesh_shape,
+                     wire_dtype))
     if entry is None and mesh_suffix(mesh_shape):
+        # drop only the mesh fragment; the wire fragment stays
         entry = topologies.get(
-            topology_key(device_count, process_count))
+            topology_key(device_count, process_count,
+                         wire_dtype=wire_dtype))
     return entry
 
 
@@ -270,7 +302,8 @@ def _threshold(base_entry: Dict, rel_tol: float, mad_k: float):
 def compare(baseline: Dict, metrics: Dict[str, Dict],
             rel_tol: float = REL_TOL,
             mad_k: float = MAD_K, device_count=None,
-            process_count=None, mesh_shape=None) -> Dict:
+            process_count=None, mesh_shape=None,
+            wire_dtype=None) -> Dict:
     """Gate ``metrics`` against ``baseline``'s entry for this
     topology. Returns::
 
@@ -283,9 +316,10 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
     0.1 ms for ms-metrics, 100 µs for s-metrics). Raises ValueError
     when the baseline has no entry for this topology — an ungated
     topology point must fail loudly, not pass silently."""
-    key = topology_key(device_count, process_count, mesh_shape)
+    key = topology_key(device_count, process_count, mesh_shape,
+                       wire_dtype)
     entry = baseline_entry(baseline, device_count, process_count,
-                           mesh_shape)
+                           mesh_shape, wire_dtype)
     if entry is None:
         have = ", ".join(sorted(baseline.get("topologies", {}))) \
             or "none"

@@ -101,18 +101,31 @@ def run_mesh_shape(manifest: dict):
     return dict(shape) if isinstance(shape, dict) else None
 
 
+def run_wire_dtype(manifest: dict):
+    """The run's uplink wire dtype (``--sketch_dtype``) from its
+    recorded config, or None for non-sketch / pre-quantization
+    manifests — they only ever carried f32 on the wire."""
+    cfg = manifest.get("config") or {}
+    if cfg.get("mode") != "sketch":
+        return None
+    return cfg.get("sketch_dtype") or None
+
+
 def run_key(manifest: dict) -> tuple:
     """(config_hash, device_count, process_count): two runs are
     comparable — diffable by the report, gateable against one
     baseline entry — only when ALL three match. Config hash alone is
     not an identity: the same config on 1 vs 8 devices is a scaling
     experiment, not a regression. 2D-mesh runs append their
-    ``m<C>x<M>`` fragment (a 4x2 and an 8x1 program on the same chips
-    are different experiments); 1-D runs keep the historical 3-tuple,
-    so old manifests stay comparable to each other."""
-    from commefficient_tpu.telemetry.gate import mesh_suffix
+    ``m<C>x<M>`` fragment and quantized-wire runs their ``q<dtype>``
+    fragment (a 4x2 and an 8x1 program on the same chips — or an int8
+    and an f32 wire — are different experiments); 1-D f32 runs keep
+    the historical 3-tuple, so old manifests stay comparable to each
+    other."""
+    from commefficient_tpu.telemetry.gate import mesh_suffix, wire_suffix
     key = (manifest.get("config_hash") or "",) + run_topology(manifest)
-    suffix = mesh_suffix(run_mesh_shape(manifest))
+    suffix = (mesh_suffix(run_mesh_shape(manifest))
+              + wire_suffix(run_wire_dtype(manifest)))
     return key + (suffix,) if suffix else key
 
 

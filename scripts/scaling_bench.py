@@ -36,6 +36,13 @@ same 8 chips are guarded independently. Shapes whose device product
 exceeds the host's cores still run — virtual CPU devices make e.g. a
 32-device ``8x4`` layout a (slow but honest) dryrun.
 
+``--sketch_dtypes`` appends one point per uplink wire dtype
+(bf16/int8/fp8) on the largest requested device count. Each point's
+config carries its ``--sketch_dtype``, so its manifest — and
+therefore its perf-gate topology key — gets the ``q<dtype>`` suffix
+(``d8p1qint8``): a quantized point is guarded by its own baseline
+entry and never compared against the f32 curve.
+
 ``--multihost`` appends a 2-process point via the
 scripts/multihost_smoke.py launcher pattern (free-port coordinator,
 ``jax.distributed.initialize`` per worker): process 0 writes the
@@ -96,7 +103,8 @@ def worker(args):
                  local_momentum=0.0, virtual_momentum=0.9,
                  num_workers=W, local_batch_size=B,
                  num_clients=W * 2, dataset_name="CIFAR10", seed=0,
-                 k=16, num_rows=3, num_cols=256, mesh=args.mesh)
+                 k=16, num_rows=3, num_cols=256, mesh=args.mesh,
+                 sketch_dtype=args.sketch_dtype)
     cfg.ledger = args.ledger
     cfg.do_profile = True
 
@@ -162,6 +170,9 @@ def worker(args):
         "device_count": int(jax.device_count()),
         "process_count": int(jax.process_count()),
         "mesh_shape": mesh_shape,
+        "sketch_dtype": args.sketch_dtype,
+        "upload_wire_bytes_per_client": float(
+            cfg.upload_wire_bytes_per_client),
         "clients_per_s": round(clients_per_s, 2),
         "parallel_efficiency": round(eff, 3),
         "collective_fraction": round(
@@ -275,6 +286,12 @@ def main(argv=None):
                          "as extra points (e.g. 8x1,4x2,2x4,1x8); "
                          "each CxM point runs on C*M virtual devices "
                          f"and C must divide {W} workers")
+    ap.add_argument("--sketch_dtypes", default="",
+                    help="comma-separated uplink wire dtypes "
+                         "(bf16,int8,fp8) to append as extra points "
+                         "on the largest requested device count; "
+                         "each point's perf-gate key gets a q<dtype> "
+                         "suffix")
     ap.add_argument("--multihost", action="store_true",
                     help="append a 2-process point (2 devices per "
                          "process) and merge its ledger shards")
@@ -286,6 +303,8 @@ def main(argv=None):
     ap.add_argument("--devices", type=int, default=1,
                     help=argparse.SUPPRESS)
     ap.add_argument("--mesh", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--sketch_dtype", default="f32",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--ledger", default="", help=argparse.SUPPRESS)
     ap.add_argument("--ref_clients_per_s", type=float, default=0.0,
                     help=argparse.SUPPRESS)
@@ -313,6 +332,11 @@ def main(argv=None):
         if W % c:
             ap.error(f"mesh shape {s}: clients axis {c} does not "
                      f"divide {W} workers")
+    dtypes = [s.strip() for s in args.sketch_dtypes.split(",")
+              if s.strip()]
+    for dt in dtypes:
+        if dt not in ("f32", "bf16", "int8", "fp8"):
+            ap.error(f"unknown sketch dtype {dt}")
     stamp = int(time.time())
     points, ref = [], None
 
@@ -338,6 +362,18 @@ def main(argv=None):
             ref = (point["clients_per_s"], c * m)
         points.append(point)
         show(f"d{c * m}p1 mesh {c}x{m}", point)
+
+    for dt in dtypes:
+        n = max(counts) if counts else 1
+        point, _ = _run_point(n, args, ref, stamp,
+                              extra_cmd=["--sketch_dtype", dt],
+                              tag=f"q{dt}")
+        if ref is None:
+            ref = (point["clients_per_s"], n)
+        points.append(point)
+        show(f"d{n}p1 q{dt} "
+             f"({point['upload_wire_bytes_per_client']:.0f} B/client)",
+             point)
 
     if args.multihost:
         point, ledger = _run_point(4, args, ref, stamp, nproc=2)

@@ -7,9 +7,16 @@ can be attributed without a full-model xplane (VERDICT round-3 task #3
 ``sketch_from_leaves`` over a GPT-2-shaped leaf list against the flat
 ``sketch`` + its pad.
 
+``--sketch_dtype {f32,bf16,int8,fp8}`` adds the wire-quantization
+stages (quantize_table / dequantize / the fused sketch+quantize op)
+and reports the uplink wire bytes next to the f32 reference, so one
+invocation shows what a dtype buys in both time and bytes. With
+``--ledger`` the result also lands as a bench record and a run
+manifest under ``runs/`` (perf-gateable, wire-dtype keyed).
+
 Usage:
   python scripts/sketch_bench.py [--d 124439808] [--c 524288] [--r 5]
-      [--k 50000] [--reps 20] [--tree]
+      [--k 50000] [--reps 20] [--tree] [--sketch_dtype int8]
 """
 
 import argparse
@@ -89,9 +96,14 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU platform (the container's "
                     "sitecustomize overrides JAX_PLATFORMS)")
+    ap.add_argument("--sketch_dtype", default="f32",
+                    choices=["f32", "bf16", "int8", "fp8"],
+                    help="also time the wire-quantization stages at "
+                    "this dtype and report uplink wire bytes")
     ap.add_argument("--ledger", type=str, default="",
                     help="append the result as a telemetry JSONL "
-                    "bench record (stdout line unchanged)")
+                    "bench record and register a run manifest "
+                    "(stdout line unchanged)")
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -157,6 +169,31 @@ def main():
         reps=args.reps)
     res["unsketch_sparse_total_ms"] = round(ms, 2)
 
+    from commefficient_tpu import accounting
+    wire = args.sketch_dtype
+    res["wire"] = {
+        "sketch_dtype": wire,
+        "upload_wire_bytes": accounting.sketch_wire_bytes(
+            args.r, args.c, wire),
+        "upload_f32_bytes": accounting.sketch_wire_bytes(
+            args.r, args.c, "f32"),
+    }
+    if wire != "f32":
+        from commefficient_tpu.ops import quant
+        ms, qs = timed(
+            jax.jit(lambda t: quant.quantize_table(t, wire)),
+            table, reps=args.reps)
+        res["quantize_table_ms"] = round(ms, 2)
+        q, scale = qs
+        ms, _ = timed(
+            jax.jit(lambda qq: quant.dequantize(qq, scale)), q,
+            reps=args.reps)
+        res["dequantize_ms"] = round(ms, 2)
+        ms, _ = timed(
+            jax.jit(lambda vv: cs.sketch_quantized(vv, wire)), v,
+            reps=args.reps)
+        res["sketch_quantized_fused_ms"] = round(ms, 2)
+
     if args.chain:
         n = args.chain
 
@@ -181,9 +218,13 @@ def main():
 
     print(json.dumps(res))
     if args.ledger:
-        from commefficient_tpu.telemetry import append_bench_record
+        from commefficient_tpu.telemetry import (append_bench_record,
+                                                 registry)
         append_bench_record(args.ledger, "sketch_bench", res,
                             backend=jax.default_backend())
+        registry.maybe_write_manifest(
+            args, bench={"sketch_bench": res},
+            extra={"wire_dtype": wire})
 
 
 if __name__ == "__main__":

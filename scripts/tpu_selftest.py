@@ -147,6 +147,78 @@ def probe_smoke():
     return f"recovery error {rec:.3f}"
 
 
+def quant_smoke():
+    """Quantized uplink path on the REAL backend: the fused Pallas
+    emit+quantize kernel must agree bit-for-bit with the unfused
+    quantize_local(sketch(.)) path on-device for every wire dtype,
+    and a quantized sketch round's TRUE recovery error must stay
+    inside the alarm band of the f32 reference round (per-row scales
+    bound the quantization penalty; server momentum/EF stays f32) —
+    while moving ~4x fewer uplink bytes."""
+    from commefficient_tpu import accounting
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.core.rounds import (ClientStates,
+                                               build_client_round,
+                                               build_server_round)
+    from commefficient_tpu.core.server import ServerState
+    from commefficient_tpu.ops.quant import quantize_local
+    from commefficient_tpu.ops.sketch import CountSketch
+
+    d = 1 << 16
+    cs = CountSketch(d=d, c=4096, r=3, seed=7)
+    v = jnp.asarray(np.random.RandomState(0).randn(d)
+                    .astype(np.float32))
+    for wire in ("bf16", "int8", "fp8"):
+        qf, _ = jax.jit(lambda x, w=wire: cs.sketch_quantized(x, w))(v)
+        qu, _ = jax.jit(
+            lambda x, w=wire: quantize_local(cs.sketch(x), w))(v)
+        assert np.asarray(qf).tobytes() == np.asarray(qu).tobytes(), \
+            f"{wire}: fused kernel != unfused quantize"
+
+    W, B = 8, 4
+
+    def lin_loss(p, b):
+        n = jnp.maximum(jnp.sum(b["mask"]), 1.0)
+        loss = jnp.sum((b["c"] @ p) * b["mask"]) / n
+        return loss, (loss * 0.0,)
+
+    rng = np.random.RandomState(0)
+    cvec = rng.randn(W, 1, d).astype(np.float32)
+    cvec[:, :, :500] *= 50.0  # heavy hitters: recovery floor << 1
+    batch = {"c": jnp.asarray(np.broadcast_to(cvec, (W, B, d))),
+             "mask": jnp.ones((W, B), jnp.float32)}
+    flat = jnp.zeros((d,), jnp.float32)
+    errs = {}
+    for wire in ("f32", "int8", "fp8"):
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     num_workers=W, local_batch_size=B, k=500,
+                     num_rows=5, num_cols=16384, seed=21,
+                     sketch_dtype=wire)
+        cfg.grad_size = d
+        cr = jax.jit(build_client_round(cfg, lin_loss, B, probes=True,
+                                        probe_recovery=True))
+        sr = jax.jit(build_server_round(cfg, probes=True))
+        res = cr(flat, ClientStates.init(cfg, 100, flat), batch,
+                 jnp.arange(W, dtype=jnp.int32),
+                 jax.random.PRNGKey(0), 1.0)
+        out = sr(flat, ServerState.init(cfg), res.aggregated,
+                 jnp.float32(0.1))
+        assert bool(jnp.isfinite(out[0]).all()), wire
+        pr = {k: float(x) for k, x in res.probes.items()}
+        pr.update({k: float(x) for k, x in out[-1].items()})
+        assert pr["agg_nan"] == 0 and pr["agg_inf"] == 0, (wire, pr)
+        errs[wire] = pr["recovery_error"]
+    band = max(2.0 * errs["f32"], errs["f32"] + 0.05)
+    assert errs["int8"] <= band, errs
+    assert errs["fp8"] <= band, errs
+    ratio = (accounting.sketch_wire_bytes(5, 16384, "f32")
+             / accounting.sketch_wire_bytes(5, 16384, "int8"))
+    return (f"fused==unfused bitwise; recovery err f32 "
+            f"{errs['f32']:.3f} int8 {errs['int8']:.3f} fp8 "
+            f"{errs['fp8']:.3f}; uplink {ratio:.2f}x smaller at int8")
+
+
 def audit_smoke():
     """Static audit on the REAL backend: zero unwaived lint hits, and
     the sketch fused round compiled for this topology is donation-
@@ -438,6 +510,7 @@ def main():
     check("pallas_vs_xla_sketch_parity", pallas_parity)
     check("bf16_flagship_round", bf16_round_trains)
     check("probe_smoke", probe_smoke)
+    check("quant_smoke", quant_smoke)
     check("audit_smoke", audit_smoke)
     check("trace_smoke", trace_smoke)
     check("scaling_smoke", scaling_smoke)

@@ -14,6 +14,7 @@ case); this mirror is the oracle for the *current* semantics.
 
 from __future__ import annotations
 
+import ml_dtypes
 import numpy as np
 
 
@@ -96,6 +97,77 @@ def np_robust_fold(cfg, transmits, counts):
     rej = (np.linalg.norm(plain - agg)
            / max(np.linalg.norm(plain), _TINY))
     return agg.reshape(np.shape(transmits[0])), float(rej)
+
+
+# wire quantization (mirror of ops/quant.py) --------------------------
+
+NP_WIRE_DTYPES = {"bf16": np.dtype(ml_dtypes.bfloat16),
+                  "int8": np.dtype(np.int8),
+                  "fp8": np.dtype(ml_dtypes.float8_e4m3fn)}
+NP_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def np_qeff(wire, n_addends):
+    """Per-addend wire range under summation headroom; identical
+    formula to ops/quant.qeff (int8 floors to an integer step)."""
+    q = NP_QMAX[wire]
+    if wire == "int8":
+        return float(max(1, int(q // max(1, n_addends))))
+    return q / float(max(1, n_addends))
+
+
+def np_quantize_table(table, wire, n_addends=1, global_rowmax=None):
+    """f32 sketch table -> (wire-dtype table, f32 per-row scale) —
+    the local-quantize + harmonize scheme of ops/quant.py, in NumPy.
+    All arithmetic is float32 (same dtype the engine traces in) and
+    the bf16/fp8 casts share ml_dtypes' conversion code with jax, so
+    at ``n_addends=1`` with ``global_rowmax=None`` (the single-shard
+    wire crossing the engine's ``_qdq_local`` performs) the result is
+    bit-identical to the device path. ``scale`` is None for bf16."""
+    t = np.asarray(table, np.float32)
+    if wire == "bf16":
+        return t.astype(NP_WIRE_DTYPES["bf16"]), None
+    qmax = np.float32(NP_QMAX[wire])
+    rowmax = np.max(np.abs(t), axis=-1, keepdims=True)
+    s_local = np.where(rowmax > 0, rowmax / qmax,
+                       np.float32(1.0)).astype(np.float32)
+    if wire == "int8":
+        q = np.clip(np.round(t / s_local), -qmax, qmax)
+    else:
+        # fp8 rounds through an EXPLICIT f16 intermediate, exactly as
+        # ops/quant._to_fp8 does on device
+        q = (t / s_local).astype(np.float16).astype(
+            NP_WIRE_DTYPES["fp8"])
+    if global_rowmax is None:
+        global_rowmax = rowmax
+    g = np.asarray(global_rowmax, np.float32).reshape(rowmax.shape)
+    qe = np.float32(np_qeff(wire, n_addends))
+    s_global = np.where(g > 0, g / qe,
+                        np.float32(1.0)).astype(np.float32)
+    ratio = (s_local / s_global).astype(np.float32)
+    if wire == "int8":
+        q = np.clip(np.round(q.astype(np.float32) * ratio),
+                    -qmax, qmax).astype(np.int8)
+    else:
+        q = (q.astype(np.float32) * ratio).astype(
+            np.float16).astype(NP_WIRE_DTYPES["fp8"])
+    return q, s_global
+
+
+def np_dequantize_table(q, scale):
+    """Wire-dtype table -> f32 (mirror of ops/quant.dequantize)."""
+    t = np.asarray(q).astype(np.float32)
+    if scale is None:
+        return t
+    return t * np.asarray(scale, np.float32)
+
+
+def np_qdq_table(table, wire):
+    """Full single-shard wire crossing: quantize at full range and
+    dequantize. f32 is a passthrough (no wire crossing exists)."""
+    if wire == "f32":
+        return np.asarray(table, np.float32)
+    return np_dequantize_table(*np_quantize_table(table, wire))
 
 
 class MirrorFed:
@@ -275,10 +347,28 @@ class MirrorFed:
         transmits = [self._client_transmit(cid, X, y, B)
                      for cid, X, y in clients]
         robust = getattr(self.cfg, "robust_agg", "none") != "none"
+        wire = getattr(self.cfg, "sketch_dtype", "f32")
+        quantized = self.cfg.mode == "sketch" and wire != "f32"
+        # where the table crosses the wire (mirrors the engine's path
+        # split in core/rounds.py): clip / robust paths upload
+        # per-client tables, so each transmit is quantized BEFORE the
+        # fold; the sketch-late paths upload one summed table, so the
+        # sum quantizes before the division. (The fused path qdq's
+        # after the division — the scheme is scale-invariant up to
+        # rounding, so both forms agree; tolerances absorb the ULPs.)
+        late = (self.cfg.mode == "sketch"
+                and self.cfg.max_grad_norm is None and not robust)
+        if quantized and not late:
+            transmits = [np_qdq_table(t, wire).astype(np.float64)
+                         for t in transmits]
         rej = None
         if robust:
             agg, rej = np_robust_fold(
                 self.cfg, transmits, [len(y) for _, _, y in clients])
+        elif quantized:
+            agg = np_qdq_table(
+                np.sum(transmits, axis=0), wire).astype(np.float64) \
+                / total
         else:
             agg = np.sum(transmits, axis=0) / total
         # sketch-late engine paths materialise DENSE per-client

@@ -11,8 +11,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from commefficient_tpu import accounting
 from commefficient_tpu.config import Config
 from commefficient_tpu.runtime import FedModel
+
+# downloads ship values as f32 under the dense encoding
+VAL_BYTES = accounting.bytes_of(1, "f32")
 
 
 def make_model(grad_size=50, num_clients=6):
@@ -50,8 +54,8 @@ class BruteForce:
         self.last_updated[changed_idx] = self.round
 
     def download(self, ids):
-        out = np.array([4.0 * np.sum(self.last_updated
-                                     > self.last_seen[c])
+        out = np.array([VAL_BYTES * np.sum(self.last_updated
+                                           > self.last_seen[c])
                         for c in ids])
         self.last_seen[ids] = self.round
         return out
@@ -178,6 +182,99 @@ def test_local_topk_virtual_momentum_sparse_download():
     assert got[5] < 4.0 * d
 
 
+def make_delta_model(wire="int8", grad_size=64, num_clients=6):
+    import flax.linen as nn
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(grad_size // 2, use_bias=False)(x)
+
+    module = Lin()
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 2)))[
+        "params"]
+    args = Config(mode="sketch", error_type="virtual",
+                  local_momentum=0.0, virtual_momentum=0.9,
+                  num_rows=2, num_cols=16, num_blocks=1, k=3,
+                  num_workers=2, local_batch_size=2,
+                  num_clients=num_clients, dataset_name="CIFAR10",
+                  seed=0, sketch_dtype=wire,
+                  downlink_encoding="delta")
+
+    def loss(p, batch, cfg):
+        return jnp.float32(0.0), ()
+
+    return FedModel(module, params, loss, args)
+
+
+@pytest.mark.parametrize("wire", ["f32", "int8"])
+def test_delta_downlink_matches_brute_force(wire):
+    """--downlink_encoding delta vs a dense-history brute force: per
+    client, changed values ship at the wire width, indices (int32)
+    only for coords NOT in the previous broadcast's support, repeats
+    as one bitmap bit per previous-support coord — and only clients
+    that saw the previous broadcast get to delta-code at all."""
+    rng = np.random.RandomState(3)
+    m = make_delta_model(wire=wire)
+    d = m.args.grad_size
+    wb = accounting.dtype_bytes(wire)
+    idx_b = accounting.dtype_bytes(np.int32)
+
+    last_updated = np.full(d, -1, np.int64)
+    last_seen = np.full(m.num_clients, -1, np.int64)
+    prev_vec = np.zeros(d, bool)  # previous update's support
+    repeated = 0
+    bitmap_bits = 0
+    rnd = 0
+    for _ in range(40):
+        if rng.rand() < 0.15:
+            sup = None  # dense update
+            vec = np.ones(d, bool)
+            m.note_update(None)
+        else:
+            k = rng.randint(1, 10)
+            sup = np.sort(rng.choice(d, k, replace=False))
+            vec = np.zeros(d, bool)
+            vec[sup] = True
+            m.note_update((sup, np.ones(len(sup))))
+        rnd += 1
+        repeated = int((vec & prev_vec).sum())
+        bitmap_bits = int(prev_vec.sum())
+        prev_vec = vec
+        last_updated[vec] = rnd
+
+        ids = rng.choice(m.num_clients, 2, replace=False)
+        got, _ = m._account_bytes(ids)
+        for c in ids:
+            changed = int(np.sum(last_updated > last_seen[c]))
+            if last_seen[c] == rnd - 1:  # saw the previous broadcast
+                want = (changed * wb
+                        + (changed - repeated) * idx_b
+                        + int(np.ceil(bitmap_bits / 8)))
+            else:
+                want = changed * (wb + idx_b)
+            assert got[c] == want, (wire, rnd, c, got[c], want)
+            last_seen[c] = rnd
+
+
+def test_delta_downlink_stale_client_pays_full_indices():
+    """A client that skipped a broadcast cannot delta-code: every
+    changed coord ships (idx, val) with no bitmap."""
+    m = make_delta_model(wire="int8")
+    d = m.args.grad_size
+    idx = np.arange(5)
+    m.note_update((idx, np.ones(5)))
+    # client 0 syncs at round 1; client 1 stays stale
+    m._account_bytes(np.array([0]))
+    m.note_update((idx, np.ones(5)))  # identical support: all repeats
+    got, _ = m._account_bytes(np.array([0, 1]))
+    # fresh client: 5 values + 0 fresh indices + ceil(5/8)=1 bitmap
+    assert got[0] == 5 * 1 + 0 * 4 + 1
+    # stale client: both rounds' union is still those 5 coords, but
+    # nothing delta-codes — 5 x (idx + val)
+    assert got[1] == 5 * (4 + 1)
+
+
 class TestLedgerMatchesBruteForce:
     """Full-stack mode matrix: run a real FedModel + FedOptimizer for
     3 rounds with the JSONL ledger sink attached, and assert each
@@ -194,6 +291,21 @@ class TestLedgerMatchesBruteForce:
         "sketch": dict(mode="sketch", error_type="virtual",
                        local_momentum=0.0, virtual_momentum=0.9,
                        num_rows=2, num_cols=16, num_blocks=1, k=3),
+        # quantized wire lattice: the ledger's uplink total must price
+        # the table at the wire width plus the f32 row scales, never
+        # at a hardcoded 4 bytes/element
+        "sketch_bf16": dict(mode="sketch", error_type="virtual",
+                            local_momentum=0.0, virtual_momentum=0.9,
+                            num_rows=2, num_cols=16, num_blocks=1,
+                            k=3, sketch_dtype="bf16"),
+        "sketch_int8": dict(mode="sketch", error_type="virtual",
+                            local_momentum=0.0, virtual_momentum=0.9,
+                            num_rows=2, num_cols=16, num_blocks=1,
+                            k=3, sketch_dtype="int8"),
+        "sketch_fp8": dict(mode="sketch", error_type="virtual",
+                           local_momentum=0.0, virtual_momentum=0.9,
+                           num_rows=2, num_cols=16, num_blocks=1,
+                           k=3, sketch_dtype="fp8"),
         "true_topk": dict(mode="true_topk", error_type="virtual",
                           local_momentum=0.0, virtual_momentum=0.9,
                           k=3),
@@ -251,8 +363,14 @@ class TestLedgerMatchesBruteForce:
             # server update lands (end of the client pass) — mirror
             want_down = bf.download(ids)
             np.testing.assert_array_equal(down[ids], want_down)
-            assert up.sum() == \
-                4.0 * 2 * args.upload_floats_per_client
+            # dtype-aware uplink: wire-width table (+ f32 row scales
+            # for the scaled dtypes), f32 floats everywhere else
+            assert up.sum() == 2 * args.upload_wire_bytes_per_client
+            if mode == "sketch_int8":
+                assert args.upload_wire_bytes_per_client == \
+                    accounting.sketch_wire_bytes(2, 16, "int8")
+                assert up.sum() < \
+                    VAL_BYTES * 2 * args.upload_floats_per_client
             opt.step()
             w_after = np.asarray(model.ps_weights)
             bf.note(np.nonzero(w_before != w_after)[0])

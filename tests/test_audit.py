@@ -293,16 +293,21 @@ def test_static_uplink_bytes_match_ledger_exactly(audit_report, name):
 
 def test_ledger_bytes_agree_with_accounting_formula(audit_report):
     """Anchor the cross-check to the same source of truth
-    tests/test_accounting.py brute-forces: uplink bytes per client are
-    4 * cfg.upload_floats_per_client."""
+    tests/test_accounting.py brute-forces: uplink bytes per client
+    are ``accounting.bytes_of`` at the program's wire dtype (table at
+    wire width + per-row f32 scales where the dtype carries them)."""
+    from commefficient_tpu import accounting
+
     for name, entry in audit_report["programs"].items():
         if "uplink" not in entry:
             continue
         cfg = make_cfg(entry["mode"], 8,
                        **SERVER_CFG_KW[entry["mode"]])
         if entry["mode"] == "sketch":
+            wire = entry["uplink"]["wire_dtype"]
             assert entry["uplink"]["ledger_bytes_per_client"] == \
-                4 * cfg.num_rows * cfg.num_cols
+                accounting.sketch_wire_bytes(cfg.num_rows,
+                                             cfg.num_cols, wire)
         elif entry["mode"] == "local_topk":
             assert entry["uplink"]["ledger_bytes_per_client"] == \
                 4 * cfg.k

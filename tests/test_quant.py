@@ -1,0 +1,269 @@
+"""Wire-quantization ops (``--sketch_dtype``): quantize/harmonize/
+dequantize properties, bit-exact parity with the NumPy reference
+mirror, the fused Pallas emit+quantize path vs sketch-then-quantize,
+recovery error inside the alarm band, the downlink delta-encoding
+byte formula, and the f32 HLO-identity pin (quantization machinery
+must leave ZERO trace in the f32 round program)."""
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from commefficient_tpu import accounting
+from commefficient_tpu.ops import quant
+from commefficient_tpu.ops.sketch import CountSketch
+from tests.reference_mirror import (np_dequantize_table, np_qeff,
+                                    np_quantize_table)
+
+SCALED = ["int8", "fp8"]
+WIRES = ["bf16", "int8", "fp8"]
+
+
+def rand_table(r=4, c=64, seed=0, zero_row=True):
+    """Rows at wildly different magnitudes (each row carries its own
+    scale) plus, by default, one all-zero row for the 0/0 guard."""
+    rng = np.random.RandomState(seed)
+    t = rng.randn(r, c).astype(np.float32)
+    t *= np.power(10.0, rng.randint(-3, 4, (r, 1))).astype(np.float32)
+    if zero_row:
+        t[1] = 0.0
+    return t
+
+
+class TestQuantizeProperties:
+    @pytest.mark.parametrize("wire", SCALED)
+    def test_roundtrip_error_bounded(self, wire):
+        t = rand_table()
+        q, s = jax.jit(lambda x: quant.quantize_table(x, wire))(
+            jnp.asarray(t))
+        back = np.asarray(quant.dequantize(q, s))
+        s = np.asarray(s)
+        if wire == "int8":
+            # uniform steps of width ``scale``: half-step plus one
+            # f32 ULP of the div/mul round trip
+            assert np.all(np.abs(back - t) <= 0.5 * s * (1 + 1e-6))
+        else:
+            # e4m3 relative ulp/2 = 2^-4 (f16 intermediate adds at
+            # most one more near-tie ULP -> 2^-3 is safely loose);
+            # subnormal floor: half the min subnormal (2^-9) x scale
+            assert np.all(np.abs(back - t)
+                          <= np.maximum(np.abs(t) * 2.0**-3,
+                                        s * 2.0**-10))
+
+    @pytest.mark.parametrize("wire", SCALED)
+    def test_zero_row_guard(self, wire):
+        """All-zero rows quantize to zeros under scale exactly 1.0 —
+        the 0/0 guard in ops/quant._scale."""
+        t = rand_table()
+        q, s = quant.quantize_table(jnp.asarray(t), wire)
+        q, s = np.asarray(q), np.asarray(s)
+        assert np.all(np.asarray(q[1], np.float32) == 0.0)
+        assert s[1, 0] == 1.0
+        assert np.all(np_dequantize_table(q, s)[1] == 0.0)
+
+    def test_qeff_headroom_schedule(self):
+        # int8 floors to an integer step and never drops below 1
+        assert quant.qeff("int8", 1) == 127.0
+        assert quant.qeff("int8", 2) == 63.0
+        assert quant.qeff("int8", 8) == 15.0
+        assert quant.qeff("int8", 127) == 1.0
+        assert quant.qeff("int8", 500) == 1.0
+        # fp8 values are not integers: exact division
+        assert quant.qeff("fp8", 1) == 448.0
+        assert quant.qeff("fp8", 2) == 224.0
+        assert quant.qeff("fp8", 7) == 448.0 / 7.0
+        # the mirror runs the identical schedule
+        for wire in SCALED:
+            for n in (1, 2, 7, 8, 127, 500):
+                assert np_qeff(wire, n) == quant.qeff(wire, n)
+
+    @pytest.mark.parametrize("wire", SCALED)
+    def test_harmonize_identity_single_shard(self, wire):
+        """n_addends=1 with global == local rowmax: harmonize must be
+        the bit-exact identity (IEEE x/x == 1; re-rounding a value
+        the format already holds is itself)."""
+        t = rand_table(seed=3)
+        q, rowmax = quant.quantize_local(jnp.asarray(t), wire)
+        qq, s = quant.harmonize(q, rowmax, rowmax, wire, 1)
+        assert (np.asarray(qq).tobytes() == np.asarray(q).tobytes())
+        np.testing.assert_array_equal(
+            np.asarray(s),
+            np.asarray(quant._scale(rowmax, quant.qeff(wire, 1))))
+
+    @pytest.mark.parametrize("wire", SCALED)
+    def test_summation_headroom_no_overflow(self, wire):
+        """n shards harmonized onto the shared scale: the wire-dtype
+        sum can never leave the wire range, and dequantizing the sum
+        approximates the true f32 sum within n half-steps."""
+        n, r, c = 4, 3, 128
+        shards = [rand_table(r, c, seed=10 + i, zero_row=False)
+                  for i in range(n)]
+        locs = [quant.quantize_local(jnp.asarray(t), wire)
+                for t in shards]
+        g = jnp.max(jnp.stack([rm for _, rm in locs]), axis=0)
+        harm = [quant.harmonize(q, rm, g, wire, n) for q, rm in locs]
+        scale = np.asarray(harm[0][1])
+        total = sum(np.asarray(q, np.float32) for q, _ in harm)
+        assert np.all(np.abs(total) <= quant.QMAX[wire])
+        back = total * scale
+        true = sum(shards)
+        step = scale * (1.0 if wire == "int8" else 2.0**-3
+                        * quant.qeff(wire, n))
+        tol = n * 0.5 * step + n * np.abs(true) * (
+            0.0 if wire == "int8" else 2.0**-3)
+        assert np.all(np.abs(back - true) <= tol + 1e-6)
+
+    def test_bf16_is_scale_free_cast(self):
+        t = rand_table(seed=4)
+        q, s = quant.quantize_table(jnp.asarray(t), "bf16")
+        assert s is None
+        np.testing.assert_array_equal(
+            np.asarray(q), t.astype(ml_dtypes.bfloat16))
+        np.testing.assert_array_equal(
+            np.asarray(quant.dequantize(q, s)),
+            t.astype(ml_dtypes.bfloat16).astype(np.float32))
+
+    def test_fp8_routes_through_explicit_f16(self):
+        """The f32->fp8 convert is pinned to double-round via f16
+        (ops/quant._to_fp8) so CPU/TPU/NumPy agree bit-for-bit."""
+        rng = np.random.RandomState(5)
+        x = np.concatenate([
+            rng.randn(512).astype(np.float32) * 448.0,
+            rng.randn(512).astype(np.float32) * 2.0**-9,
+            np.float32([448.0, -448.0, 0.0, 2.0**-9, 2.0**-10]),
+        ])
+        got = np.asarray(quant._to_fp8(jnp.asarray(x), "fp8"))
+        want = x.astype(np.float16).astype(ml_dtypes.float8_e4m3fn)
+        assert got.tobytes() == want.tobytes()
+
+
+class TestMirrorParity:
+    """tests/reference_mirror.np_quantize_table is the engine-side
+    oracle (used by the mode-vs-mirror suites): it must match the jax
+    ops bit-for-bit, including the multi-shard harmonize path."""
+
+    @pytest.mark.parametrize("wire", WIRES)
+    @pytest.mark.parametrize("n_addends", [1, 2, 8])
+    def test_bitwise(self, wire, n_addends):
+        t = rand_table(seed=6)
+        # a shared rowmax above the local one exercises the ratio<1
+        # harmonize branch the multi-shard collective hits
+        g = None if n_addends == 1 else np.max(
+            np.abs(t), axis=-1, keepdims=True) * np.float32(2.0)
+        qj, sj = quant.quantize_table(
+            jnp.asarray(t), wire, n_addends=n_addends,
+            global_rowmax=None if g is None else jnp.asarray(g))
+        qn, sn = np_quantize_table(t, wire, n_addends=n_addends,
+                                   global_rowmax=g)
+        assert np.asarray(qj).tobytes() == qn.tobytes()
+        if wire == "bf16":
+            assert sj is None and sn is None
+        else:
+            assert np.asarray(sj).tobytes() == sn.tobytes()
+            np.testing.assert_array_equal(
+                np.asarray(quant.dequantize(qj, sj)),
+                np_dequantize_table(qn, sn))
+
+
+class TestFusedPallas:
+    """ops/sketch_pallas.sketch_quant_pallas (emit + quantize in one
+    kernel, f32 table confined to VMEM scratch) vs sketch-then-
+    quantize over the SAME pallas table: exact agreement."""
+
+    @pytest.mark.parametrize("wire", WIRES)
+    @pytest.mark.parametrize("d,c,r", [(5000, 1024, 3), (300, 128, 5)])
+    def test_fused_matches_unfused(self, wire, d, c, r):
+        cs = CountSketch(d=d, c=c, r=r, seed=7,
+                         backend="pallas_interpret")
+        v = jnp.asarray(
+            np.random.RandomState(0).randn(d).astype(np.float32))
+        qf, rmf = cs.sketch_quantized(v, wire)
+        qu, rmu = quant.quantize_local(cs.sketch(v), wire)
+        assert np.asarray(qf).tobytes() == np.asarray(qu).tobytes()
+        if wire == "bf16":
+            assert rmf is None and rmu is None
+        else:
+            np.testing.assert_array_equal(np.asarray(rmf),
+                                          np.asarray(rmu))
+
+
+class TestRecoveryBand:
+    @pytest.mark.parametrize("wire", SCALED)
+    def test_quantized_recovery_stays_in_band(self, wire):
+        """Top-k recovery from a quantize->dequantize table stays
+        within the alarm band of f32 recovery (the table's own noise
+        dominates the wire rounding at sane geometries)."""
+        d, c, r, k = 1 << 14, 2048, 3, 100
+        cs = CountSketch(d=d, c=c, r=r, seed=11)
+        rng = np.random.RandomState(12)
+        v = rng.randn(d).astype(np.float32) * 0.01
+        hh = rng.choice(d, k, replace=False)
+        v[hh] += rng.randn(k).astype(np.float32) * 10.0
+        table = cs.sketch(jnp.asarray(v))
+
+        def err(t):
+            _, idx, vals = cs.unsketch(t, k, with_support=True)
+            rec = np.zeros(d, np.float32)
+            rec[np.asarray(idx)] = np.asarray(vals)
+            return float(np.linalg.norm(rec - v) / np.linalg.norm(v))
+
+        e32 = err(table)
+        eq = err(quant.dequantize(*quant.quantize_table(table, wire)))
+        assert eq <= max(2.0 * e32, e32 + 0.05), (wire, e32, eq)
+
+
+class TestWireByteFormulas:
+    def test_uplink_ratio_meets_frontier(self):
+        """int8 uplink at the reference geometry (5 x 16384): >= 3.5x
+        fewer bytes than f32 — the PR's headline wire saving."""
+        f32 = accounting.sketch_wire_bytes(5, 16384, "f32")
+        i8 = accounting.sketch_wire_bytes(5, 16384, "int8")
+        assert f32 / i8 >= 3.5
+        # scaled dtypes carry one f32 scale per row
+        assert i8 == 5 * 16384 * 1 + 5 * 4
+        assert accounting.sketch_wire_bytes(5, 16384, "bf16") == f32 / 2
+
+    def test_delta_downlink_formula(self):
+        f = accounting.delta_downlink_bytes
+        # 10 changed, 4 repeat the previous support of 9: 10 int8
+        # values + 6 fresh int32 indices + ceil(9/8)=2 bitmap bytes
+        assert f(10, 4, 9, "int8") == 10 * 1 + 6 * 4 + 2
+        assert f(10, 4, 9, "f32") == 10 * 4 + 6 * 4 + 2
+        # a stale client delta-codes nothing: every coord is (idx, val)
+        assert f(10, 4, 9, "int8", have_prev=False) == 10 * (1 + 4)
+        assert f(0, 0, 0, "int8") == 0.0
+
+
+class TestF32HloIdentity:
+    def test_f32_program_carries_no_quantization(self):
+        """sketch_dtype='f32' must compile the EXACT round program a
+        config that never mentions the flag compiles (the committed
+        audit_baseline.json pins it against the pre-feature program),
+        and no wire-dtype tensor may appear anywhere in it."""
+        from commefficient_tpu.analysis import hlo, program
+        from commefficient_tpu.core.rounds import build_client_round
+        from commefficient_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(jax.devices())
+
+        def lower(cfg):
+            fn = build_client_round(cfg, program._toy_loss, program.B,
+                                    mesh=mesh)
+            args = program._client_inputs(cfg, mesh)
+            return jax.jit(fn, donate_argnums=(1,)).lower(
+                *args).as_text()
+
+        explicit = program.make_cfg(
+            "sketch", program.MESH_W, error_type="virtual",
+            virtual_momentum=0.9, sketch_dtype="f32")
+        silent = program.make_cfg(
+            "sketch", program.MESH_W, error_type="virtual",
+            virtual_momentum=0.9)
+        # the getattr-defaulted form the runtime also tolerates
+        del silent.__dict__["sketch_dtype"]
+        text = lower(explicit)
+        assert hlo.fingerprint(text) == hlo.fingerprint(lower(silent))
+        for wire_type in ("xi8>", "f8E4M3", "xbf16>"):
+            assert wire_type not in text, wire_type
