@@ -315,6 +315,61 @@ def _check_chaos_confinement(rel, lines, tree):
     return hits
 
 
+# --- rule: arrival-confinement -----------------------------------------
+
+
+def _check_arrival_confinement(rel, lines, tree):
+    """Arrival-process injection (asyncfed) is strictly a
+    test/bench facility, mirroring chaos-confinement: production
+    package modules must never construct an ``ArrivalSchedule`` (it
+    lives in data/chaos.py — importing it is already an import
+    violation; naming it at all is flagged here as defense in depth)
+    nor CALL ``attach_arrival_process`` with a schedule. The
+    forwarding hooks themselves (``def attach_arrival_process`` on
+    FedModel/AsyncRoundDriver, including the one-line relay in their
+    bodies) are the sanctioned injection surface for code living
+    outside the package root."""
+    if rel.as_posix() == "data/chaos.py":
+        return []
+    # line ranges of the sanctioned forwarding defs: a call to the
+    # inner hook from inside `def attach_arrival_process` is the
+    # relay, not an injection
+    relay = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "attach_arrival_process":
+            relay.append((node.lineno, node.end_lineno or node.lineno))
+    hits = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and \
+                node.id == "ArrivalSchedule":
+            hits.append((node.lineno,
+                         "ArrivalSchedule named in a production "
+                         "module — arrival processes are "
+                         "test/bench-only (inject via "
+                         "attach_arrival_process from outside the "
+                         "package)"))
+        elif isinstance(node, ast.Attribute) and \
+                node.attr == "ArrivalSchedule":
+            hits.append((node.lineno,
+                         "ArrivalSchedule referenced in a production "
+                         "module — arrival processes are "
+                         "test/bench-only"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None)
+            if name != "attach_arrival_process":
+                continue
+            if any(lo <= node.lineno <= hi for lo, hi in relay):
+                continue
+            hits.append((node.lineno,
+                         "attach_arrival_process() called from a "
+                         "production module — arrival injection is "
+                         "test/bench-only"))
+    return hits
+
+
 # --- rule: inline-partition-spec ---------------------------------------
 
 
@@ -431,6 +486,9 @@ ALL_RULES = [
     Rule("chaos-confinement",
          "data/chaos.py imported by a production module",
          _check_chaos_confinement),
+    Rule("arrival-confinement",
+         "arrival-process injection outside tests/benches/scripts",
+         _check_arrival_confinement),
     Rule("inline-partition-spec",
          "PartitionSpec/NamedSharding built outside parallel/",
          _check_inline_partition_spec),

@@ -1,0 +1,38 @@
+"""Buffered asynchronous federated rounds (FedBuff-style serving).
+
+The synchronous round is a barrier: every client in the cohort must
+arrive before the fold runs, so at millions of clients the round
+clock is the slowest arrival. This package replaces the barrier with
+an ARRIVAL QUEUE and a buffered fold:
+
+* each sampled cohort is *issued* at the current fold step and every
+  client gets an arrival delay from an arrival process (default:
+  punctual, delay 0 — schedules from ``data/chaos.py`` are injected
+  only by tests/benches/scripts, per the arrival-confinement rule);
+* arrived updates accumulate in the buffer and the server folds up
+  to ``--async_buffer_size K`` of them per step, while the *next*
+  cohort's rows are already warming on the clientstore prefetch
+  lookahead (the driver, not the sampler, feeds the prefetcher —
+  it knows what is queued);
+* each folded update is weighted ``1/(1+staleness)^alpha``
+  (``--async_staleness_weight``) inside the jitted round, on both
+  the transmit and its datapoint count, so the fold stays a weighted
+  per-datapoint mean and stale mass never corrupts the server's
+  virtual momentum / error feedback.
+
+Sketch linearity (FetchSGD) is what makes the buffer safe: stale
+sketched updates merge by weighted addition, so the buffered fold is
+algebraically testable against the NumPy mirror. The degenerate
+configuration — ``K == cohort`` and ``alpha == 0`` under punctual
+arrival — reduces bit-exactly to the synchronous round, and async-off
+builds compile to an HLO-identical program (both pinned by tests).
+
+The compiled cohort width never changes: a fold with fewer than
+``num_workers`` arrivals pads dead slots (mask 0), reusing the
+dead-slot machinery the dropout traces already exercise.
+"""
+
+from commefficient_tpu.asyncfed.queue import ArrivalQueue
+from commefficient_tpu.asyncfed.driver import AsyncRoundDriver
+
+__all__ = ["ArrivalQueue", "AsyncRoundDriver"]

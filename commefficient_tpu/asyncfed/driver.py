@@ -1,0 +1,146 @@
+"""The async round driver: issue cohorts, fold what has arrived.
+
+Host-side bookkeeping only — nothing here is traced. Each trainer
+step the driver *issues* the sampled cohort (every slot gets an
+arrival delay from the attached arrival process; default punctual),
+then assembles the fold batch from up to ``K`` updates that have
+actually arrived. The fold batch keeps the compiled cohort width:
+arrived updates fill the leading slots, the rest are dead (mask 0),
+so the jitted round program is the same one the dropout traces
+already run. The per-slot staleness vector (fold step minus issue
+step) rides along for the staleness-weighted fold inside the round.
+
+Simulation model: a stale client's gradient is evaluated when its
+fold runs (the standard simulated-staleness benchmarking model —
+arrival timing, weighting and byte accounting are exact; the local
+compute is replayed at fold time).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from commefficient_tpu.asyncfed.queue import ArrivalQueue
+
+# delays(round_index, n) -> np.ndarray of per-slot arrival delays
+ArrivalProcess = Callable[[int, int], np.ndarray]
+
+
+class AsyncRoundDriver:
+    """Buffered-arrival front end for ``FedModel.__call__``."""
+
+    def __init__(self, cfg, stamp: Optional[Callable] = None):
+        self.k = int(cfg.async_buffer_size)
+        self.num_workers = int(cfg.num_workers)
+        assert 0 < self.k <= self.num_workers
+        self.queue = ArrivalQueue()
+        self._arrival: Optional[ArrivalProcess] = None
+        self._stamp = stamp  # (ids, issue_round) -> None
+        self._fold = 0
+        self.issued_total = 0
+        self.folded_total = 0
+        self.last_stats: Dict[str, float] = {}
+
+    def attach_arrival_process(self,
+                               fn: Optional[ArrivalProcess]) -> None:
+        """Inject a seeded arrival schedule (tests/benches/scripts
+        only — production keeps the punctual default)."""
+        self._arrival = fn
+
+    # -- the per-step protocol --------------------------------------
+
+    def step(self, batch: dict):
+        """Issue ``batch``'s cohort, then assemble this fold's batch
+        from up to K arrived updates. Returns
+        ``(fold_batch, staleness)`` with ``staleness`` float32
+        ``(num_workers,)`` (0 on dead pad slots)."""
+        now = self._fold
+        ids = np.asarray(batch["client_ids"])
+        W = ids.shape[0]
+        if self._arrival is not None:
+            delays = np.maximum(
+                np.asarray(self._arrival(now, W)), 0).astype(np.int64)
+        else:
+            delays = np.zeros((W,), np.int64)
+        if self._stamp is not None:
+            self._stamp(ids, now)
+        for i in range(W):
+            self.queue.push(now + int(delays[i]), {
+                "issue": now,
+                "slot": {k: np.asarray(v)[i] for k, v in
+                         batch.items()},
+            })
+        self.issued_total += W
+        arrived = self.queue.pop_arrived(now, self.k)
+        self.folded_total += len(arrived)
+        fold_batch = self._assemble(arrived, batch)
+        staleness = np.zeros((self.num_workers,), np.float32)
+        for i, e in enumerate(arrived):
+            staleness[i] = float(now - e["issue"])
+        self._note_stats(arrived, staleness)
+        self._fold = now + 1
+        return fold_batch, staleness
+
+    def _assemble(self, arrived: List[dict], template: dict) -> dict:
+        """Width-``num_workers`` host batch: arrived slots first,
+        then dead padding (mask 0, id 0 — the established dead-slot
+        shape, skipped by state writeback and byte accounting)."""
+        W = self.num_workers
+        out = {}
+        for key, v in template.items():
+            v = np.asarray(v)
+            rows = [np.asarray(e["slot"][key]) for e in arrived]
+            pad = W - len(rows)
+            if pad:
+                zero = np.zeros_like(v[0])
+                rows.extend([zero] * pad)
+            out[key] = np.stack(rows).astype(v.dtype)
+        if len(arrived) < W:
+            # belt + braces: padding must be dead regardless of the
+            # template's mask content
+            mask = out["mask"].copy()
+            mask[len(arrived):] = 0
+            out["mask"] = mask
+        return out
+
+    # -- prefetch lookahead -----------------------------------------
+
+    def peek_next_ids(self) -> Optional[np.ndarray]:
+        """The next fold's exact gather ids (fold-slot order, dead
+        slots padded with id 0) — the prefetch-lookahead feed. Only a
+        backlog already holding a full buffer is predictable: the
+        next issue cannot preempt entries that have already arrived
+        (they sort first by (arrive_at, seq)), so the prediction is
+        exact. An underfull backlog returns None and the caller falls
+        back to the sampler lookahead; a wrong fallback guess is just
+        a prefetch miss (synchronous gather)."""
+        nxt = self.queue.peek_arrived(self._fold, self.k)
+        if len(nxt) < self.k:
+            return None
+        ids = np.zeros((self.num_workers,), np.int64)
+        for i, e in enumerate(nxt):
+            ids[i] = int(e["slot"]["client_ids"])
+        return ids
+
+    # -- telemetry --------------------------------------------------
+
+    def _note_stats(self, arrived: List[dict],
+                    staleness: np.ndarray) -> None:
+        n = len(arrived)
+        s = staleness[:n] if n else np.zeros((0,), np.float32)
+        hist = np.bincount(s.astype(np.int64),
+                           minlength=1) if n else np.zeros(1, np.int64)
+        self.last_stats = {
+            "async_buffer_occupancy": n / float(self.k),
+            "async_backlog": float(len(self.queue)),
+            "async_staleness_mean": float(s.mean()) if n else 0.0,
+            "async_staleness_max": float(s.max()) if n else 0.0,
+            "async_staleness_hist": [int(c) for c in hist],
+        }
+
+    def round_stats(self) -> Dict[str, float]:
+        """The last fold's probes (merged into the ledger round
+        record and fed to the async_staleness alarm rule)."""
+        return dict(self.last_stats)

@@ -1,0 +1,49 @@
+"""Arrival queue: issued client updates ordered by arrival step.
+
+A min-heap on ``(arrive_at, issue_seq)``: pops come out in arrival
+order, and clients arriving at the same step come out in issue
+order. That tiebreak is what makes the degenerate case exact — with
+punctual arrival (all delays 0) and a buffer the size of the cohort,
+``pop_arrived`` returns precisely the issued batch, slot for slot.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, List, Optional
+
+
+class ArrivalQueue:
+    """FIFO-within-arrival-step priority queue of issued updates."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def push(self, arrive_at: int, entry: Any) -> None:
+        heapq.heappush(self._heap, (int(arrive_at), self._seq, entry))
+        self._seq += 1
+
+    def pop_arrived(self, now: int, limit: int) -> List[Any]:
+        """Up to ``limit`` entries with ``arrive_at <= now``, in
+        (arrival, issue) order. Entries still in flight stay queued —
+        their staleness grows until a later fold drains them."""
+        out: List[Any] = []
+        while self._heap and len(out) < limit \
+                and self._heap[0][0] <= now:
+            out.append(heapq.heappop(self._heap)[2])
+        return out
+
+    def peek_arrived(self, now: int,
+                     limit: Optional[int] = None) -> List[Any]:
+        """The entries ``pop_arrived(now, limit)`` would return,
+        without consuming them (the prefetch-lookahead feed)."""
+        out: List[Any] = []
+        for t, _, e in sorted(self._heap):
+            if t > now or (limit is not None and len(out) >= limit):
+                break
+            out.append(e)
+        return out

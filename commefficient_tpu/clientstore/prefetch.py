@@ -132,7 +132,8 @@ class StorePrefetcher:
         matching one is found; patches rows the store wrote after the
         job's version snapshot so the result is always current.
         """
-        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        self._check_failure()  # a dead worker surfaces even with an
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)  # empty backlog
         deadline = clock.tick() + timeout
         while self._pending > 0:
             self._check_failure()

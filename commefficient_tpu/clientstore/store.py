@@ -110,6 +110,12 @@ class HostClientStore:
         self._lock = threading.RLock()
         self._version = 0
         self._row_version: Dict[int, int] = {}
+        # asyncfed issue stamps: client id -> round index at which its
+        # participant snapshot was issued into the arrival queue.
+        # Bookkeeping only (no row data): lets tests/telemetry check a
+        # buffered fold consumed the snapshot version it was issued
+        # with, not a later write-back's.
+        self._issue_round: Dict[int, int] = {}
         self._closed = False
 
         self.stats = {
@@ -132,6 +138,21 @@ class HostClientStore:
     def row_version(self, cid):
         with self._lock:
             return self._row_version.get(int(cid), 0)
+
+    def stamp_rounds(self, ids, round_index):
+        """Version-stamp participant snapshots at issue time: the
+        asyncfed driver records which round issued each client into
+        the arrival queue (the snapshot the buffered fold will
+        replay)."""
+        r = int(round_index)
+        with self._lock:
+            for cid in np.asarray(ids).reshape(-1):
+                self._issue_round[int(cid)] = r
+
+    def stamped_round(self, cid):
+        """The round index that last issued ``cid`` (-1 = never)."""
+        with self._lock:
+            return self._issue_round.get(int(cid), -1)
 
     @property
     def version(self):

@@ -375,6 +375,24 @@ class Config:
     # history snapshots (ckpt_<tag>_r<round>.npz hardlinks) besides
     # the latest; 0 = latest only
     checkpoint_keep: int = 0
+    # buffered asynchronous rounds (asyncfed/): fold the arrival
+    # buffer every K arrived clients instead of barriering on the
+    # full cohort. 0 = synchronous barrier (the compiled round is
+    # bit-identical to async-off builds); K must be in
+    # [1, num_workers] — the compiled cohort width stays num_workers
+    # and a fold with fewer arrivals pads dead slots (mask 0).
+    async_buffer_size: int = 0
+    # staleness exponent alpha: an update folded s rounds after it
+    # was issued is weighted 1/(1+s)^alpha (transmit AND its
+    # datapoint count, so the fold stays a weighted per-datapoint
+    # mean and stale mass never corrupts virtual momentum/EF).
+    # alpha = 0 keeps weights exactly 1 and the buffered fold
+    # reduces bit-exactly to the synchronous round at K = cohort.
+    async_staleness_weight: float = 0.0
+    # async_staleness rule (telemetry/alarms.py): fire when the
+    # round's max folded staleness (rounds) exceeds this. 0 = off;
+    # shares the --on_divergence action.
+    alarm_async_staleness: float = 0.0
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -430,6 +448,16 @@ class Config:
             "--checkpoint_every_rounds must be >= 0 (0 = off)"
         assert self.checkpoint_keep >= 0, \
             "--checkpoint_keep must be >= 0"
+        assert self.async_buffer_size >= 0, \
+            "--async_buffer_size must be >= 0 (0 = synchronous)"
+        assert self.async_staleness_weight >= 0, \
+            "--async_staleness_weight must be >= 0"
+        assert self.alarm_async_staleness >= 0, \
+            "--alarm_async_staleness must be >= 0 (0 = rule off)"
+        if self.async_buffer_size > 0:
+            assert self.async_buffer_size <= self.num_workers, \
+                "--async_buffer_size must be <= --num_workers " \
+                "(the compiled cohort width is num_workers)"
         assert self.sketch_dtype in SKETCH_DTYPES, \
             "--sketch_dtype must be f32|bf16|int8|fp8"
         assert self.downlink_encoding in DOWNLINK_ENCODINGS, \
@@ -526,6 +554,18 @@ class Config:
                 assert self.num_workers % self.robust_median_groups \
                     == 0, "--robust_median_groups must divide " \
                     "--num_workers"
+        if self.async_buffer_size > 0:
+            # the buffered fold weights the round's per-client
+            # transmits by staleness; the chunked scan only ever
+            # holds a running sum, and the async driver *is* the
+            # round-overlap mechanism, so the pipelined dispatch
+            # queue stays at depth 1
+            assert self.client_chunk == 0, \
+                "--async_buffer_size needs the full per-client " \
+                "transmit stack; incompatible with --client_chunk"
+            assert self.pipeline_depth == 1, \
+                "--async_buffer_size overlaps rounds via the " \
+                "arrival buffer; incompatible with --pipeline_depth"
         return self
 
     @property
@@ -869,6 +909,22 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--checkpoint_keep", type=int, default=0,
                         help="history snapshots retained by the round "
                         "autosaver (0 = latest only)")
+    parser.add_argument("--async_buffer_size", type=int, default=0,
+                        help="fold the arrival buffer every K arrived "
+                        "clients instead of barriering on the cohort "
+                        "(0 = synchronous; K <= --num_workers)")
+    parser.add_argument("--async_staleness_weight", type=float,
+                        default=0.0,
+                        help="staleness exponent alpha: an update "
+                        "folded s rounds late is weighted "
+                        "1/(1+s)^alpha (0 = unweighted; at K = cohort "
+                        "it reduces bit-exactly to the sync round)")
+    parser.add_argument("--alarm_async_staleness", type=float,
+                        default=0.0,
+                        help="async_staleness rule: fire when the "
+                        "round's max folded staleness exceeds this "
+                        "many rounds (0 = off; action from "
+                        "--on_divergence)")
 
     return parser
 

@@ -91,7 +91,7 @@ def _group_means(flatT, n, alive, groups):
     return gsum / jnp.maximum(gn, 1.0)[:, None], galive
 
 
-def robust_fold(cfg, transmit, batch, probes=False):
+def robust_fold(cfg, transmit, batch, probes=False, weights=None):
     """Fold the per-client transmit stack robustly.
 
     transmit: (W, *transmit_shape) per-client transmits (already
@@ -102,11 +102,24 @@ def robust_fold(cfg, transmit, batch, probes=False):
     fold_rejection_rate (deviation of the robust aggregate from the
     plain mean, relative to the plain mean's norm; None when probes
     is False).
+
+    ``weights`` (asyncfed staleness weights, (W,) float > 0) scales
+    each client's transmit AND its datapoint count before any
+    statistic runs — algebraically the fold of w_i·transmit_i with
+    w_i·n_i datapoints, so the NumPy mirror verifies a weighted fold
+    by feeding the pre-scaled stack to the unweighted mirror.  The
+    per-datapoint scale the estimators share is unchanged
+    (w·T/(w·n) = T/n where n >= 1); the default None traces nothing
+    extra.
     """
     W = transmit.shape[0]
     flatT = transmit.reshape(W, -1).astype(jnp.float32)
     n = jnp.sum(batch["mask"], axis=tuple(range(1, batch["mask"].ndim)))
     n = n.astype(jnp.float32)
+    if weights is not None:
+        w = weights.astype(jnp.float32)
+        flatT = w[:, None] * flatT
+        n = w * n
     alive = n > 0
     total = jnp.maximum(jnp.sum(n), 1.0)
     plain = jnp.sum(flatT, axis=0) / total

@@ -34,7 +34,8 @@ from commefficient_tpu.core.client import (accumulate_and_compress,
                                            stale_weight_download)
 from commefficient_tpu.core.grad import make_eval_metrics, make_forward_grad
 from commefficient_tpu.core.server import (ServerState, ServerUpdate,
-                                           server_update)
+                                           server_update,
+                                           staleness_weights)
 from commefficient_tpu.ops.sketch import CountSketch
 
 
@@ -166,6 +167,10 @@ def round_plan(cfg: Config) -> dict:
         "pipeline_depth": int(getattr(cfg, "pipeline_depth", 1)),
         "client_chunk": int(getattr(cfg, "client_chunk", 0)),
         "clientstore": getattr(cfg, "clientstore", "device"),
+        "async_buffer_size": int(getattr(cfg, "async_buffer_size", 0)
+                                 or 0),
+        "async_staleness_weight": float(
+            getattr(cfg, "async_staleness_weight", 0.0) or 0.0),
     }
     plan["sketch_dtype"] = getattr(cfg, "sketch_dtype", "f32")
     plan["downlink_encoding"] = getattr(cfg, "downlink_encoding",
@@ -203,10 +208,25 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                        dense_rows: bool = False,
                        probes: bool = False,
                        probe_recovery: bool = False,
-                       transmit_transform: Callable = None) -> Callable:
+                       transmit_transform: Callable = None,
+                       client_weights: bool = False) -> Callable:
     """Returns jit-able
     ``client_round(ps_weights, client_states, batch, client_ids, rng,
     fedavg_lr) -> RoundResult``.
+
+    ``client_weights=True`` (the asyncfed buffered-arrival driver)
+    appends a seventh argument — ``staleness``, (W,) float32 rounds
+    each folded update waited in the arrival buffer — and compiles
+    the staleness-weighted fold into the round: each client's
+    transmit AND its datapoint count scale by
+    ``1/(1+staleness)^{--async_staleness_weight}`` before the fold
+    (core/server.staleness_weights), so the aggregate stays a
+    weighted per-datapoint mean and stale mass never corrupts the
+    server's virtual momentum/EF. At alpha == 0 the weighting branch
+    is skipped at trace time (weights are identically 1), which is
+    what makes the degenerate K == cohort configuration bit-exact
+    against the synchronous round; the default ``False`` traces
+    nothing and async-off builds stay HLO-identical.
 
     ``probes=True`` fills ``RoundResult.probes`` with the cheap O(d)
     diagnostics (aggregate norm/NaN/Inf, per-client transmit-norm
@@ -267,6 +287,16 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     if transmit_transform is not None:
         assert getattr(cfg, "client_chunk", 0) == 0, \
             "transmit_transform needs the full per-client transmit " \
+            "stack; incompatible with --client_chunk"
+    # Staleness-weighted fold (asyncfed): a trace-time gate like
+    # probes/robust. alpha == 0 means every weight is exactly 1, so
+    # the branch is skipped and a K == cohort buffered fold is
+    # bit-identical to the synchronous round.
+    alpha = float(getattr(cfg, "async_staleness_weight", 0.0))
+    weighted = client_weights and alpha != 0.0
+    if client_weights:
+        assert getattr(cfg, "client_chunk", 0) == 0, \
+            "client_weights needs the full per-client transmit " \
             "stack; incompatible with --client_chunk"
     # Fused-gradient fast path: when no per-client transform touches
     # the gradient (no local momentum/error, clip, DP, topk_down or
@@ -373,7 +403,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                                     scatter_dimension=1, tiled=True)
 
     def _fused_local(ps_weights, batch, total, n_shards,
-                     with_dense=False, emit=None):
+                     with_dense=False, emit=None, cw=None):
         """Fused backward over the clients in ``batch`` (all of them
         single-device; one device's shard under shard_map), already
         normalised by the GLOBAL datapoint total. The weight-decay
@@ -390,23 +420,34 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         flat concatenation first in that case: coordinate slicing
         needs the flat layout. ``with_dense`` (probe cadence rounds
         only) appends the dense flat gradient to the return — the
-        recovery-error probe's ground truth."""
+        recovery-error probe's ground truth.
+
+        ``cw`` (asyncfed, weighted builds only): this shard's (W,)
+        per-client staleness weights. Each client's loss term scales
+        by cw_i·n_i against the already-weighted global ``total``, so
+        the fused gradient equals Σ cw_i·t_i / Σ cw_i·n_i — exactly
+        the weighted per-client fold."""
 
         def make_local_loss(fn):
             def local_loss(p):
-                def one(b):
+                def one(b, cwi=None):
                     loss, metrics = fn(p, b)
                     n = jnp.sum(b["mask"])
                     # guard all-padding clients: their (meaningless)
                     # loss must not poison the weighted sum (cf. the
                     # non-fused path's masking in core/grad.py)
                     w = jnp.where(n > 0, loss * n, 0.0)
+                    if cwi is not None:
+                        w = w * cwi
                     mets = tuple((n > 0) * m
                                  for m in (loss,) + tuple(metrics))
                     return w, mets
 
-                weighted, metrics = jax.vmap(one)(batch)
-                return jnp.sum(weighted) / total, metrics
+                if cw is None:
+                    weighted_l, metrics = jax.vmap(one)(batch)
+                else:
+                    weighted_l, metrics = jax.vmap(one)(batch, cw)
+                return jnp.sum(weighted_l) / total, metrics
 
             return local_loss
 
@@ -418,7 +459,13 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         # client survives, exact zero on a fully-dropped round (the
         # per-client path's dead transmits are zeros — the fused path
         # must not keep decaying weights on a round nobody joined).
-        if getattr(cfg, "dropout_prob", 0.0) > 0:
+        if cw is not None:
+            # weighted build: the wd share is this shard's weighted
+            # alive-datapoint fraction, matching the per-client
+            # path's Σ cw_i·n_i·(wd/num_workers)·p / total exactly
+            n_per = jax.vmap(lambda b: jnp.sum(b["mask"]))(batch)
+            wd_frac = jnp.sum(cw * n_per) / total
+        elif getattr(cfg, "dropout_prob", 0.0) > 0:
             wd_frac = jnp.sum(batch["mask"]) / total
         else:
             wd_frac = None  # even split — today's exact constants
@@ -477,10 +524,16 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
 
     def client_round_fused(ps_weights, client_states: ClientStates,
                            batch, client_ids, rng,
-                           fedavg_lr=1.0) -> RoundResult:
+                           fedavg_lr=1.0, staleness=None) -> RoundResult:
         del rng, fedavg_lr
         W = client_ids.shape[0]
-        total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        if weighted:
+            cw = staleness_weights(staleness, alpha)
+            n_per = jax.vmap(lambda b: jnp.sum(b["mask"]))(batch)
+            total = jnp.maximum(jnp.sum(cw * n_per), 1.0)
+        else:
+            cw = None
+            total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
         from commefficient_tpu.parallel.mesh import (client_axis_size,
                                                      model_axis_size)
         ndev = mesh.devices.size if mesh is not None else 1
@@ -516,13 +569,14 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                         t, (CLIENT_AXIS,), C)
                 return wirex.wire_allreduce(q, scale, CLIENT_AXIS)
 
-            def block(p, local_batch, tot):
+            def block(p, local_batch, tot, *rest):
                 # mark the replicated params as device-varying before
                 # differentiating: otherwise shard_map's transpose
                 # rule auto-psums the DENSE per-device gradient to
                 # keep the cotangent replicated — a d-sized
                 # all-reduce that defeats the compressed-table
                 # traffic (and would double-count with ours)
+                cw_loc = rest[0] if rest else None
                 if hasattr(jax.lax, "pcast"):
                     p = jax.lax.pcast(p, CLIENT_AXIS, to="varying")
                 else:
@@ -535,11 +589,11 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                     # traffic is the price of the ground-truth probe
                     t, metrics, g = _fused_local(p, local_batch, tot,
                                                  C, with_dense=True,
-                                                 emit=emit)
+                                                 emit=emit, cw=cw_loc)
                     return (_client_psum(t),
                             jax.lax.psum(g, CLIENT_AXIS), metrics)
                 t, metrics = _fused_local(p, local_batch, tot, C,
-                                          emit=emit)
+                                          emit=emit, cw=cw_loc)
                 # the round's ONE all-reduce (reference
                 # fed_worker.py:139-140 NCCL reduce): sketch tables in
                 # sketch mode — inter-chip traffic stays compressed,
@@ -549,29 +603,34 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
 
             agg_spec = (table_shard_spec() if shard2d
                         else replicated_spec())
+            # weighted builds shard the staleness weights along the
+            # client axis next to the batch
+            wex = (cw,) if cw is not None else ()
+            wspec = (client_spec(),) if cw is not None else ()
             if want_dense:
                 aggregated, dense_g, metrics = shard_map(
                     block, mesh=mesh,
                     in_specs=(replicated_spec(), client_spec(),
-                              replicated_spec()),
+                              replicated_spec()) + wspec,
                     out_specs=(agg_spec, replicated_spec(),
                                client_spec()))(ps_weights, batch,
-                                               total)
+                                               total, *wex)
             else:
                 aggregated, metrics = shard_map(
                     block, mesh=mesh,
                     in_specs=(replicated_spec(), client_spec(),
-                              replicated_spec()),
+                              replicated_spec()) + wspec,
                     out_specs=(agg_spec, client_spec()))(ps_weights,
-                                                         batch, total)
+                                                         batch, total,
+                                                         *wex)
         elif want_dense:
             aggregated, metrics, dense_g = _fused_local(
-                ps_weights, batch, total, 1, with_dense=True)
+                ps_weights, batch, total, 1, with_dense=True, cw=cw)
             if quantized:
                 aggregated = _qdq_local(aggregated)
         else:
             aggregated, metrics = _fused_local(ps_weights, batch,
-                                               total, 1)
+                                               total, 1, cw=cw)
             if quantized:
                 # single-shard wire crossing: quantize-dequantize the
                 # aggregated table at full range (exactly the NumPy
@@ -588,7 +647,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                            probes=pr)
 
     def client_round(ps_weights, client_states: ClientStates, batch,
-                     client_ids, rng, fedavg_lr=1.0) -> RoundResult:
+                     client_ids, rng, fedavg_lr=1.0,
+                     staleness=None) -> RoundResult:
         W = client_ids.shape[0]
         real_ids = client_ids  # pre-sentinel ids for the chaos hook
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(client_ids)
@@ -645,19 +705,35 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
 
         # Σ_clients transmit, ÷ total datapoints — one all-reduce
         # (reference fed_worker.py:131-140 + fed_aggregator.py:328-334)
-        total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        # Weighted (asyncfed) builds fold cw_i·transmit_i over
+        # Σ cw_i·n_i instead: a weighted per-datapoint mean. The
+        # probes below keep reading the UNWEIGHTED per-client
+        # transmits — they report what clients sent, not how the
+        # fold discounted it.
+        if weighted:
+            cw = staleness_weights(staleness, alpha)
+            n_per = jnp.sum(batch["mask"],
+                            axis=tuple(range(1, batch["mask"].ndim)))
+            total = jnp.maximum(jnp.sum(cw * n_per), 1.0)
+            t_fold = transmit * cw.reshape(
+                (W,) + (1,) * (transmit.ndim - 1))
+        else:
+            cw = None
+            total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+            t_fold = transmit
         fold_pr = None
         if robust:
             from commefficient_tpu.core.robust import robust_fold
             aggregated, fold_pr = robust_fold(cfg, transmit, batch,
-                                              probes=probes)
+                                              probes=probes,
+                                              weights=cw)
         elif sketch_late:
             aggregated = _sketch_after_local_sum(
-                sketch, transmit, mesh,
+                sketch, t_fold, mesh,
                 emit=_partial_table_emit if shard2d_late else None,
                 wire=wire) / total
         else:
-            aggregated = jnp.sum(transmit, axis=0) / total
+            aggregated = jnp.sum(t_fold, axis=0) / total
 
         pr = None
         if probes:
@@ -671,7 +747,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 # clipped per-client-sketch path (max_grad_norm set)
                 # has no dense gradient to compare against and omits
                 # the key
-                dense_g = jnp.sum(transmit, axis=0) / total
+                dense_g = jnp.sum(t_fold, axis=0) / total
                 pr["recovery_error"] = sketch.recovery_error(
                     aggregated, dense_g, cfg.k)
         states = ClientStates(

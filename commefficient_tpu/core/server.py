@@ -73,6 +73,18 @@ class ServerUpdate(NamedTuple):
     probes: Optional[dict] = None
 
 
+def staleness_weights(staleness, alpha: float):
+    """FedBuff-style staleness discount ``1/(1+s)^alpha`` for the
+    buffered asynchronous fold (asyncfed/). ``alpha`` is a trace-time
+    constant — the round builder skips the weighting branch entirely
+    at alpha == 0, which is what makes the degenerate-sync
+    configuration bit-exact. Applied to a client's transmit AND its
+    datapoint count, so the fold stays a weighted per-datapoint mean
+    and the server's virtual momentum / error feedback never absorbs
+    unnormalised stale mass."""
+    return (1.0 + staleness.astype(jnp.float32)) ** jnp.float32(-alpha)
+
+
 def _use_threshold_select(cfg: Config) -> bool:
     """Exact dense-mode selections (true_topk) at large d go through
     the threshold-select mask instead of the lax.top_k sort — same

@@ -179,6 +179,21 @@ class FedModel:
                 args, num_clients, flat,
                 sharding=client_sharding(self.mesh))
 
+        # --async_buffer_size K: buffered-arrival front end
+        # (commefficient_tpu/asyncfed). The driver issues each sampled
+        # cohort into an arrival queue and hands back a fold batch of
+        # up to K arrived updates (dead-padded to the compiled cohort
+        # width) plus the per-slot staleness vector the weighted fold
+        # consumes. Host store participants get issue-round stamps so
+        # the snapshot a buffered fold replays is auditable.
+        self.async_k = int(getattr(args, "async_buffer_size", 0) or 0)
+        self._async_driver = None
+        if self.async_k > 0:
+            from commefficient_tpu.asyncfed import AsyncRoundDriver
+            stamp = (self.client_store.stamp_rounds
+                     if self.client_store is not None else None)
+            self._async_driver = AsyncRoundDriver(args, stamp=stamp)
+
         if padded_batch_size is None:
             padded_batch_size = (args.local_batch_size
                                  if args.local_batch_size > 0 else 1)
@@ -222,7 +237,8 @@ class FedModel:
                     unravel=self.unravel,
                     dense_rows=(self.clientstore == "host"),
                     probes=with_probes,
-                    probe_recovery=with_recovery),
+                    probe_recovery=with_recovery,
+                    client_weights=(self.async_k > 0)),
                 donate_argnums=(1,))
 
         self._client_round = _build_round(probes_on, False)
@@ -366,6 +382,15 @@ class FedModel:
         thread so round N+1's gather/H2D overlaps round N's compute."""
         self._participant_feed = feed
 
+    def attach_arrival_process(self, fn):
+        """Inject a seeded arrival schedule into the async driver
+        (tests/benches/scripts only — the arrival-confinement lint
+        rule keeps injection out of package modules, so production
+        keeps the punctual default). Requires --async_buffer_size."""
+        assert self._async_driver is not None, \
+            "attach_arrival_process needs --async_buffer_size > 0"
+        self._async_driver.attach_arrival_process(fn)
+
     def _gather_rows(self, ids_np):
         """Host-side rows for this round's participants, prefetched
         when the lookahead predicted them, synchronous otherwise."""
@@ -397,9 +422,17 @@ class FedModel:
                             put("weights"))
 
     def _submit_prefetch(self):
-        if self._prefetcher is None or self._participant_feed is None:
+        if self._prefetcher is None:
             return
-        ids = self._participant_feed()
+        # buffered arrival: the driver beats the sampler — when the
+        # backlog already holds the next fold's full buffer, its ids
+        # (in fold-slot order, dead-padded) are known exactly. The
+        # sampler lookahead covers the punctual/underfull case; a
+        # wrong guess is just a prefetch miss (synchronous fallback).
+        ids = (self._async_driver.peek_next_ids()
+               if self._async_driver is not None else None)
+        if ids is None and self._participant_feed is not None:
+            ids = self._participant_feed()
         if ids is not None:
             self._prefetcher.submit(np.asarray(ids, np.int64))
 
@@ -513,6 +546,13 @@ class FedModel:
         step_t0 = (clock.tick()
                    if eng is not None and eng.step_time_ratio > 0
                    and self.pipeline_depth <= 1 else None)
+        staleness = None
+        if self._async_driver is not None:
+            # issue the sampled cohort into the arrival queue, then
+            # fold what has actually arrived: the batch the round runs
+            # is the buffer's head, dead-padded to the cohort width
+            with tel.span("async_fold"):
+                batch, staleness = self._async_driver.step(batch)
         ids_np = np.asarray(batch["client_ids"])
         dev_batch = {k: v for k, v in batch.items()
                      if k != "client_ids"}
@@ -535,17 +575,22 @@ class FedModel:
         if (self._client_round_probed is not None
                 and ridx % self.probe_period == 0):
             round_fn = self._client_round_probed
+        # staleness rides as a seventh positional arg only when the
+        # async driver is on — the synchronous call site stays
+        # byte-identical (and so does its compiled program)
+        sargs = (() if staleness is None
+                 else (shard_batch(self.mesh, jnp.asarray(staleness)),))
         if (self._cost_model is None and tel.enabled
                 and getattr(args, "do_profile", False)):
             # roofline expectation from this round's lowered program —
             # once per run, text-only (no second compile)
             self._emit_cost_model(
                 round_fn, (self.ps_weights, cs_in, dev_batch, ids,
-                           rng, jnp.float32(self.fedavg_lr)))
+                           rng, jnp.float32(self.fedavg_lr)) + sargs)
         with tel.span("round_dispatch"), trace.phase("round_dispatch"):
             res = round_fn(self.ps_weights, cs_in,
                            dev_batch, ids, rng,
-                           jnp.float32(self.fedavg_lr))
+                           jnp.float32(self.fedavg_lr), *sargs)
         self.client_states = res.client_states
         self.pending_aggregated = res.aggregated
         # dead slots (dropout / loader padding) must carry the
@@ -601,12 +646,36 @@ class FedModel:
             # alarms via _finish_probes
             tel.merge_round_probes(ridx, probe_vals)
             self._probe_host[ridx] = probe_vals
+        if self._async_driver is not None:
+            # buffered-arrival probes (staleness histogram, buffer
+            # occupancy, backlog) are host-side driver state: merged
+            # onto the ledger record every round, and routed to the
+            # alarm engine through the round's probe dict when probes
+            # are compiled in (so _finish_probes checks once) or
+            # directly when they are not
+            astats = self._async_driver.round_stats()
+            tel.merge_round_probes(ridx, astats)
+            if probe_vals is not None:
+                self._probe_host[ridx].update(astats)
+            elif self.alarm_engine is not None:
+                self.alarm_engine.check(ridx, astats)
         if step_t0 is not None:
             # wall step time through the metrics sync — evaluated
             # before set_round_bytes so an aborting alarm still lands
             # on the record telemetry.close() will flush
             eng.check_step_time(ridx, clock.tick() - step_t0)
-        down, up = self._account_bytes(ids_np, batch["mask"])
+        acct_ids, acct_mask = ids_np, batch["mask"]
+        if self._async_driver is not None:
+            # dead pad slots (id 0, mask 0) are queue padding, not
+            # participants — they must not bill client 0 a download.
+            # Folded ids route through the regular accounting, so a
+            # stale client's downlink is priced by how far
+            # client_last_seen lags (incl. delta have_prev freshness).
+            alive = np.asarray(acct_mask).reshape(
+                len(ids_np), -1).sum(axis=1) > 0
+            acct_ids = ids_np[alive]
+            acct_mask = np.asarray(acct_mask)[alive]
+        down, up = self._account_bytes(acct_ids, acct_mask)
         tel.set_round_bytes(ridx, float(down.sum()), float(up.sum()))
         return metrics + [down, up]
 

@@ -43,6 +43,13 @@ round instead of silently training on garbage. Three rules:
                        rejection means the fold is actively fighting
                        someone; sustained high rejection on honest
                        data means the trim/clip is set too tight.
+``async_staleness``  — buffered-arrival health (``--async_buffer_size``
+                       runs): the round folded an update staler than
+                       ``--alarm_async_staleness`` rounds. A growing
+                       max staleness means the arrival process is
+                       outrunning the fold cadence (the buffer drains
+                       older and older mass) — the serving analogue
+                       of the residual-growth rule.
 ``collective_skew``  — trace-derived (schema-v4 ``device_time``): a
                        profiled round's straggler wait dominates its
                        collective bucket — max cross-device
@@ -116,6 +123,8 @@ class AlarmEngine:
             getattr(cfg, "alarm_byzantine_ratio", 0.0) or 0.0)
         self.fold_rejection = float(
             getattr(cfg, "alarm_fold_rejection", 0.0) or 0.0)
+        self.async_staleness = float(
+            getattr(cfg, "alarm_async_staleness", 0.0) or 0.0)
         self.telemetry = telemetry
         self._consecutive = 0
         self._step_times = deque(maxlen=self.step_time_window)
@@ -177,6 +186,18 @@ class AlarmEngine:
                 fired.append({"rule": "fold_rejection_rate",
                               "value": float(frr),
                               "threshold": self.fold_rejection})
+
+        if self.async_staleness > 0:
+            smax = probes.get("async_staleness_max")
+            if smax is not None and (not _finite(smax)
+                                     or smax > self.async_staleness):
+                fired.append({
+                    "rule": "async_staleness",
+                    "value": float(smax),
+                    "threshold": self.async_staleness,
+                    "buffer_occupancy": probes.get(
+                        "async_buffer_occupancy"),
+                    "backlog": probes.get("async_backlog")})
 
         return self._escalate(round_index, fired)
 
@@ -259,6 +280,8 @@ def build_alarm_engine(cfg, telemetry=None):
             or float(getattr(cfg, "alarm_byzantine_ratio", 0.0)
                      or 0.0) > 0
             or float(getattr(cfg, "alarm_fold_rejection", 0.0)
+                     or 0.0) > 0
+            or float(getattr(cfg, "alarm_async_staleness", 0.0)
                      or 0.0) > 0):
         return AlarmEngine(cfg, telemetry)
     return None

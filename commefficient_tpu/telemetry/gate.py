@@ -170,27 +170,43 @@ def wire_suffix(wire_dtype) -> str:
     return f"q{wire_dtype}"
 
 
+def async_suffix(async_k) -> str:
+    """Canonical key fragment for a buffered-arrival run:
+    ``a<K>`` when ``--async_buffer_size K`` was on, ``""`` for the
+    synchronous barrier every pre-async pin measured. A buffered
+    round overlaps the next cohort's arrivals with the fold, so its
+    wall profile is a different experiment from the synchronous run
+    of the same config — an async ledger must never resolve (or
+    overwrite) a synchronous pin."""
+    k = int(async_k or 0)
+    return f"a{k}" if k > 0 else ""
+
+
 def topology_key(device_count=None, process_count=None,
-                 mesh_shape=None, wire_dtype=None) -> str:
+                 mesh_shape=None, wire_dtype=None,
+                 async_k=None) -> str:
     """Baseline entry key for one topology point. ``d<D>p<P>`` when
     both counts are known — suffixed ``m<C>x<M>`` for 2D-mesh runs
     (a 4x2 and an 8x1 run on the same 8 chips are different programs,
-    not one noise band) and ``q<dtype>`` for quantized-wire runs
-    (int8 vs f32 collectives are different experiments) —
-    :data:`ANY_TOPOLOGY` otherwise: unknown topologies form their own
-    bucket rather than silently matching a counted one. Quantized
-    runs with unknown counts still split off (``any-q<dtype>``)."""
+    not one noise band), ``q<dtype>`` for quantized-wire runs
+    (int8 vs f32 collectives are different experiments) and ``a<K>``
+    for buffered-arrival runs (an async fold overlaps work a barrier
+    round waits for) — :data:`ANY_TOPOLOGY` otherwise: unknown
+    topologies form their own bucket rather than silently matching a
+    counted one. Quantized/async runs with unknown counts still
+    split off (``any-q<dtype>``, ``any-a<K>``)."""
     if device_count is None or process_count is None:
-        w = wire_suffix(wire_dtype)
+        w = wire_suffix(wire_dtype) + async_suffix(async_k)
         return f"{ANY_TOPOLOGY}-{w}" if w else ANY_TOPOLOGY
     return (f"d{int(device_count)}p{int(process_count)}"
-            f"{mesh_suffix(mesh_shape)}{wire_suffix(wire_dtype)}")
+            f"{mesh_suffix(mesh_shape)}{wire_suffix(wire_dtype)}"
+            f"{async_suffix(async_k)}")
 
 
 def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                         device_count=None, process_count=None,
                         config_hash: str = "", mesh_shape=None,
-                        wire_dtype=None) -> Dict:
+                        wire_dtype=None, async_k=None) -> Dict:
     entry = {"ts": clock.wall(), "source": source, "metrics": metrics}
     if device_count is not None:
         entry["device_count"] = int(device_count)
@@ -204,21 +220,25 @@ def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                                else list(mesh_shape))
     if wire_suffix(wire_dtype):
         entry["wire_dtype"] = str(wire_dtype)
+    if async_suffix(async_k):
+        entry["async_buffer_size"] = int(async_k)
     return entry
 
 
 def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
                   extra: Dict = None, device_count=None,
                   process_count=None, config_hash: str = "",
-                  mesh_shape=None, wire_dtype=None) -> Dict:
+                  mesh_shape=None, wire_dtype=None,
+                  async_k=None) -> Dict:
     """A fresh schema-2 baseline holding one topology entry."""
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype)
+                       wire_dtype, async_k)
     base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
             "topologies": {key: make_topology_entry(
                 metrics, source=source, device_count=device_count,
                 process_count=process_count, config_hash=config_hash,
-                mesh_shape=mesh_shape, wire_dtype=wire_dtype)}}
+                mesh_shape=mesh_shape, wire_dtype=wire_dtype,
+                async_k=async_k)}}
     if extra:
         base.update(extra)
     return base
@@ -241,7 +261,8 @@ def migrate_baseline(baseline: Dict) -> Dict:
 def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
                     source: str = "", device_count=None,
                     process_count=None, config_hash: str = "",
-                    mesh_shape=None, wire_dtype=None) -> Dict:
+                    mesh_shape=None, wire_dtype=None,
+                    async_k=None) -> Dict:
     """Insert/replace ONE topology's entry, leaving every other
     topology point untouched — how the gate CLI re-captures the
     8-device headline without disturbing the single-chip one.
@@ -251,29 +272,33 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
          "topologies": {}}
     base["topologies"] = dict(base.get("topologies", {}))
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype)
+                       wire_dtype, async_k)
     base["topologies"][key] = make_topology_entry(
         metrics, source=source, device_count=device_count,
         process_count=process_count, config_hash=config_hash,
-        mesh_shape=mesh_shape, wire_dtype=wire_dtype)
+        mesh_shape=mesh_shape, wire_dtype=wire_dtype,
+        async_k=async_k)
     base["ts"] = clock.wall()
     return base
 
 
 def baseline_entry(baseline: Dict, device_count=None,
                    process_count=None, mesh_shape=None,
-                   wire_dtype=None):
+                   wire_dtype=None, async_k=None):
     """The topology entry ``compare`` gates against, or None when the
     baseline has no entry for this topology. A 2D-mesh run resolves
     its exact ``d<D>p<P>m<C>x<M>`` entry first and falls back to the
     mesh-blind ``d<D>p<P>`` pin (pins captured before mesh keying
     existed keep gating until re-captured — migration, not a hole).
-    Quantized-wire runs get NO such fallback: an int8 run must never
-    resolve an f32 pin — the dtype changes the collective bytes ~4x,
-    so cross-dtype comparison is a category error, not noise. An
-    ungated quantized topology stays None (compare raises loudly).
-    Schema-1 baselines resolve for ANY topology (their historical,
-    topology-blind behaviour — re-capture to get keyed guarding)."""
+    Quantized-wire and buffered-arrival runs get NO such fallback: an
+    int8 run must never resolve an f32 pin (the dtype changes the
+    collective bytes ~4x) and an async run must never resolve a
+    synchronous pin (the buffered fold overlaps waits the barrier
+    round eats) — cross-mode comparison is a category error, not
+    noise. An ungated quantized/async topology stays None (compare
+    raises loudly). Schema-1 baselines resolve for ANY topology
+    (their historical, topology-blind behaviour — re-capture to get
+    keyed guarding)."""
     schema = baseline.get("schema")
     if schema not in READABLE_BASELINE_SCHEMAS:
         raise ValueError(
@@ -285,12 +310,13 @@ def baseline_entry(baseline: Dict, device_count=None,
     topologies = baseline.get("topologies", {})
     entry = topologies.get(
         topology_key(device_count, process_count, mesh_shape,
-                     wire_dtype))
+                     wire_dtype, async_k))
     if entry is None and mesh_suffix(mesh_shape):
-        # drop only the mesh fragment; the wire fragment stays
+        # drop only the mesh fragment; the wire AND async fragments
+        # stay — there is no cross-dtype or cross-mode fallback
         entry = topologies.get(
             topology_key(device_count, process_count,
-                         wire_dtype=wire_dtype))
+                         wire_dtype=wire_dtype, async_k=async_k))
     return entry
 
 
@@ -303,7 +329,7 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
             rel_tol: float = REL_TOL,
             mad_k: float = MAD_K, device_count=None,
             process_count=None, mesh_shape=None,
-            wire_dtype=None) -> Dict:
+            wire_dtype=None, async_k=None) -> Dict:
     """Gate ``metrics`` against ``baseline``'s entry for this
     topology. Returns::
 
@@ -317,9 +343,9 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
     when the baseline has no entry for this topology — an ungated
     topology point must fail loudly, not pass silently."""
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype)
+                       wire_dtype, async_k)
     entry = baseline_entry(baseline, device_count, process_count,
-                           mesh_shape, wire_dtype)
+                           mesh_shape, wire_dtype, async_k)
     if entry is None:
         have = ", ".join(sorted(baseline.get("topologies", {}))) \
             or "none"

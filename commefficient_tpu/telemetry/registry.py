@@ -111,21 +111,35 @@ def run_wire_dtype(manifest: dict):
     return cfg.get("sketch_dtype") or None
 
 
+def run_async_k(manifest: dict):
+    """The run's buffered-arrival buffer size
+    (``--async_buffer_size``) from its recorded config, or None for
+    synchronous / pre-async manifests — they all ran the barrier
+    round."""
+    cfg = manifest.get("config") or {}
+    k = int(cfg.get("async_buffer_size") or 0)
+    return k if k > 0 else None
+
+
 def run_key(manifest: dict) -> tuple:
     """(config_hash, device_count, process_count): two runs are
     comparable — diffable by the report, gateable against one
     baseline entry — only when ALL three match. Config hash alone is
     not an identity: the same config on 1 vs 8 devices is a scaling
     experiment, not a regression. 2D-mesh runs append their
-    ``m<C>x<M>`` fragment and quantized-wire runs their ``q<dtype>``
-    fragment (a 4x2 and an 8x1 program on the same chips — or an int8
-    and an f32 wire — are different experiments); 1-D f32 runs keep
-    the historical 3-tuple, so old manifests stay comparable to each
-    other."""
-    from commefficient_tpu.telemetry.gate import mesh_suffix, wire_suffix
+    ``m<C>x<M>`` fragment, quantized-wire runs their ``q<dtype>``
+    fragment and buffered-arrival runs their ``a<K>`` fragment (a 4x2
+    and an 8x1 program on the same chips — or an int8 and an f32
+    wire, or a buffered and a barrier round — are different
+    experiments); 1-D f32 synchronous runs keep the historical
+    3-tuple, so old manifests stay comparable to each other."""
+    from commefficient_tpu.telemetry.gate import (async_suffix,
+                                                  mesh_suffix,
+                                                  wire_suffix)
     key = (manifest.get("config_hash") or "",) + run_topology(manifest)
     suffix = (mesh_suffix(run_mesh_shape(manifest))
-              + wire_suffix(run_wire_dtype(manifest)))
+              + wire_suffix(run_wire_dtype(manifest))
+              + async_suffix(run_async_k(manifest)))
     return key + (suffix,) if suffix else key
 
 

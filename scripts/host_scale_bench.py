@@ -21,13 +21,21 @@ cumsum sizes, fd behavior) and times:
 - arrival: round throughput under a data/chaos.py seeded arrival
   process (correlated dropout bursts + straggler stalls) vs the calm
   loader — the host-side cost of ragged rounds, with the replayed
-  schedule's burst/alive statistics.
+  schedule's burst/alive statistics (ArrivalSchedule.replay_stats).
+- async: buffered asynchronous serving (asyncfed) vs the synchronous
+  barrier, both replaying the same churny ArrivalSchedule at
+  --store_scale_clients host-resident clients — host-gap share
+  (wall minus device-dispatch span, as a fraction of wall) for each
+  leg, plus the buffered leg's staleness statistics. With --ledger
+  the buffered leg writes the telemetry ledger and a runs/ manifest,
+  so scripts/perf_gate.py gates it under its a<K> topology key.
 
 Usage:  python scripts/host_scale_bench.py [--persona_clients 17568]
         [--emnist_writers 3500] [--emnist_images 20] [--workdir DIR]
-        [--only all|persona|emnist|clientstore|arrival]
+        [--only all|persona|emnist|clientstore|arrival|async]
         [--store_scale_clients 1000000] [--store_budget_mb 4]
         [--arrival_rounds 40] [--arrival_burst_start 0.2]
+        [--async_k 4] [--async_alpha 0.5] [--ledger runs/async.jsonl]
 
 Results are recorded in BENCHMARKS.md ("Host data-plane at natural
 scale" and "Host client store").
@@ -271,7 +279,8 @@ def bench_arrival(num_clients, n_rounds, seed, burst_start,
     import numpy as np
 
     from commefficient_tpu.config import Config
-    from commefficient_tpu.data.chaos import (ChaosConfig,
+    from commefficient_tpu.data.chaos import (ArrivalSchedule,
+                                              ChaosConfig,
                                               ChaosInjector)
     from commefficient_tpu.runtime.fed_model import (FedModel,
                                                      FedOptimizer)
@@ -329,20 +338,10 @@ def bench_arrival(num_clients, n_rounds, seed, burst_start,
                             straggler_delay_s=straggler_delay_s)
     chaos_s, alive = run(ChaosInjector(chaos_cfg, num_clients))
 
-    # arrival statistics of the replayed schedule
-    ragged = [a for a in alive if a < 1.0]
-    burst_rounds, bursts, in_burst = 0, 0, False
-    longest, cur = 0, 0
-    for a in alive:
-        if a < 1.0:
-            burst_rounds += 1
-            cur += 1
-            if not in_burst:
-                bursts += 1
-            in_burst = True
-            longest = max(longest, cur)
-        else:
-            in_burst, cur = False, 0
+    # arrival statistics of the replayed schedule — the shared
+    # data/chaos.py summary (golden-trace-pinned), not a bench-local
+    # reimplementation
+    stats = ArrivalSchedule.replay_stats(alive, W)
     return {
         "arrival_rounds": len(alive),
         "arrival_seed": seed,
@@ -350,16 +349,147 @@ def bench_arrival(num_clients, n_rounds, seed, burst_start,
         "arrival_chaos_round_ms": round(chaos_s * 1e3, 2),
         "arrival_overhead_pct": round(
             (chaos_s / calm_s - 1.0) * 100, 1),
-        "arrival_burst_count": bursts,
-        "arrival_burst_rounds": burst_rounds,
-        "arrival_longest_burst": longest,
-        "arrival_alive_frac_min": round(min(alive), 3) if alive
-        else 1.0,
-        "arrival_alive_frac_mean": round(
-            sum(alive) / max(len(alive), 1), 3),
-        "arrival_dropped_client_rounds": round(
-            sum(1.0 - a for a in ragged) * W),
+        "arrival_burst_count": stats["burst_count"],
+        "arrival_burst_rounds": stats["burst_rounds"],
+        "arrival_longest_burst": stats["longest_burst"],
+        "arrival_alive_frac_min": stats["alive_frac_min"],
+        "arrival_alive_frac_mean": stats["alive_frac_mean"],
+        "arrival_dropped_client_rounds":
+            stats["dropped_client_rounds"],
     }
+
+
+def bench_async(num_clients, n_rounds, k, alpha, seed, wait_unit_s,
+                budget_bytes, max_delay, churn_frac, dim=64,
+                ledger=""):
+    """Buffered-async serving vs the synchronous barrier at the
+    host-resident scale axis.
+
+    Both legs replay the SAME churny ``ArrivalSchedule`` (one seed)
+    over local_topk rounds through the host client store at
+    ``num_clients`` (>= 1M by default) simulated clients. The
+    synchronous leg completes a round only when its slowest client
+    lands — the schedule's per-cohort max delay is paid as a real
+    barrier wait of ``wait_unit_s`` per fold-step unit. The buffered
+    leg (``--async_buffer_size k``) folds as soon as ``k`` arrivals
+    are buffered; stale arrivals fold late with
+    ``1/(1+staleness)^alpha`` weights instead of stalling the server,
+    so in the primed steady state it pays dispatch only.
+
+    ``host_gap_share`` is computed identically for both legs:
+    (round-loop wall - device-dispatch span) / wall — the fraction of
+    serving wall-clock the host spends NOT driving the device. The
+    delta is the headline: the barrier's straggler stalls are host
+    gap; the buffer absorbs them. Only the buffered leg writes the
+    telemetry ledger (``--ledger``), so its meta/round records are
+    the ones the ``a<K>``-keyed perf gate sees."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.data.chaos import ArrivalSchedule
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+
+    W, B = 8, 2
+    assert 0 < k <= W, "async_k must be in (0, num_workers]"
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    def make_schedule():
+        return ArrivalSchedule("churny", seed=seed,
+                               max_delay=max_delay,
+                               churn_frac=churn_frac)
+
+    def run(async_k):
+        cfg = Config(mode="local_topk", error_type="local",
+                     local_momentum=0.9, virtual_momentum=0.0, k=8,
+                     num_workers=W, local_batch_size=B,
+                     num_clients=num_clients, seed=seed,
+                     clientstore="host",
+                     clientstore_bytes=budget_bytes,
+                     async_buffer_size=async_k,
+                     async_staleness_weight=alpha if async_k else 0.0,
+                     ledger=ledger if async_k else "")
+        model = FedModel(None, {"w": jnp.zeros((dim,), jnp.float32)},
+                         loss, cfg, padded_batch_size=B)
+        opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+        sched = make_schedule()
+        if async_k:
+            model.attach_arrival_process(sched)
+        rng = np.random.RandomState(seed)
+        ids_all = [rng.choice(num_clients, W, replace=False)
+                   .astype(np.int32) for _ in range(n_rounds + 1)]
+        model.attach_participant_feed(
+            lambda: ids_all[model.round_index + 1]
+            if model.round_index + 1 < len(ids_all) else None)
+
+        def make_batch(r):
+            return {"client_ids": ids_all[r],
+                    "x": jnp.asarray(rng.randn(W, B, dim),
+                                     jnp.float32),
+                    "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+                    "mask": jnp.ones((W, B), jnp.float32)}
+
+        model(make_batch(0))  # warmup: jit compile + first H2D
+        opt.step()
+        jax.block_until_ready(model.ps_weights)
+        dispatch = 0.0
+        t0 = time.time()
+        for r in range(1, n_rounds + 1):
+            batch = make_batch(r)
+            if not async_k:
+                # barrier semantics: the round closes when its
+                # slowest client lands — replay the same schedule as
+                # a real wait (fold-step units -> wait_unit_s)
+                stall = int(sched.delays(W).max())
+                if stall:
+                    time.sleep(stall * wait_unit_s)
+            td = time.time()
+            model(batch)
+            opt.step()
+            jax.block_until_ready(model.ps_weights)
+            dispatch += time.time() - td
+        wall = time.time() - t0
+        astats = (dict(model._async_driver.round_stats())
+                  if async_k else {})
+        store_stats = (dict(model.client_store.stats)
+                       if model.client_store is not None else {})
+        model.finalize()
+        gap = max(wall - dispatch, 0.0) / max(wall, 1e-9)
+        return wall / n_rounds, gap, astats, store_stats, cfg
+
+    sync_s, sync_gap, _, _, _ = run(0)
+    buf_s, buf_gap, astats, store_stats, acfg = run(k)
+
+    out = {
+        "async_clients": int(num_clients),
+        "async_rounds": int(n_rounds),
+        "async_buffer_k": int(k),
+        "async_staleness_alpha": float(alpha),
+        "async_seed": int(seed),
+        "async_wait_unit_ms": round(wait_unit_s * 1e3, 2),
+        "async_sync_round_ms": round(sync_s * 1e3, 2),
+        "async_buffered_round_ms": round(buf_s * 1e3, 2),
+        "async_speedup_x": round(sync_s / max(buf_s, 1e-9), 2),
+        "async_sync_host_gap_share": round(sync_gap, 4),
+        "async_buffered_host_gap_share": round(buf_gap, 4),
+        "async_host_gap_reduction": round(sync_gap - buf_gap, 4),
+        "async_staleness_mean": astats.get("async_staleness_mean"),
+        "async_staleness_max": astats.get("async_staleness_max"),
+        "async_backlog_final": astats.get("async_backlog"),
+        "async_buffer_occupancy":
+            astats.get("async_buffer_occupancy"),
+    }
+    if store_stats:
+        out["clients_resident_max_async"] = int(
+            store_stats.get("resident_rows_max", 0))
+    return out, acfg
 
 
 def main():
@@ -370,7 +500,7 @@ def main():
     ap.add_argument("--workdir", type=str, default=None)
     ap.add_argument("--only", type=str, default="all",
                     choices=("all", "persona", "emnist", "clientstore",
-                             "arrival"))
+                             "arrival", "async"))
     ap.add_argument("--store_matched_clients", type=int, default=4096)
     ap.add_argument("--store_scale_clients", type=int,
                     default=1_000_000)
@@ -386,6 +516,20 @@ def main():
     ap.add_argument("--arrival_straggler_every", type=int, default=10)
     ap.add_argument("--arrival_straggler_delay_s", type=float,
                     default=0.05)
+    ap.add_argument("--async_rounds", type=int, default=40)
+    ap.add_argument("--async_k", type=int, default=4,
+                    help="buffered leg's --async_buffer_size "
+                    "(cohort is 8)")
+    ap.add_argument("--async_alpha", type=float, default=0.5,
+                    help="buffered leg's --async_staleness_weight")
+    ap.add_argument("--async_wait_unit_ms", type=float, default=5.0,
+                    help="real milliseconds per fold-step unit of "
+                    "schedule delay the synchronous barrier waits")
+    ap.add_argument("--async_max_delay", type=int, default=4)
+    ap.add_argument("--async_churn_frac", type=float, default=0.5)
+    ap.add_argument("--runs_dir", type=str, default="runs",
+                    help="registry directory for the async bench's "
+                    "run manifest (written only with --ledger)")
     ap.add_argument("--ledger", type=str, default="",
                     help="append the result as a telemetry JSONL "
                     "bench record (stdout line unchanged)")
@@ -412,6 +556,21 @@ def main():
                 args.arrival_burst_stop, args.arrival_drop_frac,
                 args.arrival_straggler_every,
                 args.arrival_straggler_delay_s))
+        if args.only in ("all", "async"):
+            aout, acfg = bench_async(
+                args.store_scale_clients, args.async_rounds,
+                args.async_k, args.async_alpha, args.arrival_seed,
+                args.async_wait_unit_ms / 1e3,
+                args.store_budget_mb << 20, args.async_max_delay,
+                args.async_churn_frac, ledger=args.ledger)
+            out.update(aout)
+            if args.ledger:
+                from commefficient_tpu.telemetry import registry
+                mp = registry.write_manifest(
+                    args.runs_dir, args=acfg, ledger=args.ledger,
+                    bench={k: v for k, v in aout.items()
+                           if v is not None})
+                print(f"manifest: {mp}", file=sys.stderr)
     finally:
         if args.workdir is None:
             shutil.rmtree(root, ignore_errors=True)

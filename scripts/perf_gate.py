@@ -66,23 +66,25 @@ def load_ledger_records(path):
 
 def resolve_topology(manifest=None, records=(), device_count=None,
                      process_count=None, mesh_shape=None,
-                     wire_dtype=None):
+                     wire_dtype=None, async_k=None):
     """The run's (device_count, process_count, mesh_shape,
-    wire_dtype) for baseline keying: CLI overrides win, then the run
-    manifest, then the ledger's meta record (``num_devices``;
+    wire_dtype, async_k) for baseline keying: CLI overrides win, then
+    the run manifest, then the ledger's meta record (``num_devices``;
     pre-fleet metas never recorded a process count — those ran the
-    single-process path, so 1). (None, None, None, None) when nothing
-    knows — such runs gate under the ``any`` bucket. ``mesh_shape``
-    follows the same chain: a CLI "CxM" string, the manifest's
-    recorded dict, or the meta record's ``mesh_shape``; 1-D runs
-    resolve to None (their key is the historical mesh-less one).
-    ``wire_dtype`` likewise: CLI, the manifest config's
-    ``sketch_dtype``, the meta record's round plan / cost model; f32
-    and pre-quantization runs resolve to None (the historical
-    unsuffixed key)."""
+    single-process path, so 1). All-None when nothing knows — such
+    runs gate under the ``any`` bucket. ``mesh_shape`` follows the
+    same chain: a CLI "CxM" string, the manifest's recorded dict, or
+    the meta record's ``mesh_shape``; 1-D runs resolve to None (their
+    key is the historical mesh-less one). ``wire_dtype`` likewise:
+    CLI, the manifest config's ``sketch_dtype``, the meta record's
+    round plan / cost model; f32 and pre-quantization runs resolve to
+    None (the historical unsuffixed key). ``async_k`` likewise: CLI,
+    the manifest config's ``async_buffer_size``, the meta record's
+    round plan; synchronous and pre-async runs resolve to None."""
     dc, pc = device_count, process_count
     ms = parse_mesh_shape(mesh_shape)
     wd = wire_dtype
+    ak = async_k
     if manifest is not None:
         mdc, mpc = registry.run_topology(manifest)
         dc = mdc if dc is None else dc
@@ -91,7 +93,10 @@ def resolve_topology(manifest=None, records=(), device_count=None,
             ms = registry.run_mesh_shape(manifest)
         if wd is None:
             wd = registry.run_wire_dtype(manifest)
-    if dc is None or pc is None or ms is None or wd is None:
+        if ak is None:
+            ak = registry.run_async_k(manifest)
+    if dc is None or pc is None or ms is None or wd is None \
+            or ak is None:
         for rec in records:
             if rec.get("kind") != "meta":
                 continue
@@ -103,19 +108,24 @@ def resolve_topology(manifest=None, records=(), device_count=None,
                 pc = int(rec["process_count"])
             if ms is None and isinstance(rec.get("mesh_shape"), dict):
                 ms = dict(rec["mesh_shape"])
+            plan = rec.get("plan") or {}
             if wd is None:
-                plan = rec.get("plan") or {}
                 cost = rec.get("cost_model") or {}
                 if plan.get("mode") == "sketch":
                     wd = plan.get("sketch_dtype")
                 elif cost.get("wire_dtype"):
                     wd = cost.get("wire_dtype")
+            if ak is None and plan.get("async_buffer_size"):
+                ak = int(plan["async_buffer_size"])
             if (dc is not None and pc is not None
-                    and ms is not None and wd is not None):
+                    and ms is not None and wd is not None
+                    and ak is not None):
                 break
     if wd == "f32":
         wd = None  # historical unsuffixed key
-    return dc, pc, ms, wd
+    if not ak:
+        ak = None  # synchronous runs keep the historical key
+    return dc, pc, ms, wd, ak
 
 
 def parse_mesh_shape(mesh_shape):
@@ -175,6 +185,12 @@ def main(argv=None):
                          "(normally read from the manifest config / "
                          "ledger meta; f32 runs keep the historical "
                          "unsuffixed key)")
+    ap.add_argument("--async_k", type=int, default=None,
+                    help="override the run's --async_buffer_size for "
+                         "baseline keying (normally read from the "
+                         "manifest config / ledger meta plan; "
+                         "synchronous runs keep the historical "
+                         "unsuffixed key)")
     args = ap.parse_args(argv)
 
     ledger = args.ledger
@@ -190,7 +206,7 @@ def main(argv=None):
         print(f"run: {mpath} (config {manifest.get('config_hash', '')[:8]}, "
               f"git {manifest.get('git_sha', '')[:8]}, "
               f"topology "
-              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest))}"
+              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest))}"
               f") -> {ledger}")
     if ledger is None:
         ap.error("one of --ledger / --runs_dir is required")
@@ -200,11 +216,10 @@ def main(argv=None):
     if not metrics:
         print(f"{ledger}: no gateable metrics (empty ledger?)")
         return 1
-    dc, pc, ms, wd = resolve_topology(manifest, records,
-                                      args.device_count,
-                                      args.process_count,
-                                      args.mesh_shape, args.wire_dtype)
-    topo = gate.topology_key(dc, pc, ms, wd)
+    dc, pc, ms, wd, ak = resolve_topology(
+        manifest, records, args.device_count, args.process_count,
+        args.mesh_shape, args.wire_dtype, args.async_k)
+    topo = gate.topology_key(dc, pc, ms, wd, ak)
     print(f"{ledger}: {len(metrics)} metric(s) extracted "
           f"(topology {topo})")
     chash = (manifest or {}).get("config_hash", "")
@@ -224,7 +239,7 @@ def main(argv=None):
                   "with --write-baseline first")
             return 1
         existing = gate.load_baseline(gate_path)
-        entry = gate.baseline_entry(existing, dc, pc, ms, wd)
+        entry = gate.baseline_entry(existing, dc, pc, ms, wd, ak)
         if entry is None and args.write_baseline and not args.check:
             # first capture of a NEW topology point: nothing to gate
             # this run against, other points stay untouched
@@ -246,7 +261,8 @@ def main(argv=None):
                                    rel_tol=args.rel_tol,
                                    mad_k=args.mad_k,
                                    device_count=dc, process_count=pc,
-                                   mesh_shape=ms, wire_dtype=wd)
+                                   mesh_shape=ms, wire_dtype=wd,
+                                   async_k=ak)
             print(gate.render_verdict(verdict))
 
     if args.write_baseline:
@@ -263,7 +279,7 @@ def main(argv=None):
                                  source=os.path.abspath(ledger),
                                  device_count=dc, process_count=pc,
                                  config_hash=chash, mesh_shape=ms,
-                                 wire_dtype=wd),
+                                 wire_dtype=wd, async_k=ak),
             args.write_baseline)
         print(f"baseline[{topo}] -> {args.write_baseline}")
 

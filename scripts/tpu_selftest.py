@@ -219,6 +219,80 @@ def quant_smoke():
             f"{errs['fp8']:.3f}; uplink {ratio:.2f}x smaller at int8")
 
 
+def async_smoke():
+    """Buffered asynchronous rounds (asyncfed) on the REAL backend:
+    the degenerate configuration — buffer size == cohort, staleness
+    weight 0, punctual arrivals — must be BIT-IDENTICAL to the
+    synchronous barrier round (the async driver adds bookkeeping,
+    never math), and a churny arrival schedule must land its
+    staleness histogram in the telemetry ledger for the observatory
+    to read."""
+    import json
+    import shutil
+    import tempfile
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.data.chaos import ArrivalSchedule
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+
+    W, B, d = 8, 2, 1 << 10
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    def run(async_k, alpha, sched=None, ledger=""):
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9, k=32,
+                     num_rows=3, num_cols=256, num_workers=W,
+                     local_batch_size=B, num_clients=64, seed=3,
+                     async_buffer_size=async_k,
+                     async_staleness_weight=alpha, ledger=ledger)
+        model = FedModel(None, {"w": jnp.zeros((d,), jnp.float32)},
+                         loss, cfg, padded_batch_size=B)
+        opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+        if sched is not None:
+            model.attach_arrival_process(sched)
+        rng = np.random.RandomState(3)
+        for _ in range(6):
+            batch = {"client_ids": rng.choice(64, W, replace=False)
+                     .astype(np.int32),
+                     "x": jnp.asarray(rng.randn(W, B, d), jnp.float32),
+                     "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+                     "mask": jnp.ones((W, B), jnp.float32)}
+            model(batch)
+            opt.step()
+        ps = np.asarray(model.ps_weights)
+        model.finalize()
+        return ps
+
+    sync = run(0, 0.0)
+    deg = run(W, 0.0)  # K == cohort, punctual: the barrier in disguise
+    assert np.array_equal(sync, deg), "degenerate async != sync round"
+
+    tmp = tempfile.mkdtemp(prefix="async_smoke_")
+    try:
+        led = os.path.join(tmp, "ledger.jsonl")
+        run(4, 0.5, sched=ArrivalSchedule("churny", seed=3),
+            ledger=led)
+        hist = None
+        with open(led) as f:
+            for line in f:
+                rec = json.loads(line)
+                pr = rec.get("probes") or {}
+                if "async_staleness_hist" in pr:
+                    hist = pr["async_staleness_hist"]
+        assert hist is not None, "no staleness histogram in ledger"
+        assert sum(hist) > 0, hist
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return ("degenerate buffered round bitwise == sync; churny "
+            f"staleness hist {hist}")
+
+
 def audit_smoke():
     """Static audit on the REAL backend: zero unwaived lint hits, and
     the sketch fused round compiled for this topology is donation-
@@ -511,6 +585,7 @@ def main():
     check("bf16_flagship_round", bf16_round_trains)
     check("probe_smoke", probe_smoke)
     check("quant_smoke", quant_smoke)
+    check("async_smoke", async_smoke)
     check("audit_smoke", audit_smoke)
     check("trace_smoke", trace_smoke)
     check("scaling_smoke", scaling_smoke)

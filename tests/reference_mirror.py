@@ -99,6 +99,15 @@ def np_robust_fold(cfg, transmits, counts):
     return agg.reshape(np.shape(transmits[0])), float(rej)
 
 
+def np_staleness_weights(staleness, alpha):
+    """Mirror of core/server.staleness_weights: the buffered-async
+    fold's per-client down-weight ``1/(1+s)^alpha``, computed in f32
+    exactly like the jitted step (the weight multiplies both the
+    transmit and its datapoint count before the fold)."""
+    s = np.asarray(staleness, np.float32)
+    return (1.0 + s) ** np.float32(-float(alpha))
+
+
 # wire quantization (mirror of ops/quant.py) --------------------------
 
 NP_WIRE_DTYPES = {"bf16": np.dtype(ml_dtypes.bfloat16),

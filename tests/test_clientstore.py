@@ -370,6 +370,70 @@ def test_prefetcher_hit_miss_staleness_and_shutdown():
     st.close()
 
 
+def test_prefetcher_out_of_order_consumption():
+    """Buffered-async overlap (asyncfed) consumes staged gathers out
+    of issue order: a ``take`` for the SECOND submit must drain the
+    first staged job as a miss — no deadlock, no torn rows — and a
+    row written after the async snapshot must still come back patched
+    through the version check, never a silently-stale mix."""
+    st = HostClientStore(12, {"v": ((4,), None)},
+                         budget_bytes=1 << 16)
+    pf = StorePrefetcher(st)
+    ids1 = np.array([1, 2, 3], np.int64)
+    ids2 = np.array([4, 5, 6], np.int64)
+    st.write(ids1, {"v": np.ones((3, 4), np.float32)})
+    st.write(ids2, {"v": np.full((3, 4), 2.0, np.float32)})
+    pf.submit(ids1)
+    pf.submit(ids2)
+    assert _wait(lambda: pf._done.qsize() == 2)
+    # a write landing between the snapshot and the take: version
+    # patching must hand back the CURRENT row, not the staged one
+    st.write([5], {"v": np.full((1, 4), 42.0, np.float32)})
+    rows = pf.take(ids2)
+    assert rows is not None
+    assert pf.misses == 1 and pf.hits == 1
+    np.testing.assert_array_equal(rows["v"][0], np.full(4, 2.0))
+    np.testing.assert_array_equal(rows["v"][1], np.full(4, 42.0))
+    # the backlog is drained: a further take must return fast with
+    # None (synchronous-gather fallback), not wedge on the queue
+    t0 = time.time()
+    assert pf.take(ids1, timeout=0.5) is None
+    assert time.time() - t0 < 5.0
+    pf.close()
+    st.close()
+
+
+def test_prefetcher_worker_death_surfaces_out_of_order():
+    """The chaos-harness kill hook marks the loop dead exactly like
+    an escaped exception: the NEXT take()/submit — even one for a
+    job staged before the death — raises the worker-died RuntimeError
+    instead of stalling out its timeout."""
+    st = HostClientStore(4, {"v": ((2,), None)}, budget_bytes=1 << 12)
+    pf = StorePrefetcher(st)
+    pf.submit(np.array([0], np.int64))
+    assert pf.take(np.array([0], np.int64)) is not None
+    pf._fail_for_test(ValueError("chaos"))
+    with pytest.raises(RuntimeError, match="prefetch worker died"):
+        pf.take(np.array([0], np.int64))
+    with pytest.raises(RuntimeError, match="prefetch worker died"):
+        pf.submit(np.array([1], np.int64))
+    pf.close()
+    st.close()
+
+
+def test_store_issue_round_stamps():
+    """asyncfed version stamps: bookkeeping-only per-client issue
+    rounds, -1 for never-issued, last issue wins on re-issue."""
+    st = HostClientStore(8, {"v": ((2,), None)}, budget_bytes=1 << 12)
+    assert st.stamped_round(3) == -1
+    st.stamp_rounds(np.array([1, 3], np.int64), 5)
+    st.stamp_rounds(np.array([[3]], np.int64), 7)  # any shape of ids
+    assert st.stamped_round(1) == 5
+    assert st.stamped_round(3) == 7
+    assert st.stamped_round(0) == -1
+    st.close()
+
+
 # ----------------------------------------------------------------------
 # config plumbing
 

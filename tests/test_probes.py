@@ -297,15 +297,43 @@ def test_probes_off_program_identical(mode, error_type):
         cfg, robust_agg="none", robust_trim_frac=0.2,
         robust_clip_norm=5.0, robust_median_groups=2,
         alarm_byzantine_ratio=4.0, alarm_fold_rejection=0.5,
-        checkpoint_every_rounds=3, checkpoint_keep=2)
+        checkpoint_every_rounds=3, checkpoint_keep=2,
+        # asyncfed knobs without --async_buffer_size: the staleness
+        # weight and alarm threshold are host/trace-gated and must
+        # not perturb a synchronous build
+        async_staleness_weight=0.7, alarm_async_staleness=4.0)
     assert _lower_text(
         build_client_round(inert_cfg, linear_loss, 3,
                            transmit_transform=None),
         inert_cfg) == default
+    # alpha == 0 keeps even a client_weights build's WEIGHTING
+    # branch untraced (the staleness arg itself is appended, so the
+    # signature — not the fold math — is what differs)
+    assert _lower_text(
+        build_client_round(cfg, linear_loss, 3, client_weights=False),
+        cfg) == default
     # an ACTIVE robust fold, by contrast, changes the program
     med_cfg = dataclasses.replace(cfg, robust_agg="median")
     assert _lower_text(build_client_round(med_cfg, linear_loss, 3),
                        med_cfg) != default
+    # ...and so does an active staleness-weighted fold
+    aw_cfg = dataclasses.replace(cfg, async_buffer_size=2,
+                                 async_staleness_weight=0.7)
+    aw_round = build_client_round(aw_cfg, linear_loss, 3,
+                                  client_weights=True)
+    d, B, W = 8, 3, 2
+    ps = jax.ShapeDtypeStruct((d,), jnp.float32)
+    cs = jax.eval_shape(
+        lambda: ClientStates.init(aw_cfg, 4, jnp.zeros((d,),
+                                                       jnp.float32)))
+    batch = {"x": jax.ShapeDtypeStruct((W, B, d), jnp.float32),
+             "y": jax.ShapeDtypeStruct((W, B), jnp.float32),
+             "mask": jax.ShapeDtypeStruct((W, B), jnp.float32)}
+    assert jax.jit(aw_round).lower(
+        ps, cs, batch, jax.ShapeDtypeStruct((W,), jnp.int32),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((W,), jnp.float32)).as_text() != default
 
     def _server_text(sr):
         ps = jax.ShapeDtypeStruct((8,), jnp.float32)
