@@ -403,6 +403,84 @@ def _check_inline_partition_spec(rel, lines, tree):
     return hits
 
 
+# --- rule: checkpoint-mesh-route ---------------------------------------
+
+
+_MESH_CONSTRUCTORS = {"client_sharding", "server_state_sharding",
+                      "replicated", "shard_batch", "make_mesh",
+                      "make_mesh2d"}
+
+
+def _check_checkpoint_mesh_route(rel, lines, tree):
+    """Every placement the checkpoint path applies at save/load time —
+    a ``device_put`` target or a ``sharding=`` argument — must come
+    from a parallel/mesh.py spec constructor (or be the explicit None
+    "keep the default layout"). The elastic-restore contract (a CxM
+    checkpoint restores bit-exact onto C'xM') holds precisely because
+    restore re-derives placement from the CURRENT mesh through the
+    same constructors FedModel/FedOptimizer initialised with; an
+    ad-hoc sharding built inline here would silently fork the layout
+    and break the migration."""
+    if rel.as_posix() != "runtime/checkpoint.py":
+        return []
+
+    def call_name(e):
+        f = e.func
+        return (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+
+    def sanctioned(e, names):
+        if isinstance(e, ast.Constant) and e.value is None:
+            return True
+        if isinstance(e, ast.Call):
+            return call_name(e) in _MESH_CONSTRUCTORS
+        if isinstance(e, ast.IfExp):
+            return (sanctioned(e.body, names)
+                    and sanctioned(e.orelse, names))
+        if isinstance(e, ast.Name):
+            return e.id in names
+        return False
+
+    # names whose EVERY assignment is a sanctioned placement (to a
+    # fixpoint, so spec = other_spec chains resolve)
+    assigns: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    assigns.setdefault(t.id, []).append(node.value)
+    names: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, vals in assigns.items():
+            if name not in names and all(
+                    sanctioned(v, names) for v in vals):
+                names.add(name)
+                changed = True
+
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node) == "device_put" and len(node.args) >= 2 \
+                and not sanctioned(node.args[1], names):
+            hits.append((node.lineno,
+                         "device_put placement not built by a "
+                         "parallel.mesh spec constructor — checkpoint "
+                         "save/load shapes must route through "
+                         "parallel/mesh.py"))
+        for kw in node.keywords:
+            if kw.arg in ("sharding", "device") \
+                    and not sanctioned(kw.value, names):
+                hits.append((node.lineno,
+                             f"{kw.arg}= argument not built by a "
+                             "parallel.mesh spec constructor — "
+                             "checkpoint save/load shapes must route "
+                             "through parallel/mesh.py"))
+    return hits
+
+
 # --- rule: byte-literal -------------------------------------------------
 
 
@@ -492,6 +570,9 @@ ALL_RULES = [
     Rule("inline-partition-spec",
          "PartitionSpec/NamedSharding built outside parallel/",
          _check_inline_partition_spec),
+    Rule("checkpoint-mesh-route",
+         "checkpoint placement not built by parallel.mesh constructors",
+         _check_checkpoint_mesh_route),
     Rule("byte-literal",
          "inline byte-width multiply in runtime/telemetry accounting",
          _check_byte_literal),

@@ -105,6 +105,57 @@ class AsyncRoundDriver:
             out["mask"] = mask
         return out
 
+    # -- checkpoint/resume ------------------------------------------
+
+    def export_state(self) -> dict:
+        """Host-serialisable snapshot of the driver: the arrival heap
+        in (arrive_at, seq) order — timing columns as int64 arrays,
+        per-slot rows stacked per batch key — plus the fold/seq/total
+        counters. Saved by runtime/checkpoint.py so a resumed async
+        run rebuilds the exact backlog instead of silently restarting
+        with an empty buffer."""
+        entries, next_seq = self.queue.snapshot()
+        keys = sorted(entries[0][2]["slot"]) if entries else []
+        return {
+            "fold": int(self._fold),
+            "seq": int(next_seq),
+            "issued_total": int(self.issued_total),
+            "folded_total": int(self.folded_total),
+            "slot_keys": keys,
+            "arrive_at": np.asarray([t for t, _, _ in entries],
+                                    np.int64),
+            "issue_seq": np.asarray([s for _, s, _ in entries],
+                                    np.int64),
+            "issue": np.asarray([e["issue"] for _, _, e in entries],
+                                np.int64),
+            "slots": {k: np.stack([np.asarray(e["slot"][k])
+                                   for _, _, e in entries])
+                      for k in keys},
+        }
+
+    def import_state(self, state: dict) -> None:
+        """Inverse of :meth:`export_state` — rebuilds the heap and
+        counters in place. Entry order, seq values and staleness
+        arithmetic come back exactly, so the resumed fold sequence is
+        bit-identical to the uninterrupted run's."""
+        self._fold = int(state["fold"])
+        self.issued_total = int(state["issued_total"])
+        self.folded_total = int(state["folded_total"])
+        keys = list(state["slot_keys"])
+        arrive_at = np.asarray(state["arrive_at"], np.int64)
+        issue_seq = np.asarray(state["issue_seq"], np.int64)
+        issue = np.asarray(state["issue"], np.int64)
+        entries = []
+        for i in range(arrive_at.shape[0]):
+            entry = {
+                "issue": int(issue[i]),
+                "slot": {k: np.asarray(state["slots"][k][i])
+                         for k in keys},
+            }
+            entries.append((int(arrive_at[i]), int(issue_seq[i]),
+                            entry))
+        self.queue.restore(entries, int(state["seq"]))
+
     # -- prefetch lookahead -----------------------------------------
 
     def peek_next_ids(self) -> Optional[np.ndarray]:

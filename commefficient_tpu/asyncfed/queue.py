@@ -47,3 +47,21 @@ class ArrivalQueue:
                 break
             out.append(e)
         return out
+
+    def snapshot(self):
+        """``(entries, next_seq)`` — every queued ``(arrive_at, seq,
+        entry)`` in (arrival, issue) order plus the running sequence
+        counter: the checkpointable view of the backlog
+        (runtime/checkpoint.py saves it so in-flight buffered rounds
+        survive a resume)."""
+        return (sorted(self._heap, key=lambda t: (t[0], t[1])),
+                self._seq)
+
+    def restore(self, entries, next_seq: int) -> None:
+        """Inverse of :meth:`snapshot` — rebuilds the heap in place.
+        Preserving the original seq values keeps the FIFO tiebreak
+        (and therefore the fold order) identical to a run that was
+        never interrupted."""
+        self._heap = [(int(t), int(s), e) for t, s, e in entries]
+        heapq.heapify(self._heap)
+        self._seq = int(next_seq)

@@ -154,6 +154,27 @@ class HostClientStore:
         with self._lock:
             return self._issue_round.get(int(cid), -1)
 
+    def export_stamps(self):
+        """``(ids, rounds)`` int64 arrays of every issue-round stamp,
+        for checkpointing: the asyncfed staleness bookkeeping must
+        survive a resume along with the arrival backlog it audits.
+        Stamps cover the full issued cohort on every process (the
+        driver stamps before ownership filtering), so one process's
+        export is the global view."""
+        with self._lock:
+            ids = np.asarray(sorted(self._issue_round), np.int64)
+            rounds = np.asarray([self._issue_round[int(i)]
+                                 for i in ids], np.int64)
+        return ids, rounds
+
+    def import_stamps(self, ids, rounds):
+        """Inverse of :meth:`export_stamps` (checkpoint restore)."""
+        with self._lock:
+            self._issue_round = {
+                int(i): int(r)
+                for i, r in zip(np.asarray(ids).reshape(-1),
+                                np.asarray(rounds).reshape(-1))}
+
     @property
     def version(self):
         with self._lock:

@@ -46,6 +46,22 @@ class ServerState(NamedTuple):
 
         return ServerState(z(), z())
 
+    @staticmethod
+    def restore(Vvelocity, Verror, sharding=None) -> "ServerState":
+        """Rebuild from host arrays at checkpoint restore. The
+        checkpoint always holds the FULL buffers, so ``sharding``
+        (parallel/mesh.server_state_sharding for the CURRENT mesh)
+        re-places them under whatever topology the resumed run has —
+        a resize is a placement migration, values untouched, which is
+        what keeps a resized resume bit-exact vs an unresized one
+        (tests/test_elastic.py)."""
+        def put(a):
+            a = jnp.asarray(a, jnp.float32)
+            return a if sharding is None else jax.device_put(
+                a, sharding)
+
+        return ServerState(put(Vvelocity), put(Verror))
+
 
 class ServerUpdate(NamedTuple):
     # subtract from ps_weights; None when ``sparse_update`` carries

@@ -44,13 +44,16 @@ the attack lives here.
 from __future__ import annotations
 
 import dataclasses
+import os
+import signal
 import time
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
 __all__ = ["ATTACKS", "ArrivalSchedule", "ChaosConfig",
-           "ChaosInjector", "FlakyStore", "kill_prefetch_worker"]
+           "ChaosInjector", "FlakyStore", "PreemptionDrill",
+           "kill_prefetch_worker"]
 
 ATTACKS = ("none", "label_flip", "sign_flip", "scale", "noise")
 
@@ -355,6 +358,47 @@ class ArrivalSchedule:
             "dropped_client_rounds": round(
                 sum(1.0 - a for a in ragged) * cohort),
         }
+
+
+class PreemptionDrill:
+    """Seeded self-preemption: kill THIS process mid-round, once.
+
+    The elastic-restore drill's first act. A seeded RandomState picks
+    the kill round from ``[min_round, max_round]`` and the signal from
+    ``signals`` (SIGTERM for the graceful-shutdown path, SIGKILL for
+    the torn-write path), so the same seed always dies at the same
+    point — a failed drill is a repro, not a flake. The driving test
+    calls :meth:`should_kill` each round at the chosen fault point
+    (between forward and fold, after the autosave, wherever it wants
+    the cut) and :meth:`execute` delivers the signal to ``os.getpid``.
+
+    Like everything in this module the drill is test/bench-only; the
+    survivor half of the story (restart on fewer hosts, resume from
+    the last valid autosave, converge-or-alarm) lives in the chaos
+    tests, not here.
+    """
+
+    def __init__(self, seed: int = 0, min_round: int = 1,
+                 max_round: int = 4,
+                 signals: Sequence[int] = (signal.SIGTERM,
+                                           signal.SIGKILL)):
+        assert 0 <= min_round <= max_round
+        rng = np.random.RandomState(seed)
+        self.kill_round = int(rng.randint(min_round, max_round + 1))
+        self.signal = int(signals[int(rng.randint(len(signals)))])
+        self.fired = False
+
+    def should_kill(self, round_index: int) -> bool:
+        """True once ``round_index`` reaches the drawn kill round (and
+        the drill has not fired yet)."""
+        return not self.fired and int(round_index) >= self.kill_round
+
+    def execute(self) -> None:
+        """Deliver the drawn signal to this process. SIGKILL never
+        returns; SIGTERM returns to let the harness's handler (e.g.
+        ``sigterm_raises``) unwind the run."""
+        self.fired = True
+        os.kill(os.getpid(), self.signal)
 
 
 class FlakyStore:

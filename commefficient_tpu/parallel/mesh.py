@@ -134,6 +134,16 @@ def server_state_sharding(mesh: Mesh, transmit_shape) -> NamedSharding:
     return NamedSharding(mesh, server_state_spec(transmit_shape))
 
 
+def mesh_shape_dict(mesh: Optional[Mesh]) -> Optional[dict]:
+    """``{axis: size}`` view of a mesh for manifests and checkpoint
+    topology segments (None for the 1-D no-mesh path). The single
+    serialisable mesh description the elastic-resume lineage is keyed
+    by — comparing two of these answers "did the topology change?"."""
+    if mesh is None:
+        return None
+    return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+
+
 def first_local_device() -> jax.Device:
     """Local device 0 — the canonical probe target for memory stats
     and placement checks. The single sanctioned raw-device escape

@@ -18,13 +18,27 @@ Format: a single ``np.savez_compressed`` archive with a JSON ``meta``
 entry, written atomically (tmp + rename). Resume is bit-exact:
 tests/test_checkpoint.py checks interrupted-and-resumed training
 reproduces the uninterrupted run's weights exactly.
+
+Checkpoints are TOPOLOGY-PORTABLE (the elastic-pod contract,
+tests/test_elastic.py): every state buffer is saved as the full host
+array, so restore re-places it under the CURRENT run's mesh and
+process count — server momentum/EF columns reshard through
+``parallel/mesh.server_state_sharding``, client rows repad through
+``padded_rows``, multi-process clientstore side shards merge and
+re-split by the new ownership ranges, and the asyncfed arrival
+backlog is rebuilt entry for entry. ``meta["topology"]`` /
+``meta["segments"]`` record the lineage so manifests (and the perf
+gate) can tell a resized run from an unbroken one.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import tempfile
+import warnings
+import zipfile
 from typing import Optional
 
 import jax
@@ -32,8 +46,17 @@ import numpy as np
 
 from commefficient_tpu.core.rounds import ClientStates
 from commefficient_tpu.core.server import ServerState
+from commefficient_tpu.parallel.mesh import mesh_shape_dict
 
 _FMT = 1
+
+
+class TornCheckpointError(ValueError):
+    """A checkpoint archive (main or side shard) is missing,
+    truncated or otherwise unreadable. Carries the offending file's
+    path in the message so an operator knows exactly which shard to
+    recover; ``setup_resume`` catches it and falls back to the newest
+    retained autosave that still validates."""
 
 
 def checkpoint_file(directory: str, tag: str = "state") -> str:
@@ -57,6 +80,139 @@ def _atomic_savez(path: str, **arrays):
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def _verify_archive(path: str) -> None:
+    """Refuse a torn/truncated .npz with an error NAMING the file.
+    The atomic tmp+rename write means a torn archive normally cannot
+    exist, but a shared filesystem hiccup, a partial copy, or a side
+    shard orphaned by a dead process can still leave one — and
+    np.load's failure mode on those is an opaque zipfile traceback
+    halfway through restore."""
+    if not os.path.exists(path):
+        raise TornCheckpointError(
+            f"checkpoint shard missing: {path}")
+    try:
+        with zipfile.ZipFile(path) as zf:
+            bad = zf.testzip()  # CRC-checks every member
+        if bad is not None:
+            raise TornCheckpointError(
+                f"checkpoint shard {path} is torn: member {bad!r} "
+                "fails its CRC")
+    except TornCheckpointError:
+        raise
+    except (zipfile.BadZipFile, OSError, EOFError) as e:
+        raise TornCheckpointError(
+            f"checkpoint shard {path} is torn/truncated: {e}") from e
+
+
+def validate_checkpoint(path: str) -> dict:
+    """Verify the main archive AND every side shard its meta records,
+    returning the meta dict. Restore calls this first so a torn shard
+    is reported by name before any state is touched, instead of
+    crashing mid-resume with half the model restored."""
+    _verify_archive(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if "meta" not in z.files:
+                raise TornCheckpointError(
+                    f"checkpoint {path} has no meta entry — torn or "
+                    "not a checkpoint archive")
+            meta = json.loads(str(z["meta"]))
+    except TornCheckpointError:
+        raise
+    except (ValueError, OSError, EOFError) as e:
+        raise TornCheckpointError(
+            f"checkpoint {path} is unreadable: {e}") from e
+    procs = int((meta.get("clientstore") or {}).get("processes", 1))
+    for k in range(1, procs):
+        _verify_archive(_shard_file(path, k))
+    return meta
+
+
+def current_topology(mesh=None) -> dict:
+    """This run's restore-relevant topology, stamped into checkpoint
+    meta segments and registry manifests: the counts whose change
+    triggers the migration paths in load_checkpoint."""
+    topo = {"device_count": int(jax.device_count()),
+            "process_count": int(jax.process_count())}
+    ms = mesh_shape_dict(mesh)
+    if ms is not None:
+        topo["mesh_shape"] = ms
+    return topo
+
+
+def resume_manifest_extra(model) -> dict:
+    """Registry stamps for a resumed run: ``resumed_from`` (the
+    checkpoint this run restored) and ``topology_segments`` (one
+    entry per topology the lineage has run under, the restored chain
+    plus the current segment). Empty for unresumed runs, so trainers
+    can unconditionally splat it into ``maybe_write_manifest``'s
+    extra. The perf gate refuses to resolve a pin when the segments
+    span more than one topology (telemetry/registry.py
+    run_topology_changed)."""
+    info = getattr(model, "_resume_info", None)
+    if not info:
+        return {}
+    segments = list(getattr(model, "_restored_segments", []))
+    segments.append({**current_topology(model.mesh),
+                     "round_index": int(model.round_index)})
+    return {"resumed_from": dict(info),
+            "topology_segments": segments}
+
+
+def _prune_stale_shards(path: str, processes: int) -> None:
+    """Drop side shard files whose index is >= the writing process
+    count: they were left by a LARGER previous topology, the meta
+    just written no longer records them, and a later resume on yet
+    another process count must not merge rows from the dead layout."""
+    base = os.path.basename(path)
+    pat = re.compile(re.escape(base) + r"\.shard(\d+)\.npz$")
+    d = os.path.dirname(path) or "."
+    for name in os.listdir(d):
+        m = pat.fullmatch(name)
+        if m and int(m.group(1)) >= int(processes):
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+
+
+def _merged_store_shard(path: str, z, processes: int) -> dict:
+    """Merge every writing process's sparse clientstore shard into
+    one global shard: process 0's rows from the main archive plus
+    each side file's. Ids are disjoint across shards (contiguous
+    ownership ranges), so the merge is a concatenation; init rows are
+    identical everywhere and taken first-seen. This is the
+    topology-migration path — ``import_shard`` on the restoring side
+    then keeps only the rows each NEW process owns."""
+    shards = [{k[len("store:"):]: np.asarray(z[k])
+               for k in z.files if k.startswith("store:")}]
+    for k in range(1, int(processes)):
+        sp = _shard_file(path, k)
+        _verify_archive(sp)
+        with np.load(sp, allow_pickle=False) as sz:
+            shards.append({n: np.asarray(sz[n]) for n in sz.files})
+    merged: dict = {}
+    for sh in shards:
+        for n, v in sh.items():
+            if n.startswith("init:") and n not in merged:
+                merged[n] = v
+    merged["ids"] = np.concatenate(
+        [np.asarray(sh.get("ids", np.zeros((0,), np.int64)), np.int64)
+         for sh in shards])
+    fields = sorted({n for sh in shards for n in sh
+                     if n != "ids" and not n.startswith("init:")})
+    for f in fields:
+        parts = []
+        for i, sh in enumerate(shards):
+            if f not in sh:
+                raise TornCheckpointError(
+                    f"clientstore shard {i} of {path} lacks field "
+                    f"{f!r} — partial shard set")
+            parts.append(np.asarray(sh[f]))
+        merged[f] = np.concatenate(parts)
+    return merged
 
 
 def save_checkpoint(path: str, model, opt, scheduler=None,
@@ -126,6 +282,16 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         "transmit_shape": list(model.args.transmit_shape),
         "error_type": model.args.error_type,
         "extra": extra or {},
+        # elastic-pod lineage: the topology this archive was written
+        # under, plus the chain of earlier segments a resumed run
+        # restored through — restore migrates placement whenever the
+        # reader's topology differs, and manifests/perf-gate use the
+        # segment list to refuse cross-topology pin resolution
+        "topology": current_topology(getattr(model, "mesh", None)),
+        "segments": (list(getattr(model, "_restored_segments", []))
+                     + [{**current_topology(getattr(model, "mesh",
+                                                    None)),
+                         "round_index": int(model.round_index)}]),
     }
     if model.args.mode == "sketch":
         # the RESOLVED rotation granularity, not the -1 sentinel: a
@@ -150,6 +316,31 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         else:
             _atomic_savez(_shard_file(path, jax.process_index()),
                           **shard)
+        # asyncfed issue-round stamps: identical on every process
+        # (stamp_rounds runs with the full cohort's ids everywhere),
+        # so process 0's copy in the main archive covers the pod
+        stamp_ids, stamp_rounds = store.export_stamps()
+        if stamp_ids.size:
+            arrays["store_stamp_ids"] = stamp_ids
+            arrays["store_stamp_rounds"] = stamp_rounds
+    drv = getattr(model, "_async_driver", None)
+    if drv is not None:
+        # the buffered-arrival backlog: without it a resumed async
+        # run restarts with an empty queue and every in-flight
+        # buffered round is silently dropped
+        st = drv.export_state()
+        meta["asyncfed"] = {
+            "fold": st["fold"], "seq": st["seq"],
+            "issued_total": st["issued_total"],
+            "folded_total": st["folded_total"],
+            "pending": int(st["arrive_at"].shape[0]),
+            "slot_keys": list(st["slot_keys"]),
+        }
+        arrays["async_arrive_at"] = st["arrive_at"]
+        arrays["async_issue_seq"] = st["issue_seq"]
+        arrays["async_issue"] = st["issue"]
+        for k, v in st["slots"].items():
+            arrays["async:slot:" + k] = v
     if scheduler is not None:
         meta["scheduler_step"] = int(scheduler._step)
     if sampler is not None and hasattr(sampler.rng, "get_state"):
@@ -217,6 +408,7 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
                     np.savez_compressed(f, meta=json.dumps(meta),
                                         **arrays)
                 os.replace(tmp, path)
+                _prune_stale_shards(path, int(jax.process_count()))
             except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
@@ -251,7 +443,13 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
 def load_checkpoint(path: str, model, opt, scheduler=None,
                     sampler=None, loader=None) -> dict:
     """Restore runtime state in place; returns the meta dict (use
-    ``meta["epoch"]`` as the resume epoch)."""
+    ``meta["epoch"]`` as the resume epoch).
+
+    Topology-portable: the checkpoint may have been written on a
+    different mesh shape, device count or process count — state is
+    re-placed under THIS run's layout (values untouched, so a resized
+    resume stays bit-exact against an unresized one)."""
+    validate_checkpoint(path)
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["meta"]))
         checks = [("format", _FMT),
@@ -305,14 +503,15 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
 
         import jax.numpy as jnp
 
-        from commefficient_tpu.parallel.mesh import client_sharding
+        from commefficient_tpu.parallel.mesh import (
+            client_sharding, model_axis_size, padded_rows,
+            server_state_sharding)
 
         # per-client state rows were sharded over the clients axis at
         # init (FedModel.__init__) — restore with the same placement.
         # Row padding depends on the mesh size, so a checkpoint taken
         # on a different device count is repadded here (padded rows
         # hold no information: client ids never index them).
-        from commefficient_tpu.parallel.mesh import padded_rows
 
         csh = client_sharding(model.mesh)
         nc = int(model.num_clients)
@@ -333,22 +532,30 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
         if store is not None:
             # this run keeps client state in the host store
             if ck_store is not None:
-                if int(ck_store.get("processes", 1)) != \
-                        jax.process_count():
-                    raise ValueError(
-                        "clientstore checkpoint written by "
-                        f"{ck_store.get('processes')} processes; this "
-                        f"run has {jax.process_count()} — shard "
-                        "ownership would not line up")
-                if jax.process_index() == 0:
-                    shard = {k[len("store:"):]: np.asarray(z[k])
-                             for k in z.files if k.startswith("store:")}
+                ck_procs = int(ck_store.get("processes", 1))
+                if ck_procs == jax.process_count():
+                    # same process count: shard files line up with
+                    # ownership, each process imports exactly its own
+                    if jax.process_index() == 0:
+                        shard = {k[len("store:"):]: np.asarray(z[k])
+                                 for k in z.files
+                                 if k.startswith("store:")}
+                    else:
+                        sp = _shard_file(path, jax.process_index())
+                        with np.load(sp, allow_pickle=False) as sz:
+                            shard = {k: np.asarray(sz[k])
+                                     for k in sz.files}
                 else:
-                    with np.load(_shard_file(path, jax.process_index()),
-                                 allow_pickle=False) as sz:
-                        shard = {k: np.asarray(sz[k])
-                                 for k in sz.files}
+                    # topology-changing restore: the old shard split
+                    # no longer matches this run's ownership ranges —
+                    # merge every old process's sparse shard and let
+                    # import_shard's write keep only the rows each
+                    # NEW process owns (the placement-migration path)
+                    shard = _merged_store_shard(path, z, ck_procs)
                 store.import_shard(shard)
+                if "store_stamp_ids" in z.files:
+                    store.import_stamps(z["store_stamp_ids"],
+                                        z["store_stamp_rounds"])
             else:
                 # dense (device-placement) checkpoint: import every
                 # client's row into the store
@@ -360,21 +567,20 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
             model.client_states = ClientStates(None, None, None)
         elif ck_fields:
             # host-store checkpoint into a device-placement run:
-            # densify each shard over the init rows
-            if int(ck_store.get("processes", 1)) != 1:
-                raise ValueError(
-                    "cannot densify a multi-process clientstore "
-                    "checkpoint into device placement")
+            # merge all processes' sparse shards (the single-process
+            # case merges trivially) and densify over the init rows
+            merged = _merged_store_shard(
+                path, z, int(ck_store.get("processes", 1)))
 
             def densify(field):
                 if field not in ck_fields:
                     return None
-                ids = np.asarray(z["store:ids"], np.int64)
-                rows_f = np.asarray(z["store:" + field])
+                ids = np.asarray(merged["ids"], np.int64)
+                rows_f = np.asarray(merged[field])
                 shape = (int(model.num_clients),) + rows_f.shape[1:]
-                init_key = "store:init:" + field
-                if init_key in z.files:
-                    base = np.broadcast_to(np.asarray(z[init_key]),
+                init = merged.get("init:" + field)
+                if init is not None:
+                    base = np.broadcast_to(np.asarray(init),
                                            shape).copy()
                 else:
                     base = np.zeros(shape, np.float32)
@@ -394,8 +600,20 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
                 put_client_rows(z["cs_weights"])
                 if "cs_weights" in z else cs.weights,
             )
-        opt.server_state = ServerState(jnp.asarray(z["ss_Vvelocity"]),
-                                       jnp.asarray(z["ss_Verror"]))
+        # server momentum/EF buffers: the archive holds the full host
+        # table, so restoring onto a different CxM mesh is a pure
+        # placement migration — device_put under the CURRENT mesh's
+        # column sharding (values untouched, hence bit-exact vs an
+        # unresized run). The <=1 model-axis case restores replicated,
+        # exactly the layout FedOptimizer initialised.
+        if model_axis_size(model.mesh) > 1:
+            ssh = server_state_sharding(
+                model.mesh, tuple(model.args.transmit_shape))
+        else:
+            ssh = None
+        opt.server_state = ServerState.restore(
+            np.asarray(z["ss_Vvelocity"]), np.asarray(z["ss_Verror"]),
+            sharding=ssh)
         model.last_updated = np.asarray(z["last_updated"])
         model.client_last_seen = np.asarray(z["client_last_seen"])
         if getattr(model, "model_state", None) is not None:
@@ -414,8 +632,8 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
                     "initialised statistics")
             else:
                 restored = []
-                for path, leaf in leaves:
-                    key = "bnstats:" + keystr(path)
+                for leaf_path, leaf in leaves:
+                    key = "bnstats:" + keystr(leaf_path)
                     if key not in z.files:
                         raise ValueError(
                             f"checkpoint lacks BN running stats {key} "
@@ -470,6 +688,47 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
                     z["sampler_mid_spec_sizes"])
                 st["spec_idx"] = np.asarray(z["sampler_mid_spec_idx"])
             sampler.import_state(st)
+
+        # asyncfed backlog: rebuild the arrival heap + counters so
+        # queued (in-flight) buffered rounds survive the resume
+        drv = getattr(model, "_async_driver", None)
+        ck_async = meta.get("asyncfed")
+        if drv is not None and ck_async is not None:
+            keys = list(ck_async.get("slot_keys", []))
+            drv.import_state({
+                "fold": ck_async["fold"], "seq": ck_async["seq"],
+                "issued_total": ck_async["issued_total"],
+                "folded_total": ck_async["folded_total"],
+                "slot_keys": keys,
+                "arrive_at": np.asarray(z["async_arrive_at"]),
+                "issue_seq": np.asarray(z["async_issue_seq"]),
+                "issue": np.asarray(z["async_issue"]),
+                "slots": {k: np.asarray(z["async:slot:" + k])
+                          for k in keys},
+            })
+        elif drv is not None:
+            warnings.warn(
+                "checkpoint has no asyncfed state (written by a "
+                "synchronous or pre-elastic run); the arrival buffer "
+                "resumes empty")
+        elif ck_async is not None and int(ck_async.get("pending", 0)):
+            raise ValueError(
+                f"checkpoint holds {ck_async['pending']} queued async "
+                "arrival(s) but this run is synchronous — resume with "
+                "--async_buffer_size or the buffered rounds in flight "
+                f"are dropped ({path})")
+
+        # lineage, for manifests (resume_manifest_extra) and the next
+        # save's meta["segments"] chain
+        model._restored_segments = list(
+            meta.get("segments")
+            or ([meta["topology"]] if meta.get("topology") else []))
+        model._resume_info = {
+            "checkpoint": os.path.abspath(path),
+            "epoch": int(meta.get("epoch", 0)),
+            "round_index": int(meta.get("round_index", 0)),
+            "topology": meta.get("topology"),
+        }
     return meta
 
 
@@ -523,15 +782,24 @@ class RoundAutosaver:
             self._retain(r)
 
     def _retain(self, round_index: int):
-        import re
         import shutil
+
+        def link(src, dst):
+            if os.path.exists(dst) or not os.path.exists(src):
+                return
+            try:
+                os.link(src, dst)
+            except OSError:
+                shutil.copy2(src, dst)
+
         hist = history_file(self.args.checkpoint_path, self.tag,
                             round_index)
-        if not os.path.exists(hist):
-            try:
-                os.link(self.path, hist)
-            except OSError:
-                shutil.copy2(self.path, hist)
+        link(self.path, hist)
+        # multi-process clientstore side shards retain WITH the main
+        # archive — a fallback resume onto this snapshot must be able
+        # to rebuild the store from the matching shard set
+        for k in range(1, jax.process_count()):
+            link(_shard_file(self.path, k), _shard_file(hist, k))
         pat = re.compile(
             rf"^ckpt_{re.escape(self.tag)}_r(\d+)\.npz$")
         snaps = sorted(
@@ -540,11 +808,44 @@ class RoundAutosaver:
                       os.listdir(self.args.checkpoint_path))
             if m)
         for _, name in snaps[:-self.keep]:
+            doomed = [name] + [
+                n for n in os.listdir(self.args.checkpoint_path)
+                if n.startswith(name + ".shard")]
+            for victim in doomed:
+                try:
+                    os.unlink(os.path.join(self.args.checkpoint_path,
+                                           victim))
+                except OSError:
+                    pass
+
+
+def _resolve_resume_source(directory: str, path: str,
+                           tag: str) -> str:
+    """The archive ``--resume`` should actually restore: the
+    canonical checkpoint when it validates, else the NEWEST retained
+    autosave snapshot that does. A torn canonical (shared-fs hiccup,
+    partial copy) therefore costs at most ``--checkpoint_every_rounds``
+    rounds instead of crashing the resume; with no valid fallback the
+    original TornCheckpointError (naming the bad shard) propagates."""
+    try:
+        validate_checkpoint(path)
+        return path
+    except TornCheckpointError as torn:
+        pat = re.compile(rf"^ckpt_{re.escape(tag)}_r(\d+)\.npz$")
+        snaps = sorted(
+            ((int(m.group(1)), m.group(0))
+             for m in (pat.match(n) for n in os.listdir(directory))
+             if m), reverse=True)
+        for _, name in snaps:
+            hist = os.path.join(directory, name)
             try:
-                os.unlink(os.path.join(self.args.checkpoint_path,
-                                       name))
-            except OSError:
-                pass
+                validate_checkpoint(hist)
+            except TornCheckpointError:
+                continue
+            print(f"WARNING: {torn} — falling back to retained "
+                  f"autosave {hist}")
+            return hist
+        raise
 
 
 def setup_resume(args, model, opt, scheduler, loader, tag: str):
@@ -554,6 +855,8 @@ def setup_resume(args, model, opt, scheduler, loader, tag: str):
     - ``--resume`` requires ``--checkpoint`` and an existing file —
       anything else raises instead of silently training from scratch
       (and then overwriting the directory's checkpoints).
+    - a torn canonical checkpoint falls back to the newest retained
+      autosave that still validates (``_resolve_resume_source``).
     - ``epoch_hook`` saves every ``--checkpoint_every`` epochs and at
       the end of training.
     - ``round_hook(epoch)`` is the :class:`RoundAutosaver` when
@@ -573,10 +876,11 @@ def setup_resume(args, model, opt, scheduler, loader, tag: str):
         if not os.path.exists(path):
             raise FileNotFoundError(
                 f"--resume: no checkpoint at {path}")
-        meta = load_checkpoint(path, model, opt, scheduler, sampler,
+        src = _resolve_resume_source(args.checkpoint_path, path, tag)
+        meta = load_checkpoint(src, model, opt, scheduler, sampler,
                                loader)
         start_epoch = meta["epoch"]
-        print(f"resumed from {path} at epoch {start_epoch}"
+        print(f"resumed from {src} at epoch {start_epoch}"
               + (" (mid-epoch)" if meta.get("sampler_mid_epoch")
                  else ""))
 

@@ -121,6 +121,31 @@ def run_async_k(manifest: dict):
     return k if k > 0 else None
 
 
+def run_segments(manifest: dict) -> list:
+    """The run's per-topology segments (``topology_segments``, stamped
+    by the trainers from checkpoint lineage for resumed runs). Empty
+    for unresumed / pre-elastic manifests."""
+    segs = manifest.get("topology_segments")
+    return [s for s in segs if isinstance(s, dict)] \
+        if isinstance(segs, list) else []
+
+
+def run_topology_changed(manifest: dict) -> bool:
+    """True when a resumed run crossed a topology boundary mid-run:
+    its segments span more than one distinct (device_count,
+    process_count, mesh_shape). Such a run's ledger mixes rounds
+    measured under different topologies, so the perf gate must NEVER
+    resolve it to a single baseline pin — gate each segment's own
+    ledger instead (scripts/perf_gate.py refuses)."""
+    keys = set()
+    for s in run_segments(manifest):
+        ms = s.get("mesh_shape")
+        keys.add((s.get("device_count"), s.get("process_count"),
+                  json.dumps(ms, sort_keys=True)
+                  if isinstance(ms, dict) else None))
+    return len(keys) > 1
+
+
 def run_key(manifest: dict) -> tuple:
     """(config_hash, device_count, process_count): two runs are
     comparable — diffable by the report, gateable against one

@@ -593,12 +593,15 @@ def main(argv=None):
         results = []
         model.interrupted()
     model.finalize()
+    from commefficient_tpu.runtime.checkpoint import \
+        resume_manifest_extra
     from commefficient_tpu.telemetry import registry
     registry.maybe_write_manifest(
         args, mesh_shape=dict(model.mesh.shape),
         extra={"trainer": "cv_train", "epochs": len(results),
                "interrupted": interrupted,
-               "diverged": bool(getattr(model, "diverged", False))})
+               "diverged": bool(getattr(model, "diverged", False)),
+               **resume_manifest_extra(model)})
 
     if args.do_checkpoint and not interrupted \
             and jax.process_index() == 0:

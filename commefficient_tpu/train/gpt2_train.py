@@ -480,12 +480,15 @@ def main(argv=None):
         results = []
         model.interrupted()
     model.finalize()
+    from commefficient_tpu.runtime.checkpoint import \
+        resume_manifest_extra
     from commefficient_tpu.telemetry import registry
     registry.maybe_write_manifest(
         args, mesh_shape=dict(model.mesh.shape),
         extra={"trainer": "gpt2_train", "epochs": len(results),
                "interrupted": interrupted,
-               "diverged": bool(getattr(model, "diverged", False))})
+               "diverged": bool(getattr(model, "diverged", False)),
+               **resume_manifest_extra(model)})
     if logdir is not None and not getattr(model, "diverged", False) \
             and not interrupted and jax.process_index() == 0:
         # reference gpt2_train.py:146, 278-283: final model + tokenizer

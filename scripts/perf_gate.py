@@ -224,6 +224,25 @@ def main(argv=None):
           f"(topology {topo})")
     chash = (manifest or {}).get("config_hash", "")
 
+    # a run that resized mid-run (elastic resume onto a different
+    # topology) has a ledger that mixes rounds measured under
+    # DIFFERENT topologies — no single baseline entry is a valid pin
+    # for it, in either direction
+    if manifest is not None and registry.run_topology_changed(manifest):
+        segs = registry.run_segments(manifest)
+        chain = " -> ".join(
+            gate.topology_key(s.get("device_count"),
+                              s.get("process_count"),
+                              s.get("mesh_shape"), wd, ak)
+            for s in segs)
+        print(f"perf gate: REFUSED — run resumed across a mid-run "
+              f"topology change ({len(segs)} segments: {chain}); its "
+              "metrics span topologies and never resolve to one "
+              "baseline pin. Gate each segment's own ledger instead.")
+        if args.check or args.write_baseline:
+            return 1
+        return 0
+
     verdict = None
     existing = None
     # a write-only invocation gates against the file it is about to

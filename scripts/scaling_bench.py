@@ -43,6 +43,14 @@ therefore its perf-gate topology key — gets the ``q<dtype>`` suffix
 (``d8p1qint8``): a quantized point is guarded by its own baseline
 entry and never compared against the f32 curve.
 
+``--resize CxM:C2xM2`` appends an elastic-resume pair: the workload
+runs on the first mesh, checkpoints, and the SAME run resumes on the
+second mesh (a different device count) — the resumed point's
+``scaling`` block carries ``resume_load_s`` (the restore wall time)
+and its manifest the ``resumed_from`` + ``topology_segments`` lineage
+stamps, so the report renders the pair as one lineage and the perf
+gate refuses to pin the topology-spanning ledger.
+
 ``--multihost`` appends a 2-process point via the
 scripts/multihost_smoke.py launcher pattern (free-port coordinator,
 ``jax.distributed.initialize`` per worker): process 0 writes the
@@ -88,6 +96,8 @@ def worker(args):
 
     from commefficient_tpu.config import Config
     from commefficient_tpu.runtime import FedModel, FedOptimizer
+    from commefficient_tpu.runtime.checkpoint import (
+        load_checkpoint, resume_manifest_extra, save_checkpoint)
     from commefficient_tpu.telemetry import clock, registry
     from commefficient_tpu.telemetry.profiler import trace_window
 
@@ -115,6 +125,14 @@ def worker(args):
 
     model = FedModel(module, params, loss, cfg, padded_batch_size=B)
     opt = FedOptimizer([{"lr": 0.1}], cfg)
+    resume_load_s = 0.0
+    if args.ckpt_resume:
+        # elastic resume point: restore the partner point's state
+        # onto THIS mesh before any timed work — the load wall time
+        # is the headline resume cost
+        t_load = clock.tick()
+        load_checkpoint(args.ckpt_resume, model, opt)
+        resume_load_s = clock.tick() - t_load
     rng = np.random.RandomState(0)  # same seed on every process: SPMD
 
     def mk(r):
@@ -134,6 +152,8 @@ def worker(args):
             opt.step()
         jax.block_until_ready(model.ps_weights)
         dt = clock.tick() - t0
+    if args.ckpt_save:
+        save_checkpoint(args.ckpt_save, model, opt)
     model.finalize()
 
     if jax.process_index() != 0:
@@ -180,12 +200,14 @@ def worker(args):
         else 0.0,
         "max_skew_s": round(max(skews), 6) if skews else 0.0,
     }
+    if args.ckpt_resume:
+        point["resume_load_s"] = round(resume_load_s, 4)
     manifest = registry.write_manifest(
         args.runs_dir, args=cfg, ledger=args.ledger,
         bench={"clients_per_s": {"value": point["clients_per_s"],
                                  "unit": "clients/s"}},
         mesh_shape=mesh_shape,
-        extra={"scaling": point})
+        extra={"scaling": point, **resume_manifest_extra(model)})
     print(POINT_TAG + json.dumps(point), flush=True)
     print(f"manifest -> {manifest}", file=sys.stderr)
     return 0
@@ -292,6 +314,14 @@ def main(argv=None):
                          "on the largest requested device count; "
                          "each point's perf-gate key gets a q<dtype> "
                          "suffix")
+    ap.add_argument("--resize", default="",
+                    help="elastic-resume pair 'CxM:C2xM2': run the "
+                         "workload on the first mesh, checkpoint it, "
+                         "then resume the SAME run on the second "
+                         "mesh/device count — the resume-cost point "
+                         "(its manifest carries resumed_from + "
+                         "topology_segments, so the perf gate "
+                         "refuses to pin the merged ledger)")
     ap.add_argument("--multihost", action="store_true",
                     help="append a 2-process point (2 devices per "
                          "process) and merge its ledger shards")
@@ -306,6 +336,9 @@ def main(argv=None):
     ap.add_argument("--sketch_dtype", default="f32",
                     help=argparse.SUPPRESS)
     ap.add_argument("--ledger", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt_save", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt_resume", default="",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--ref_clients_per_s", type=float, default=0.0,
                     help=argparse.SUPPRESS)
     ap.add_argument("--ref_devices", type=int, default=1,
@@ -337,6 +370,22 @@ def main(argv=None):
     for dt in dtypes:
         if dt not in ("f32", "bf16", "int8", "fp8"):
             ap.error(f"unknown sketch dtype {dt}")
+    resize = []
+    if args.resize:
+        halves = args.resize.lower().split(":")
+        try:
+            resize = [tuple(int(p) for p in h.split("x"))
+                      for h in halves]
+            ok = len(resize) == 2 and all(len(t) == 2 for t in resize)
+        except ValueError:
+            ok = False
+        if not ok:
+            ap.error(f"--resize wants 'CxM:C2xM2', got "
+                     f"{args.resize!r}")
+        for c, m in resize:
+            if W % c:
+                ap.error(f"resize mesh {c}x{m}: clients axis {c} "
+                         f"does not divide {W} workers")
     stamp = int(time.time())
     points, ref = [], None
 
@@ -374,6 +423,26 @@ def main(argv=None):
         show(f"d{n}p1 q{dt} "
              f"({point['upload_wire_bytes_per_client']:.0f} B/client)",
              point)
+
+    if resize:
+        (c1, m1), (c2, m2) = resize
+        ckpt = os.path.join(args.runs_dir, "scaling",
+                            f"resize_{stamp}.npz")
+        point, _ = _run_point(
+            c1 * m1, args, ref, stamp,
+            extra_cmd=["--mesh", f"{c1}x{m1}", "--ckpt_save", ckpt],
+            tag=f"m{c1}x{m1}rz0")
+        if ref is None:
+            ref = (point["clients_per_s"], c1 * m1)
+        points.append(point)
+        show(f"d{c1 * m1}p1 mesh {c1}x{m1} (pre-resize)", point)
+        point, _ = _run_point(
+            c2 * m2, args, ref, stamp,
+            extra_cmd=["--mesh", f"{c2}x{m2}", "--ckpt_resume", ckpt],
+            tag=f"m{c2}x{m2}rz1")
+        points.append(point)
+        show(f"d{c2 * m2}p1 mesh {c2}x{m2} (resumed, load "
+             f"{point.get('resume_load_s', 0.0)} s)", point)
 
     if args.multihost:
         point, ledger = _run_point(4, args, ref, stamp, nproc=2)

@@ -473,6 +473,80 @@ def render_scaling_curves(curves) -> str:
     return "\n".join(lines)
 
 
+def lineages(manifests) -> list:
+    """Resume lineages from the registry: manifests carrying a
+    ``resumed_from`` stamp (trainers write it from checkpoint
+    metadata) grouped with the earlier same-config manifests they
+    continue, oldest first. One lineage = one logical training run,
+    possibly spanning several manifests and several topologies (the
+    ``topology_segments`` chain records each leg)."""
+    from commefficient_tpu.telemetry import registry
+
+    by_hash = {}
+    for path, rec in manifests:             # oldest first
+        by_hash.setdefault(rec.get("config_hash", ""), []) \
+            .append((path, rec))
+    out = []
+    for chash, group in sorted(by_hash.items()):
+        if not any(isinstance(rec.get("resumed_from"), dict)
+                   for _, rec in group):
+            continue
+        entries = []
+        for path, rec in group:
+            dc, pc = registry.run_topology(rec)
+            entries.append({
+                "manifest": path,
+                "resumed_from": rec.get("resumed_from")
+                if isinstance(rec.get("resumed_from"), dict) else None,
+                "device_count": dc, "process_count": pc,
+                "mesh_shape": registry.run_mesh_shape(rec),
+                "segments": registry.run_segments(rec),
+            })
+        changed = any(registry.run_topology_changed(rec)
+                      for _, rec in group)
+        out.append({"config_hash": chash, "entries": entries,
+                    "topology_changed": changed})
+    return out
+
+
+def _segment_label(seg: dict) -> str:
+    dc = seg.get("device_count")
+    pc = seg.get("process_count")
+    label = f"d{dc}p{pc}" if dc is not None else "d?p?"
+    ms = seg.get("mesh_shape")
+    if isinstance(ms, dict) and ms:
+        label += " " + "x".join(str(v) for v in ms.values())
+    r = seg.get("round_index")
+    if r is not None:
+        label += f"@r{r}"
+    return label
+
+
+def render_lineages(lins) -> str:
+    lines = []
+    for lin in lins:
+        lines.append(f"== resume lineage (config "
+                     f"{lin['config_hash'][:8] or '????????'}, "
+                     f"{len(lin['entries'])} runs) ==")
+        for e in lin["entries"]:
+            name = os.path.basename(e["manifest"])
+            rf = e["resumed_from"]
+            tail = ""
+            if rf:
+                src = os.path.basename(str(rf.get("checkpoint", "")))
+                tail = (f" <- resumed from {src} "
+                        f"(round {rf.get('round_index', '?')})")
+            segs = e["segments"]
+            chain = " -> ".join(_segment_label(s) for s in segs) \
+                if segs else _segment_label(e)
+            lines.append(f"  {name}: {chain}{tail}")
+        if lin["topology_changed"]:
+            lines.append("  NOTE: topology changed mid-lineage — the "
+                         "perf gate treats each segment separately "
+                         "and refuses to pin the merged ledger")
+    return "\n".join(lines)
+
+
 def runs_dir_report(runs_dir: str, as_json: bool) -> int:
     """Registry mode: list the recent manifest-registered runs, render
     the latest run's ledger, diff it against the previous COMPARABLE
@@ -502,6 +576,9 @@ def runs_dir_report(runs_dir: str, as_json: bool) -> int:
                   f"backend {rec.get('backend', '?')}, {topo}"
                   + (f", {headline}" if headline else ""))
     curves = scaling_curves(manifests)
+    lins = lineages(manifests)
+    if lins and not as_json:
+        print(render_lineages(lins))
     hits = registry.latest_ledgers(runs_dir, n=1)
     if not hits:
         print("no manifest points at an existing ledger file")
@@ -520,7 +597,8 @@ def runs_dir_report(runs_dir: str, as_json: bool) -> int:
     if prev is None:
         if as_json:
             print(json.dumps({"latest": summ,
-                              "scaling_curves": curves}))
+                              "scaling_curves": curves,
+                              "lineages": lins}))
         else:
             print(render_summary(summ, label=latest))
             if not len(prev_hits) > 1:
@@ -535,7 +613,8 @@ def runs_dir_report(runs_dir: str, as_json: bool) -> int:
     d = diff_summaries(summarize(records_p), summ)
     if as_json:
         print(json.dumps({"latest": summ, "diff_vs_previous": d,
-                          "scaling_curves": curves}))
+                          "scaling_curves": curves,
+                          "lineages": lins}))
     else:
         print(render_summary(summ, label=latest))
         print(render_diff(d, prev, latest))

@@ -146,6 +146,11 @@ SEEDED = {
         def layout(mesh):
             return P("clients")
         """, "inline-partition-spec"),
+    "runtime/checkpoint.py": ("""
+        import jax
+        def restore(z, some_spec):
+            return jax.device_put(z["x"], some_spec)
+        """, "checkpoint-mesh-route"),
 }
 
 
@@ -226,6 +231,33 @@ def test_partition_spec_attribute_form_fires(tmp_path):
     hits = unwaived(run_lint(
         root=tmp_path, rules=[RULES_BY_NAME["inline-partition-spec"]]))
     assert len(hits) == 1 and hits[0].line == 3
+
+
+def test_checkpoint_mesh_route_allows_constructor_specs(tmp_path):
+    # placements built by parallel.mesh constructors — directly, via a
+    # named intermediate, or via the conditional spec-or-None idiom —
+    # are the sanctioned route; an inline sharding= is not
+    p = tmp_path / "runtime" / "checkpoint.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(
+        "import jax\n"
+        "from commefficient_tpu.parallel.mesh import (client_sharding,"
+        " server_state_sharding, model_axis_size)\n"
+        "def load(z, model):\n"
+        "    csh = client_sharding(model.mesh)\n"
+        "    ssh = server_state_sharding(model.mesh, (3, 8)) \\\n"
+        "        if model_axis_size(model.mesh) > 1 else None\n"
+        "    a = jax.device_put(z['rows'], csh)\n"
+        "    return a, restore(z['ss'], sharding=ssh)\n")
+    rule = RULES_BY_NAME["checkpoint-mesh-route"]
+    assert run_lint(root=tmp_path, rules=[rule]) == []
+    # the same file with a hand-built sharding= must fire
+    p.write_text(
+        "def load(z, model, mesh):\n"
+        "    s = make_my_own_layout(mesh)\n"
+        "    return restore(z['ss'], sharding=s)\n")
+    hits = unwaived(run_lint(root=tmp_path, rules=[rule]))
+    assert len(hits) == 1 and "sharding=" in hits[0].message
 
 
 def test_partition_spec_allowed_in_parallel(tmp_path):

@@ -572,6 +572,123 @@ def test_ledger_resume_is_monotone_and_deduplicated(tmp_path):
     assert rounds == sorted(set(rounds)) == list(range(8))
 
 
+# --- preemption drill: die mid-round, resume on fewer devices ----------
+
+
+_DRILL_WORKER = '''
+import json, os, sys
+import numpy as np
+import jax, jax.numpy as jnp
+from commefficient_tpu.config import Config
+from commefficient_tpu.runtime import FedModel, FedOptimizer
+from commefficient_tpu.runtime.checkpoint import (RoundAutosaver,
+                                                  checkpoint_file,
+                                                  load_checkpoint)
+
+phase, ckdir, seed = sys.argv[1], sys.argv[2], int(sys.argv[3])
+W, B, D, ROUNDS = 4, 8, 16, 20
+rng = np.random.RandomState(11)
+w_true = rng.randn(D).astype(np.float32)
+X = rng.randn(W, B, D).astype(np.float32)
+Y = (X.reshape(-1, D) @ w_true).reshape(W, B).astype(np.float32)
+
+def loss(p, batch, _cfg):
+    pred = batch["x"] @ p["w"]
+    n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+    l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+    return l, (l * 0.0 + 1.0,)
+
+cfg = Config(mode="sketch", error_type="virtual", local_momentum=0.0,
+             virtual_momentum=0.9, num_workers=W, local_batch_size=B,
+             num_clients=W, dataset_name="CIFAR10", seed=4, k=16,
+             num_rows=5, num_cols=64)
+cfg.checkpoint_path = ckdir
+cfg.checkpoint_every_rounds = 1
+cfg.checkpoint_keep = 2
+model = FedModel(None, {"w": jnp.zeros((D,), jnp.float32)}, loss,
+                 cfg, padded_batch_size=B)
+opt = FedOptimizer([{"lr": 0.3}], cfg, model=model)
+saver = RoundAutosaver(cfg, model, opt, None, None, None, tag="drill")
+drill = None
+start = 0
+if phase == "kill":
+    from commefficient_tpu.data.chaos import PreemptionDrill
+    drill = PreemptionDrill(seed=seed, min_round=2, max_round=5)
+else:
+    load_checkpoint(checkpoint_file(ckdir, "drill"), model, opt)
+    start = int(model.round_index)
+
+batch = {"x": X, "y": Y, "mask": np.ones((W, B), np.float32),
+         "client_ids": np.arange(W, dtype=np.int32)}
+
+def err(m):
+    return float(np.linalg.norm(
+        np.asarray(jax.device_get(m.ps_weights)) - w_true))
+
+initial = err(model)
+for r in range(start, ROUNDS):
+    model(batch)
+    if drill is not None and drill.should_kill(model.round_index):
+        drill.execute()  # never returns on SIGKILL; SIGTERM dies too
+    opt.step()
+    saver(0)
+model.finalize()
+print("DRILL " + json.dumps({
+    "start": start, "initial": initial, "final": err(model),
+    "diverged": bool(getattr(model, "diverged", False))}))
+'''
+
+
+def test_preemption_drill_resume_on_fewer_devices(tmp_path):
+    """The elastic drill end to end: a seeded PreemptionDrill kills a
+    2-device sketch run mid-round (after the forward, before the fold
+    commits), and a 1-device survivor resumes from the round-cadence
+    autosave and must keep converging on the honest objective — or
+    flag divergence. Silent degradation is the forbidden outcome."""
+    worker = tmp_path / "drill_worker.py"
+    worker.write_text(_DRILL_WORKER)
+    ckdir = str(tmp_path / "ck")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               PYTHONPATH=repo)
+    out = subprocess.run(
+        [sys.executable, str(worker), "kill", ckdir, "7"], env=env,
+        capture_output=True, text=True, timeout=560, cwd=repo)
+    assert out.returncode in (-signal.SIGTERM, -signal.SIGKILL), \
+        (out.returncode, out.stderr[-2000:])
+    # the autosave cadence left a valid resume point behind
+    snaps = [n for n in os.listdir(ckdir) if n.endswith(".npz")]
+    assert any(n == "ckpt_drill.npz" for n in snaps), snaps
+
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    out = subprocess.run(
+        [sys.executable, str(worker), "resume", ckdir, "7"], env=env,
+        capture_output=True, text=True, timeout=560, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = next(json.loads(line[len("DRILL "):])
+               for line in out.stdout.splitlines()
+               if line.startswith("DRILL "))
+    assert rec["start"] >= 1, rec  # resumed mid-run, not from scratch
+    converged = rec["final"] <= 0.5 * rec["initial"]
+    assert converged or rec["diverged"], rec
+
+
+def test_preemption_drill_is_seeded():
+    """Same seed -> same kill round and signal: a failed drill is a
+    repro, not a flake."""
+    from commefficient_tpu.data.chaos import PreemptionDrill
+
+    a, b = PreemptionDrill(seed=9), PreemptionDrill(seed=9)
+    assert (a.kill_round, a.signal) == (b.kill_round, b.signal)
+    assert 1 <= a.kill_round <= 4
+    assert a.signal in (signal.SIGTERM, signal.SIGKILL)
+    assert not a.should_kill(a.kill_round - 1)
+    assert a.should_kill(a.kill_round)
+    a.fired = True
+    assert not a.should_kill(a.kill_round)
+
+
 # --- config guard rails ------------------------------------------------
 
 

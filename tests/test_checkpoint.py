@@ -290,3 +290,81 @@ def test_gpt2_resume_round_trip(tmp_path):
     assert m1["epoch"] == m2["epoch"] == 2
     for k in s1:
         np.testing.assert_array_equal(s1[k], s2[k], err_msg=k)
+
+
+# -- torn-shard detection and the retained-autosave fallback ------------
+
+
+def _truncate(path):
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+
+
+def test_validate_names_missing_side_shard(tmp_path):
+    """A multi-process checkpoint whose side shard vanished (dead
+    process, partial copy) must refuse by NAME before any state is
+    touched."""
+    from commefficient_tpu.runtime.checkpoint import (
+        TornCheckpointError, validate_checkpoint)
+
+    path = str(tmp_path / "ck.npz")
+    meta = {"format": 1, "clientstore": {"fields": ["velocities"],
+                                         "processes": 2}}
+    np.savez_compressed(path, meta=json.dumps(meta))
+    with pytest.raises(TornCheckpointError, match=r"ck\.npz\.shard1"):
+        validate_checkpoint(path)
+    # a present-but-torn side shard is named the same way
+    _truncate_target = path + ".shard1.npz"
+    np.savez_compressed(_truncate_target, ids=np.zeros(1, np.int64))
+    _truncate(_truncate_target)
+    with pytest.raises(TornCheckpointError, match=r"shard1\.npz"):
+        validate_checkpoint(path)
+
+
+def test_torn_canonical_falls_back_to_retained_autosave(
+        tmp_path, capsys):
+    """A torn canonical checkpoint costs at most the autosave cadence:
+    --resume restores the newest retained round snapshot instead of
+    crashing, and the run completes."""
+    d = tmp_path / "run"
+    cv_train.main(_midrun_argv(d, 4))
+    _truncate(os.path.join(str(d), "ckpt_ResNet9.npz"))
+    cv_train.main(_midrun_argv(d, 6, ("--resume",)))
+    out = capsys.readouterr().out
+    assert "falling back to retained autosave" in out
+    assert "_r00000004.npz" in out
+    _, meta = _load_state(d)  # canonical rewritten by the resumed run
+    assert meta["epoch"] == 6
+
+
+def test_torn_canonical_without_fallback_raises(tmp_path):
+    """No retained snapshot to fall back to: the original error —
+    naming the torn file — propagates instead of silently training
+    from scratch."""
+    from commefficient_tpu.runtime.checkpoint import TornCheckpointError
+
+    cv_train.main(_argv(tmp_path, 1))
+    _truncate(os.path.join(str(tmp_path), "ckpt_ResNet9.npz"))
+    with pytest.raises(TornCheckpointError, match=r"ckpt_ResNet9\.npz"):
+        cv_train.main(_argv(tmp_path, 2, ("--resume",)))
+
+
+def test_round_autosave_retention_across_resume_boundary(tmp_path):
+    """--checkpoint_keep keeps pruning across a stop/resume: the
+    resumed run's autosaves displace the pre-resume snapshots instead
+    of accumulating beside them."""
+    d = tmp_path / "run"
+    cv_train.main(_midrun_argv(d, 4))
+    snaps = sorted(glob.glob(os.path.join(str(d), "ckpt_ResNet9_r*.npz")))
+    rounds = [int(os.path.basename(n).split("_r")[1].split(".")[0])
+              for n in snaps]
+    assert rounds == [2, 4]
+    cv_train.main(_midrun_argv(d, 8, ("--resume",)))
+    snaps = sorted(glob.glob(os.path.join(str(d), "ckpt_ResNet9_r*.npz")))
+    rounds = [int(os.path.basename(n).split("_r")[1].split(".")[0])
+              for n in snaps]
+    assert rounds == [6, 8], rounds
+    _, meta = _load_state(d)
+    assert meta["round_index"] == 8
