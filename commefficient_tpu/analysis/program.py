@@ -126,6 +126,18 @@ def build_specs() -> List[ProgramSpec]:
         ProgramSpec("sketch/quant2d", "sketch", "fused2d",
                     dict(error_type="virtual", virtual_momentum=0.9,
                          sketch_dtype="int8")),
+        # latency-hiding chunk pipeline (--overlap_depth): the table
+        # crosses the wire in min(depth, r) disjoint row chunks, one
+        # wire-dtype collective per chunk — the audit proves the
+        # per-chunk collective bytes still sum to the ledger's
+        # byte-exact total, one chunk-sized f32 scale pmax rides per
+        # chunk, and no f32 table (or chunk) ever crosses the ICI
+        ProgramSpec("sketch/overlap2", "sketch", "fused",
+                    dict(error_type="virtual", virtual_momentum=0.9,
+                         sketch_dtype="int8", overlap_depth=2)),
+        ProgramSpec("sketch/overlap2d", "sketch", "fused2d",
+                    dict(error_type="virtual", virtual_momentum=0.9,
+                         sketch_dtype="int8", overlap_depth=2)),
     ]
     per_client_kw = {
         "sketch": dict(error_type="virtual", virtual_momentum=0.9,
@@ -272,17 +284,32 @@ def audit_client_program(spec: ProgramSpec, mesh=None,
         return 0, wire_hlo
 
     ledger = int(cfg.upload_wire_bytes_per_client)
-    scale_shapes = ((cfg.num_rows, 1), (cfg.num_rows,))
+    # --overlap_depth chunking: the table crosses in min(depth, r)
+    # disjoint row chunks, so the wire collectives (and their f32
+    # scale pmaxes) compile at chunk-row shapes instead of the whole
+    # table's — the byte totals must still sum to the same ledger
+    depth = int(getattr(cfg, "overlap_depth", 1))
+    chunks = []
+    if depth > 1:
+        from commefficient_tpu.parallel.wire import row_chunks
+        chunks = row_chunks(cfg.num_rows, depth)
+    scale_shapes = [(cfg.num_rows, 1), (cfg.num_rows,)]
+    for _off, cnt in chunks:
+        scale_shapes += [(cnt, 1), (cnt,)]
     scale = (sum(
         hlo.matching_collective_bytes(ops, "all-reduce", "f32", s)
-        for s in scale_shapes) if wire in ("int8", "fp8") else 0)
+        for s in dict.fromkeys(scale_shapes))
+        if wire in ("int8", "fp8") else 0)
     M = model_axis_size(mesh) if spec.use_mesh else 1
     if M > 1:
         # 2D emission: the client-axis all-reduce and the model-axis
         # reduce-scatter both carry the (r, c/M) column shard — XLA
         # sometimes flattens the shard to 1-D, so both layouts key
         shard = (cfg.num_rows, cfg.num_cols // M)
-        shard_shapes = (shard, (shard[0] * shard[1],))
+        shard_shapes = [shard, (shard[0] * shard[1],)]
+        for _off, cnt in chunks:
+            shard_shapes += [(cnt, cfg.num_cols // M),
+                             (cnt * (cfg.num_cols // M),)]
         static, static_dt = _wire_bytes("all-reduce", shard_shapes)
         rs, rs_dt = _wire_bytes("reduce-scatter", shard_shapes)
         entry["uplink"] = {
@@ -296,9 +323,12 @@ def audit_client_program(spec: ProgramSpec, mesh=None,
             "relation": "sharded",
         }
     else:
-        static, static_dt = _wire_bytes(
-            "all-reduce", (cfg.transmit_shape,
-                           (int(np.prod(cfg.transmit_shape)),)))
+        table_shapes = [cfg.transmit_shape,
+                        (int(np.prod(cfg.transmit_shape)),)]
+        for _off, cnt in chunks:
+            table_shapes += [(cnt, cfg.num_cols),
+                             (cnt * cfg.num_cols,)]
+        static, static_dt = _wire_bytes("all-reduce", table_shapes)
         rs_dt = static_dt
         entry["uplink"] = {
             "ledger_bytes_per_client": ledger,
@@ -387,6 +417,36 @@ def audit_client_program(spec: ProgramSpec, mesh=None,
                 f"uplink: an f32 table-shaped all-reduce beside the "
                 f"{wire} ({static_dt}) wire path — the table is "
                 "crossing the ICI unquantized")
+    if chunks:
+        # chunk pipeline shape: one wire collective per row chunk,
+        # and no chunk ever crosses the ICI at f32 (an extra f32
+        # chunk materialisation would silently double the traffic
+        # the pipeline exists to hide)
+        kind = "reduce-scatter" if M > 1 else "all-reduce"
+        chunk_dt = rs_dt if M > 1 else static_dt
+        base_c = cfg.num_cols // M if M > 1 else cfg.num_cols
+        chunk_set = set()
+        for _off, cnt in chunks:
+            chunk_set.update({(cnt, base_c), (cnt * base_c,)})
+        n_ops = sum(
+            1 for op in ops if op.kind == kind
+            and any(d == chunk_dt and s in chunk_set
+                    for d, s, _b in op.shapes))
+        entry["uplink"]["overlap_depth"] = depth
+        entry["uplink"]["chunk_collectives"] = n_ops
+        if n_ops != len(chunks):
+            failures.append(
+                f"overlap: {n_ops} chunk-shaped {kind} op(s) for "
+                f"{len(chunks)} row chunks — the pipeline is not "
+                "issuing one wire collective per chunk")
+        if wire != "f32" and chunk_dt != "f32":
+            for s in sorted(chunk_set):
+                f32b = hlo.matching_reduce_bytes(ops, "f32", s)
+                if f32b:
+                    failures.append(
+                        f"overlap: {f32b} bytes f32-reduced at chunk "
+                        f"shape {s} — a chunk is crossing the ICI "
+                        "unquantized")
     entry.update(mode=spec.mode, path=spec.path, probes=spec.probes,
                  failures=failures)
     return entry

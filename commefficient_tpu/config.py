@@ -258,6 +258,16 @@ class Config:
     # loop bounds memory the same way, fed_worker.py:59-133). Ignored
     # on a multi-device mesh (the client axis is already divided).
     client_chunk: int = 0
+    # latency-hiding round pipeline (sketch mode): chunk sketch
+    # emission over table rows and issue each chunk's wire collective
+    # while the next chunk quantizes — XLA's latency-hiding scheduler
+    # overlaps collective i with chunk i+1's compute. 1 = today's
+    # serial program (bit-identical HLO); N > 1 splits the (r, c)
+    # table into min(N, r) row chunks. The folded result is unchanged:
+    # the sketch is linear over disjoint row chunks and quantization
+    # scales are per-row, so row-chunked quantize + harmonize +
+    # collective composes exactly with the whole-table path.
+    overlap_depth: int = 1
     # GPT-2: tokens per logits chunk in the chunked tied-head
     # cross-entropy (models/gpt2.py lm_nll_sums_chunked) — the
     # vocab-head temp memory scales with this chunk, not the sequence.
@@ -460,6 +470,8 @@ class Config:
                 "(the compiled cohort width is num_workers)"
         assert self.sketch_dtype in SKETCH_DTYPES, \
             "--sketch_dtype must be f32|bf16|int8|fp8"
+        assert self.overlap_depth >= 1, \
+            "--overlap_depth must be >= 1 (1 = serial round)"
         assert self.downlink_encoding in DOWNLINK_ENCODINGS, \
             "--downlink_encoding must be dense|delta"
         if self.mesh:
@@ -506,6 +518,12 @@ class Config:
             assert self.mode == "sketch", \
                 "--sketch_dtype != f32 requires --mode sketch " \
                 "(only the sketch table has a quantized wire path)"
+        if self.overlap_depth > 1:
+            # only the sketch table emits in disjoint row chunks;
+            # dense transmits have no chunkable collective payload
+            assert self.mode == "sketch", \
+                "--overlap_depth > 1 requires --mode sketch " \
+                "(only the sketch table emits in row chunks)"
         if self.mode == "sketch":
             # sketched SGD with local error/momentum is undefined: we
             # can't know which part of a sketch is "error"
@@ -804,6 +822,13 @@ def build_parser(default_lr: Optional[float] = None,
                         "the previous round's support for repeated "
                         "indices (accounting-level; the compiled "
                         "program is unchanged)")
+    parser.add_argument("--overlap_depth", type=int, default=1,
+                        help="latency-hiding round pipeline (sketch "
+                        "mode): emit the table in min(N, rows) row "
+                        "chunks and overlap each chunk's wire "
+                        "collective with the next chunk's "
+                        "emit+quantize (1 = serial round, "
+                        "bit-identical program)")
     parser.add_argument("--client_chunk", type=int, default=0,
                         help="scan the round's client fan-out in "
                         "chunks of this many clients (0 = all at "

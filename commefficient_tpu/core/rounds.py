@@ -166,6 +166,7 @@ def round_plan(cfg: Config) -> dict:
         "robust_agg": getattr(cfg, "robust_agg", "none"),
         "pipeline_depth": int(getattr(cfg, "pipeline_depth", 1)),
         "client_chunk": int(getattr(cfg, "client_chunk", 0)),
+        "overlap_depth": int(getattr(cfg, "overlap_depth", 1)),
         "clientstore": getattr(cfg, "clientstore", "device"),
         "async_buffer_size": int(getattr(cfg, "async_buffer_size", 0)
                                  or 0),
@@ -344,6 +345,19 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     wire = getattr(cfg, "sketch_dtype", "f32")
     quantized = cfg.mode == "sketch" and wire != "f32"
 
+    # Latency-hiding round pipeline (--overlap_depth, sketch mode):
+    # emit and cross the table in min(depth, r) disjoint row chunks,
+    # each chunk's collective issued as soon as its rows are quantized
+    # so XLA's latency-hiding scheduler runs chunk i's wire crossing
+    # under chunk i+1's compute. Per-row scales make every chunk's
+    # quantize + harmonize exactly the row slice of the whole-table
+    # algebra, so the folded table is bit-identical at any depth. A
+    # trace-time gate like probes/robust: depth 1 traces none of the
+    # chunked branches and the program stays bit-identical (pinned by
+    # test_probes_off_program_identical).
+    depth = int(getattr(cfg, "overlap_depth", 1))
+    overlap = cfg.mode == "sketch" and depth > 1
+
     def _quantize_for_collective(t, axes, n_addends):
         """Local f32 table -> (wire-dtype table, shared scale) ready
         for a wire-dtype psum/psum_scatter (parallel/wire.py owns the
@@ -358,6 +372,18 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         from commefficient_tpu.ops import quant
         q, scale = quant.quantize_table(t, wire)
         return quant.dequantize(q, scale)
+
+    def _qdq_local_overlapped(t):
+        """Single-shard crossing under --overlap_depth: per-row-chunk
+        quantize-dequantize, folded in emission order. Scales are
+        per-row, so each chunk's qdq IS the row slice of the
+        whole-table qdq — bit-identical result, chunked program (the
+        single-device mirror of the chunked collective pipeline)."""
+        from commefficient_tpu.core.server import fold_row_chunks
+        from commefficient_tpu.parallel.wire import row_chunks
+        return fold_row_chunks(
+            _qdq_local(jax.lax.slice_in_dim(t, off, off + cnt, axis=0))
+            for off, cnt in row_chunks(t.shape[0], depth))
 
     def _partial_table_emit(g):
         """2D-mesh sketch emission for one model peer: sketch ONLY
@@ -384,6 +410,36 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         idx = start + jnp.arange(n_loc, dtype=jnp.int32)
         vals = jnp.where(idx < d, vals, 0.0)
         partial = sketch.sketch_sparse(jnp.minimum(idx, d - 1), vals)
+        if overlap:
+            # chunked emission: slice the partial table into disjoint
+            # row chunks and issue each chunk's model-axis
+            # reduce-scatter as soon as its rows are quantized — the
+            # unrolled interleaving is what lets the scheduler overlap
+            # chunk i's collective with chunk i+1's quantize. Returns
+            # the per-chunk results in row order; the client-axis
+            # crossing (_client_psum) folds them back. Same headroom
+            # algebra per chunk (C*M addends), same ledger bytes: N
+            # collectives of cnt·c/M wire elements sum to one of
+            # r·c/M.
+            from commefficient_tpu.parallel import wire as wirex
+            from commefficient_tpu.parallel.mesh import (
+                CLIENT_AXIS, client_axis_size)
+            C = client_axis_size(mesh)
+            chunks = []
+            for off, cnt in wirex.row_chunks(sketch.r, depth):
+                part = jax.lax.slice_in_dim(partial, off, off + cnt,
+                                            axis=0)
+                if quantized:
+                    q, scale = _quantize_for_collective(
+                        part, (CLIENT_AXIS, MODEL_AXIS), C * M)
+                    chunks.append(
+                        (wirex.wire_reduce_scatter(q, MODEL_AXIS),
+                         scale))
+                else:
+                    chunks.append(jax.lax.psum_scatter(
+                        part, MODEL_AXIS, scatter_dimension=1,
+                        tiled=True))
+            return chunks
         if quantized:
             # quantize the shard-local partial BEFORE the collective:
             # the reduce-scatter moves wire-dtype bytes (r·c·wb per
@@ -558,7 +614,27 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 """The table's client-axis all-reduce — in wire dtype
                 on the quantized path (the table crosses the ICI at
                 wire width; dequantized right after, so the server
-                only ever sees f32)."""
+                only ever sees f32). Under --overlap_depth the
+                crossing runs per row chunk, interleaved with the
+                chunk quantizes, and the chunk-ordered fold
+                (core/server.fold_row_chunks) reassembles the
+                table."""
+                if overlap:
+                    from commefficient_tpu.core.server import \
+                        fold_row_chunks
+                    from commefficient_tpu.parallel import wire as wirex
+                    if shard2d:
+                        # emit handed back per-chunk reduce-scattered
+                        # shards (quantized: with their scales)
+                        if quantized:
+                            return fold_row_chunks(
+                                wirex.wire_allreduce(q, s, CLIENT_AXIS)
+                                for q, s in t)
+                        return fold_row_chunks(
+                            jax.lax.psum(ch, CLIENT_AXIS) for ch in t)
+                    return wirex.chunked_quantize_allreduce(
+                        t, wire if quantized else "f32",
+                        (CLIENT_AXIS,), C, CLIENT_AXIS, depth)
                 if not quantized:
                     return jax.lax.psum(t, CLIENT_AXIS)
                 from commefficient_tpu.parallel import wire as wirex
@@ -627,7 +703,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             aggregated, metrics, dense_g = _fused_local(
                 ps_weights, batch, total, 1, with_dense=True, cw=cw)
             if quantized:
-                aggregated = _qdq_local(aggregated)
+                aggregated = (_qdq_local_overlapped(aggregated)
+                              if overlap else _qdq_local(aggregated))
         else:
             aggregated, metrics = _fused_local(ps_weights, batch,
                                                total, 1, cw=cw)
@@ -635,7 +712,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                 # single-shard wire crossing: quantize-dequantize the
                 # aggregated table at full range (exactly the NumPy
                 # mirror's np_quantize_table/np_dequantize_table)
-                aggregated = _qdq_local(aggregated)
+                aggregated = (_qdq_local_overlapped(aggregated)
+                              if overlap else _qdq_local(aggregated))
         pr = None
         if probes:
             pr = _agg_probes(aggregated)
@@ -731,7 +809,7 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             aggregated = _sketch_after_local_sum(
                 sketch, t_fold, mesh,
                 emit=_partial_table_emit if shard2d_late else None,
-                wire=wire) / total
+                wire=wire, depth=depth if overlap else 1) / total
         else:
             aggregated = jnp.sum(t_fold, axis=0) / total
 
@@ -847,7 +925,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
                  client_states),
                 (ids_p, rngs_p, batch_p))
             if quantized:
-                table = _qdq_local(table)
+                table = (_qdq_local_overlapped(table)
+                         if overlap else _qdq_local(table))
             aggregated = table / total
         else:
             # dense accumulator: transmit_shape covers both dense (d,)
@@ -865,7 +944,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             if sketch_late:
                 table = sketch.sketch(acc)
                 if quantized:
-                    table = _qdq_local(table)
+                    table = (_qdq_local_overlapped(table)
+                             if overlap else _qdq_local(table))
                 aggregated = table / total
                 dense_g = acc / total
             else:
@@ -945,7 +1025,7 @@ def _round_bn_stats(stats_fn, ps_weights, batch):
 
 
 def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh,
-                            emit=None, wire="f32"):
+                            emit=None, wire="f32", depth=1):
     """(W, d) dense transmits -> (r, c) summed table: per-device local
     dense sum, one sketch per device, psum of tables over the mesh.
     ``emit`` (2D mesh, sketch mode) replaces the full per-device
@@ -955,7 +1035,11 @@ def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh,
     ``wire`` != "f32" quantizes the table before the collective
     (ops/quant.py — the collective payload drops to wire width) and
     dequantizes after; with an ``emit``, the emit closure already did
-    the quantize + reduce-scatter and hands back ``(q, scale)``."""
+    the quantize + reduce-scatter and hands back ``(q, scale)``.
+    ``depth`` > 1 (--overlap_depth) crosses the table in disjoint
+    row chunks — collective i interleaved with chunk i+1's quantize —
+    and folds the chunks back in row order (an ``emit`` then hands
+    back the per-chunk list)."""
     from commefficient_tpu.parallel.mesh import (CLIENT_AXIS,
                                                  client_axis_size,
                                                  replicated_spec,
@@ -968,6 +1052,22 @@ def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh,
 
         def block(local):  # (W/C, d) on each client-axis shard
             g = jnp.sum(local, axis=0)
+            if depth > 1:
+                from commefficient_tpu.core.server import \
+                    fold_row_chunks
+                from commefficient_tpu.parallel import wire as wirex
+                if emit is not None:
+                    chunks = emit(g)  # per-row-chunk scattered shards
+                    if wire != "f32":
+                        return fold_row_chunks(
+                            wirex.wire_allreduce(q, s, CLIENT_AXIS)
+                            for q, s in chunks)
+                    return fold_row_chunks(
+                        jax.lax.psum(ch, CLIENT_AXIS)
+                        for ch in chunks)
+                return wirex.chunked_quantize_allreduce(
+                    sketch.sketch(g), wire, (CLIENT_AXIS,), C,
+                    CLIENT_AXIS, depth)
             if wire != "f32":
                 from commefficient_tpu.parallel import wire as wirex
                 if emit is None:
@@ -987,6 +1087,17 @@ def _sketch_after_local_sum(sketch: CountSketch, transmit, mesh,
     table = sketch.sketch(jnp.sum(transmit, axis=0))
     if wire != "f32":
         from commefficient_tpu.ops import quant
+        if depth > 1:
+            # single-device mirror of the chunked crossing: per-chunk
+            # qdq (per-row scales -> bit-identical, chunked program)
+            from commefficient_tpu.core.server import fold_row_chunks
+            from commefficient_tpu.parallel.wire import row_chunks
+            return fold_row_chunks(
+                quant.dequantize(*quant.quantize_table(
+                    jax.lax.slice_in_dim(table, off, off + cnt,
+                                         axis=0),
+                    wire))
+                for off, cnt in row_chunks(table.shape[0], depth))
         return quant.dequantize(*quant.quantize_table(table, wire))
     return table
 

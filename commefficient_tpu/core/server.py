@@ -63,6 +63,16 @@ class ServerState(NamedTuple):
         return ServerState(put(Vvelocity), put(Verror))
 
 
+def fold_row_chunks(chunks) -> jax.Array:
+    """Chunk-ordered fold of the overlap pipeline's per-row-chunk
+    collectives (``--overlap_depth``): reassemble the dequantized row
+    chunks into the (r, c[/M]) table in emission order. The chunks
+    cover disjoint row ranges, so the fold is pure concatenation — no
+    summation — and is bit-exact regardless of which chunk's
+    collective completed first on the wire."""
+    return jnp.concatenate(list(chunks), axis=0)
+
+
 class ServerUpdate(NamedTuple):
     # subtract from ps_weights; None when ``sparse_update`` carries
     # the k-sparse form instead (large-d sketch mode: materialising a

@@ -396,7 +396,7 @@ class CountSketch:
         return jax.vmap(one_row)(jnp.arange(self.r, dtype=jnp.uint32),
                                  rot_dev)
 
-    def sketch_quantized(self, v: jax.Array, wire: str):
+    def sketch_quantized(self, v: jax.Array, wire: str, rows=None):
         """Dense (d,) vector -> (wire-dtype (r, c) table, (r, 1) f32
         rowmax): the fused emit + local-quantize wire path. On the
         Pallas backend the f32 table only ever exists in the kernel's
@@ -404,11 +404,24 @@ class CountSketch:
         backends sketch then quantize (same algebra, ops/quant.py
         quantize_local), so the two paths agree exactly on a given
         table. Callers harmonize the result onto the shared global
-        scale before the wire collective (core/rounds.py)."""
+        scale before the wire collective (core/rounds.py).
+
+        ``rows`` — optional ``(offset, count)`` row chunk
+        (--overlap_depth chunked emission): emit + quantize ONLY those
+        table rows. The Pallas kernel then runs with a chunk-sized
+        VMEM scratch, the chunk's rotation-row slice and sign streams
+        keyed by the absolute row, so the chunk is bit-identical to
+        the same rows of a whole-table call (per-row scales make the
+        quantization algebra row-separable)."""
         from commefficient_tpu.ops.quant import quantize_local
+        off, cnt = rows if rows is not None else (0, self.r)
+        assert 0 <= off and off + cnt <= self.r, (off, cnt, self.r)
         if wire == "bf16":
             # scale-free cast — nothing to fuse
-            return quantize_local(self.sketch(v), wire)
+            q, rm = quantize_local(self.sketch(v), wire)
+            if rows is not None:
+                q = jax.lax.slice_in_dim(q, off, off + cnt, axis=0)
+            return q, rm
         backend = self._resolve_backend()
         if backend in ("pallas", "pallas_interpret"):
             from commefficient_tpu.ops.sketch_pallas import \
@@ -419,13 +432,21 @@ class CountSketch:
             _, sign_seed = self._seeds()
             sgn = (self._packed_signs_traced()
                    if self._packed_sign_kernels else None)
+            rot = self._rotations()
+            if rows is not None:
+                rot = rot[off:off + cnt]
             return sketch_quant_pallas(
-                vp, jnp.asarray(self._rotations()), self.c, self.r,
+                vp, jnp.asarray(rot), self.c, cnt,
                 int(sign_seed), wire,
                 backend == "pallas_interpret",
                 one_mix=self._one_mix_signs,
-                rot_step=self.rot_lanes, sgn=sgn)
-        return quantize_local(self.sketch(v), wire)
+                rot_step=self.rot_lanes, sgn=sgn,
+                row_offset=off)
+        table = self.sketch(v)
+        if rows is not None:
+            table = jax.lax.slice_in_dim(table, off, off + cnt,
+                                         axis=0)
+        return quantize_local(table, wire)
 
     # --- recovery --------------------------------------------------------
 

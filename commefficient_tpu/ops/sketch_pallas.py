@@ -191,19 +191,26 @@ def _median_network(vals):
     return 0.5 * (v[n // 2 - 1] + v[n // 2])
 
 
-def _flips_for_chunk(t, sgn_block, one_mix: bool, seed, c, S, L, r):
+def _flips_for_chunk(t, sgn_block, one_mix: bool, seed, c, S, L, r,
+                     row_offset: int = 0):
     """Per-row sign-bit flip masks for chunk ``t``, cheapest source
     first: a streamed packed-sign block (bit ``row`` of a u8 per
     element — 2 shift/and ops per row, no hashing), else the in-kernel
-    one-mix hash (r <= 16), else one mix per (row, coord)."""
+    one-mix hash (r <= 16), else one mix per (row, coord).
+    ``row_offset`` shifts every row index by the table-row offset of a
+    chunked call (--overlap_depth): the sign stream is keyed by the
+    ABSOLUTE table row, so a chunk's rows flip identically to the same
+    rows of a whole-table call."""
     if sgn_block is not None:
         b32 = sgn_block.astype(jnp.uint32)
-        return [(b32 << (31 - row)) & jnp.uint32(0x80000000)
-                for row in range(r)]
+        return [(b32 << (31 - (row_offset + row)))
+                & jnp.uint32(0x80000000) for row in range(r)]
     if one_mix:
         h = _sign_hash_chunk(t, seed, c, S, L, r)
-        return [_flip_from_hash(h, row) for row in range(r)]
-    return [_flip_chunk(t, row, seed, c, S, L) for row in range(r)]
+        return [_flip_from_hash(h, row_offset + row)
+                for row in range(r)]
+    return [_flip_chunk(t, row_offset + row, seed, c, S, L)
+            for row in range(r)]
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
@@ -283,11 +290,13 @@ def sketch_pallas(vp, rot, c: int, r: int, sign_seed: int,
     return out.reshape(r, c)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.jit,
+                   static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 11))
 def sketch_quant_pallas(vp, rot, c: int, r: int, sign_seed: int,
                         wire: str = "int8", interpret: bool = False,
                         lanes: int | None = None, one_mix: bool = False,
-                        rot_step: int = 0, sgn=None):
+                        rot_step: int = 0, sgn=None,
+                        row_offset: int = 0):
     """Fused emit + quantize: ``sketch_pallas`` whose f32 table lives
     ONLY in a VMEM scratch accumulator — after the last chunk the
     kernel computes each row's maxabs, quantizes the row at full wire
@@ -302,7 +311,16 @@ def sketch_quant_pallas(vp, rot, c: int, r: int, sign_seed: int,
 
     Returns ``(q, rowmax)``: q (r, c) in the wire dtype, rowmax
     (r, 1) f32. ``wire`` is "int8" or "fp8" (bf16 has no scale and is
-    a plain cast of ``sketch_pallas``'s output — nothing to fuse)."""
+    a plain cast of ``sketch_pallas``'s output — nothing to fuse).
+
+    ``row_offset`` (--overlap_depth chunked emission): ``r`` is then
+    the CHUNK row count and ``rot`` the chunk's row slice of the
+    rotation table; the sign streams key off the absolute row
+    ``row_offset + row``, so each chunk's output is bit-identical to
+    the same rows of a whole-table call. The VMEM scratch and the
+    compiler's VMEM budget derive from the chunk row count — a
+    depth-N pipeline holds one chunk-sized accumulator per in-flight
+    chunk instead of N full-table scratches."""
     from commefficient_tpu.ops.quant import QMAX, wire_jnp_dtype
     assert wire in QMAX, wire
     qmax = QMAX[wire]
@@ -314,6 +332,11 @@ def sketch_quant_pallas(vp, rot, c: int, r: int, sign_seed: int,
     seed = np.uint32(sign_seed)
     sublane = rot_step > 0 and rot_step % L == 0
     packed = sgn is not None
+    assert row_offset >= 0
+    if one_mix:
+        # the one-mix hash carries 16 sign bits — absolute rows of a
+        # chunked call must stay inside them
+        assert row_offset + r <= 16, (row_offset, r)
 
     def kernel(rot_ref, v_ref, *refs):
         if packed:
@@ -329,7 +352,7 @@ def sketch_quant_pallas(vp, rot, c: int, r: int, sign_seed: int,
         chunk = v_ref[:]
         flips = _flips_for_chunk(
             t, sgn_ref[:] if packed else None,
-            one_mix, seed, c, S, L, r)
+            one_mix, seed, c, S, L, r, row_offset)
         lane = jax.lax.broadcasted_iota(jnp.int32, (S, L), 1)
         for row in range(r):
             signed = _apply_flip(chunk, flips[row])

@@ -150,6 +150,7 @@ class FedModel:
         self._prefetcher = None
         self._participant_feed = None
         self._store_pending = None
+        self._prefetch_after_writeback = False
         if self.clientstore == "host":
             if int(getattr(args, "pipeline_depth", 1)) > 1:
                 raise ValueError(
@@ -610,7 +611,18 @@ class FedModel:
             alive = np.asarray(batch["mask"]).reshape(W, -1) \
                 .sum(axis=1) > 0
             self._store_pending = (np.asarray(ids_np, np.int64), alive)
-            self._submit_prefetch()
+            if int(getattr(args, "overlap_depth", 1)) > 1:
+                # latency-hiding pipeline: a prefetch staged now
+                # snapshots the store BEFORE opt.step()'s write-back,
+                # so take() would synchronously re-gather every repeat
+                # participant's row next round. Defer the submit to
+                # step(), right after the write-back lands — the
+                # background gather then overlaps the downlink
+                # delta-encode bookkeeping (note_update /
+                # _note_delta_support) instead of being undone by it.
+                self._prefetch_after_writeback = True
+            else:
+                self._submit_prefetch()
         else:
             self.pending_client_ids = _state_ids(ids, dev_batch)
         self.round_index += 1
@@ -1035,6 +1047,13 @@ class FedOptimizer:
         # host client store: the round's participant rows (incl. any
         # server-side velocity rewrite above) go back to the host now
         m._store_writeback()
+        if m._prefetch_after_writeback:
+            # --overlap_depth > 1: the gather staged here sees the
+            # post-write-back row versions, so next round's take() is
+            # patch-free while the worker thread hides the gather
+            # under the delta-encode host work below
+            m._prefetch_after_writeback = False
+            m._submit_prefetch()
         if support is None:
             # dense-update modes. fedavg/momentum updates touch every
             # coordinate; the exceptions that don't: a zero scalar LR

@@ -129,7 +129,10 @@ def metrics_from_records(records) -> Dict[str, Dict]:
     for name, vals in sorted(spans.items()):
         out[f"span:{name}:ms"] = summarize_samples(vals, "lower")
     for name, vals in sorted(device.items()):
-        better = "higher" if name == "roofline_utilization" else "lower"
+        # more hidden collective time is better, like utilization;
+        # every other device bucket is time spent (lower wins)
+        better = ("higher" if name in ("roofline_utilization",
+                                       "overlapped_s") else "lower")
         out[f"device:{name}"] = summarize_samples(vals, better)
     for name, entry in sorted(bench.items()):
         out[name] = summarize_samples(entry["samples"],
@@ -182,31 +185,48 @@ def async_suffix(async_k) -> str:
     return f"a{k}" if k > 0 else ""
 
 
+def overlap_suffix(overlap_depth) -> str:
+    """Canonical key fragment for a chunked-emission run: ``o<N>``
+    when ``--overlap_depth N`` > 1 was on, ``""`` for the serial
+    round every pre-overlap pin measured (depth 1 is HLO-identical to
+    the pre-overlap program, so it keeps the bare key). A pipelined
+    round's collective profile is a different experiment from the
+    serial one — an o4 ledger must never resolve (or overwrite) an
+    o1/bare pin, and there is NO cross-depth fallback (like the wire
+    and async fragments, unlike the mesh fragment)."""
+    n = int(overlap_depth or 0)
+    return f"o{n}" if n > 1 else ""
+
+
 def topology_key(device_count=None, process_count=None,
                  mesh_shape=None, wire_dtype=None,
-                 async_k=None) -> str:
+                 async_k=None, overlap_depth=None) -> str:
     """Baseline entry key for one topology point. ``d<D>p<P>`` when
     both counts are known — suffixed ``m<C>x<M>`` for 2D-mesh runs
     (a 4x2 and an 8x1 run on the same 8 chips are different programs,
     not one noise band), ``q<dtype>`` for quantized-wire runs
-    (int8 vs f32 collectives are different experiments) and ``a<K>``
+    (int8 vs f32 collectives are different experiments), ``a<K>``
     for buffered-arrival runs (an async fold overlaps work a barrier
-    round waits for) — :data:`ANY_TOPOLOGY` otherwise: unknown
+    round waits for) and ``o<N>`` for chunked-emission runs (a
+    pipelined collective profile is a different experiment from the
+    serial one) — :data:`ANY_TOPOLOGY` otherwise: unknown
     topologies form their own bucket rather than silently matching a
-    counted one. Quantized/async runs with unknown counts still
-    split off (``any-q<dtype>``, ``any-a<K>``)."""
+    counted one. Quantized/async/overlapped runs with unknown counts
+    still split off (``any-q<dtype>``, ``any-a<K>``, ``any-o<N>``)."""
     if device_count is None or process_count is None:
-        w = wire_suffix(wire_dtype) + async_suffix(async_k)
+        w = (wire_suffix(wire_dtype) + async_suffix(async_k)
+             + overlap_suffix(overlap_depth))
         return f"{ANY_TOPOLOGY}-{w}" if w else ANY_TOPOLOGY
     return (f"d{int(device_count)}p{int(process_count)}"
             f"{mesh_suffix(mesh_shape)}{wire_suffix(wire_dtype)}"
-            f"{async_suffix(async_k)}")
+            f"{async_suffix(async_k)}{overlap_suffix(overlap_depth)}")
 
 
 def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                         device_count=None, process_count=None,
                         config_hash: str = "", mesh_shape=None,
-                        wire_dtype=None, async_k=None) -> Dict:
+                        wire_dtype=None, async_k=None,
+                        overlap_depth=None) -> Dict:
     entry = {"ts": clock.wall(), "source": source, "metrics": metrics}
     if device_count is not None:
         entry["device_count"] = int(device_count)
@@ -222,6 +242,8 @@ def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
         entry["wire_dtype"] = str(wire_dtype)
     if async_suffix(async_k):
         entry["async_buffer_size"] = int(async_k)
+    if overlap_suffix(overlap_depth):
+        entry["overlap_depth"] = int(overlap_depth)
     return entry
 
 
@@ -229,16 +251,16 @@ def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
                   extra: Dict = None, device_count=None,
                   process_count=None, config_hash: str = "",
                   mesh_shape=None, wire_dtype=None,
-                  async_k=None) -> Dict:
+                  async_k=None, overlap_depth=None) -> Dict:
     """A fresh schema-2 baseline holding one topology entry."""
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k)
+                       wire_dtype, async_k, overlap_depth)
     base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
             "topologies": {key: make_topology_entry(
                 metrics, source=source, device_count=device_count,
                 process_count=process_count, config_hash=config_hash,
                 mesh_shape=mesh_shape, wire_dtype=wire_dtype,
-                async_k=async_k)}}
+                async_k=async_k, overlap_depth=overlap_depth)}}
     if extra:
         base.update(extra)
     return base
@@ -262,7 +284,7 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
                     source: str = "", device_count=None,
                     process_count=None, config_hash: str = "",
                     mesh_shape=None, wire_dtype=None,
-                    async_k=None) -> Dict:
+                    async_k=None, overlap_depth=None) -> Dict:
     """Insert/replace ONE topology's entry, leaving every other
     topology point untouched — how the gate CLI re-captures the
     8-device headline without disturbing the single-chip one.
@@ -272,19 +294,20 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
          "topologies": {}}
     base["topologies"] = dict(base.get("topologies", {}))
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k)
+                       wire_dtype, async_k, overlap_depth)
     base["topologies"][key] = make_topology_entry(
         metrics, source=source, device_count=device_count,
         process_count=process_count, config_hash=config_hash,
         mesh_shape=mesh_shape, wire_dtype=wire_dtype,
-        async_k=async_k)
+        async_k=async_k, overlap_depth=overlap_depth)
     base["ts"] = clock.wall()
     return base
 
 
 def baseline_entry(baseline: Dict, device_count=None,
                    process_count=None, mesh_shape=None,
-                   wire_dtype=None, async_k=None):
+                   wire_dtype=None, async_k=None,
+                   overlap_depth=None):
     """The topology entry ``compare`` gates against, or None when the
     baseline has no entry for this topology. A 2D-mesh run resolves
     its exact ``d<D>p<P>m<C>x<M>`` entry first and falls back to the
@@ -310,13 +333,16 @@ def baseline_entry(baseline: Dict, device_count=None,
     topologies = baseline.get("topologies", {})
     entry = topologies.get(
         topology_key(device_count, process_count, mesh_shape,
-                     wire_dtype, async_k))
+                     wire_dtype, async_k, overlap_depth))
     if entry is None and mesh_suffix(mesh_shape):
-        # drop only the mesh fragment; the wire AND async fragments
-        # stay — there is no cross-dtype or cross-mode fallback
+        # drop only the mesh fragment; the wire, async AND overlap
+        # fragments stay — there is no cross-dtype, cross-mode or
+        # cross-depth fallback (an o2 pipelined round has a different
+        # collective schedule than the serial o1 program)
         entry = topologies.get(
             topology_key(device_count, process_count,
-                         wire_dtype=wire_dtype, async_k=async_k))
+                         wire_dtype=wire_dtype, async_k=async_k,
+                         overlap_depth=overlap_depth))
     return entry
 
 
@@ -329,7 +355,8 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
             rel_tol: float = REL_TOL,
             mad_k: float = MAD_K, device_count=None,
             process_count=None, mesh_shape=None,
-            wire_dtype=None, async_k=None) -> Dict:
+            wire_dtype=None, async_k=None,
+            overlap_depth=None) -> Dict:
     """Gate ``metrics`` against ``baseline``'s entry for this
     topology. Returns::
 
@@ -343,9 +370,10 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
     when the baseline has no entry for this topology — an ungated
     topology point must fail loudly, not pass silently."""
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k)
+                       wire_dtype, async_k, overlap_depth)
     entry = baseline_entry(baseline, device_count, process_count,
-                           mesh_shape, wire_dtype, async_k)
+                           mesh_shape, wire_dtype, async_k,
+                           overlap_depth)
     if entry is None:
         have = ", ".join(sorted(baseline.get("topologies", {}))) \
             or "none"

@@ -60,6 +60,12 @@ changes:
              skew stats (max/p95 enter-delta seconds, straggler
              device id, matched-collective count). These are the only
              dict-valued entries allowed inside ``device_time``.
+             Additive numeric buckets stay schema-4: ``overlapped_s``
+             (collective wall time hidden behind some lane's compute,
+             telemetry/trace.py — ``collective_s - overlapped_s`` is
+             the serial collective share) appears on traces parsed
+             after --overlap_depth landed; readers treat any extra
+             numeric bucket generically.
 ``process`` — optional on every record: the jax process index that
              observed it. Stamped by the per-process ledger shards
              (``<ledger>.p<k>.jsonl``, telemetry/core.py) so merged

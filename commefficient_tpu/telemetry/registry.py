@@ -121,6 +121,18 @@ def run_async_k(manifest: dict):
     return k if k > 0 else None
 
 
+def run_overlap_depth(manifest: dict):
+    """The run's round-pipeline chunk depth (``--overlap_depth``)
+    from its recorded config, or None for serial / pre-overlap
+    manifests — depth 1 IS the serial round, so only depth > 1 keys a
+    distinct experiment."""
+    cfg = manifest.get("config") or {}
+    if cfg.get("mode") != "sketch":
+        return None
+    n = int(cfg.get("overlap_depth") or 0)
+    return n if n > 1 else None
+
+
 def run_segments(manifest: dict) -> list:
     """The run's per-topology segments (``topology_segments``, stamped
     by the trainers from checkpoint lineage for resumed runs). Empty
@@ -153,18 +165,22 @@ def run_key(manifest: dict) -> tuple:
     not an identity: the same config on 1 vs 8 devices is a scaling
     experiment, not a regression. 2D-mesh runs append their
     ``m<C>x<M>`` fragment, quantized-wire runs their ``q<dtype>``
-    fragment and buffered-arrival runs their ``a<K>`` fragment (a 4x2
-    and an 8x1 program on the same chips — or an int8 and an f32
-    wire, or a buffered and a barrier round — are different
-    experiments); 1-D f32 synchronous runs keep the historical
-    3-tuple, so old manifests stay comparable to each other."""
+    fragment, buffered-arrival runs their ``a<K>`` fragment and
+    chunk-pipelined runs their ``o<N>`` fragment (a 4x2 and an 8x1
+    program on the same chips — or an int8 and an f32 wire, or a
+    buffered and a barrier round, or a depth-2 pipelined and a serial
+    round — are different experiments); 1-D f32 synchronous serial
+    runs keep the historical 3-tuple, so old manifests stay
+    comparable to each other."""
     from commefficient_tpu.telemetry.gate import (async_suffix,
                                                   mesh_suffix,
+                                                  overlap_suffix,
                                                   wire_suffix)
     key = (manifest.get("config_hash") or "",) + run_topology(manifest)
     suffix = (mesh_suffix(run_mesh_shape(manifest))
               + wire_suffix(run_wire_dtype(manifest))
-              + async_suffix(run_async_k(manifest)))
+              + async_suffix(run_async_k(manifest))
+              + overlap_suffix(run_overlap_depth(manifest)))
     return key + (suffix,) if suffix else key
 
 

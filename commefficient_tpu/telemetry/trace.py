@@ -241,6 +241,44 @@ def _clip(intervals, lo, hi):
     return out
 
 
+def _subtract(a, b):
+    """``a \\ b`` for merged, sorted interval lists — a lane's compute
+    slice is its busy union minus its collective/transfer cover."""
+    out = []
+    j = 0
+    for lo, hi in a:
+        cur = lo
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b) and b[k][0] < hi:
+            s, e = b[k]
+            if s > cur:
+                out.append((cur, s))
+            cur = max(cur, e)
+            k += 1
+        if cur < hi:
+            out.append((cur, hi))
+    return out
+
+
+def _intersect(a, b):
+    """``a ∩ b`` for merged, sorted interval lists — the overlapped
+    bucket is collective ∩ (some lane's compute)."""
+    out = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            out.append((lo, hi))
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
 # --- per-round attribution ---------------------------------------------
 
 
@@ -352,6 +390,7 @@ def attribute_rounds(events) -> dict:
 
         {round_index: {"window_s", "busy_s", "compute_s",
                        "collective_s", "transfer_s", "host_gap_s",
+                       "overlapped_s",
                        "per_device": {device_id: {...}},
                        "skew": {...}}}
 
@@ -362,6 +401,14 @@ def attribute_rounds(events) -> dict:
     window - busy``, so the four buckets sum to the window exactly.
     The aggregate buckets pool every lane's intervals — identical to
     the schema-v3 computation bit-for-bit.
+
+    ``overlapped_s`` is the slice of ``collective_s`` that ran
+    concurrently with some lane's compute (pooled collective union ∩
+    union of per-lane compute) — an overlay on the partition, not a
+    fifth bucket: the four buckets above still sum to the window
+    exactly, and ``collective_s - overlapped_s`` is the serial
+    collective share the --overlap_depth pipeline is built to
+    collapse.
 
     ``per_device[<id>]`` repeats the bucket math on that device's own
     interval set and splits its collective bucket into ``wait_s``
@@ -414,6 +461,21 @@ def attribute_rounds(events) -> dict:
         # (disjoint buckets: the four sum to the window)
         xfer_us = _measure(_union(t + c)) - coll_us
         win_us = hi - lo
+        # overlapped: wall time where the pooled collective union runs
+        # concurrently with some lane's COMPUTE (its busy minus its
+        # own collective/transfer cover) — the slice of collective_s
+        # the --overlap_depth pipeline hid behind compute. An overlay
+        # on the partition, not a fifth bucket: compute + collective +
+        # transfer + host_gap still sum to the window exactly, and
+        # 0 <= overlapped_s <= collective_s; collective_s -
+        # overlapped_s is the SERIAL collective share.
+        comp_iv = []
+        for slot in by_dev.values():
+            d_busy = _union(_clip(slot["dev"], lo, hi))
+            d_other = _union(_clip(slot["coll"], lo, hi)
+                             + _clip(slot["xfer"], lo, hi))
+            comp_iv.extend(_subtract(d_busy, d_other))
+        ovl_us = _measure(_intersect(c, _union(comp_iv)))
         buckets = {
             "window_s": round(win_us / 1e6, 6),
             "busy_s": round(busy_us / 1e6, 6),
@@ -421,6 +483,7 @@ def attribute_rounds(events) -> dict:
             "collective_s": round(coll_us / 1e6, 6),
             "transfer_s": round(xfer_us / 1e6, 6),
             "host_gap_s": round((win_us - busy_us) / 1e6, 6),
+            "overlapped_s": round(min(ovl_us, coll_us) / 1e6, 6),
         }
         groups = _collective_groups(coll_insts, lo, hi)
         wait_iv, skew = _skew_stats(groups)

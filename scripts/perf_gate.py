@@ -66,25 +66,30 @@ def load_ledger_records(path):
 
 def resolve_topology(manifest=None, records=(), device_count=None,
                      process_count=None, mesh_shape=None,
-                     wire_dtype=None, async_k=None):
+                     wire_dtype=None, async_k=None,
+                     overlap_depth=None):
     """The run's (device_count, process_count, mesh_shape,
-    wire_dtype, async_k) for baseline keying: CLI overrides win, then
-    the run manifest, then the ledger's meta record (``num_devices``;
-    pre-fleet metas never recorded a process count — those ran the
-    single-process path, so 1). All-None when nothing knows — such
-    runs gate under the ``any`` bucket. ``mesh_shape`` follows the
-    same chain: a CLI "CxM" string, the manifest's recorded dict, or
-    the meta record's ``mesh_shape``; 1-D runs resolve to None (their
-    key is the historical mesh-less one). ``wire_dtype`` likewise:
-    CLI, the manifest config's ``sketch_dtype``, the meta record's
-    round plan / cost model; f32 and pre-quantization runs resolve to
-    None (the historical unsuffixed key). ``async_k`` likewise: CLI,
-    the manifest config's ``async_buffer_size``, the meta record's
-    round plan; synchronous and pre-async runs resolve to None."""
+    wire_dtype, async_k, overlap_depth) for baseline keying: CLI
+    overrides win, then the run manifest, then the ledger's meta
+    record (``num_devices``; pre-fleet metas never recorded a process
+    count — those ran the single-process path, so 1). All-None when
+    nothing knows — such runs gate under the ``any`` bucket.
+    ``mesh_shape`` follows the same chain: a CLI "CxM" string, the
+    manifest's recorded dict, or the meta record's ``mesh_shape``;
+    1-D runs resolve to None (their key is the historical mesh-less
+    one). ``wire_dtype`` likewise: CLI, the manifest config's
+    ``sketch_dtype``, the meta record's round plan / cost model; f32
+    and pre-quantization runs resolve to None (the historical
+    unsuffixed key). ``async_k`` likewise: CLI, the manifest config's
+    ``async_buffer_size``, the meta record's round plan; synchronous
+    and pre-async runs resolve to None. ``overlap_depth`` likewise:
+    CLI, the manifest config, the meta record's round plan; depth-1
+    (serial) and pre-overlap runs resolve to None."""
     dc, pc = device_count, process_count
     ms = parse_mesh_shape(mesh_shape)
     wd = wire_dtype
     ak = async_k
+    od = overlap_depth
     if manifest is not None:
         mdc, mpc = registry.run_topology(manifest)
         dc = mdc if dc is None else dc
@@ -95,8 +100,10 @@ def resolve_topology(manifest=None, records=(), device_count=None,
             wd = registry.run_wire_dtype(manifest)
         if ak is None:
             ak = registry.run_async_k(manifest)
+        if od is None:
+            od = registry.run_overlap_depth(manifest)
     if dc is None or pc is None or ms is None or wd is None \
-            or ak is None:
+            or ak is None or od is None:
         for rec in records:
             if rec.get("kind") != "meta":
                 continue
@@ -117,15 +124,19 @@ def resolve_topology(manifest=None, records=(), device_count=None,
                     wd = cost.get("wire_dtype")
             if ak is None and plan.get("async_buffer_size"):
                 ak = int(plan["async_buffer_size"])
+            if od is None and plan.get("overlap_depth"):
+                od = int(plan["overlap_depth"])
             if (dc is not None and pc is not None
                     and ms is not None and wd is not None
-                    and ak is not None):
+                    and ak is not None and od is not None):
                 break
     if wd == "f32":
         wd = None  # historical unsuffixed key
     if not ak:
         ak = None  # synchronous runs keep the historical key
-    return dc, pc, ms, wd, ak
+    if not od or int(od) <= 1:
+        od = None  # serial rounds keep the historical key
+    return dc, pc, ms, wd, ak, od
 
 
 def parse_mesh_shape(mesh_shape):
@@ -191,6 +202,12 @@ def main(argv=None):
                          "manifest config / ledger meta plan; "
                          "synchronous runs keep the historical "
                          "unsuffixed key)")
+    ap.add_argument("--overlap_depth", type=int, default=None,
+                    help="override the run's --overlap_depth for "
+                         "baseline keying (normally read from the "
+                         "manifest config / ledger meta plan; "
+                         "depth-1 serial runs keep the historical "
+                         "unsuffixed key)")
     args = ap.parse_args(argv)
 
     ledger = args.ledger
@@ -206,7 +223,7 @@ def main(argv=None):
         print(f"run: {mpath} (config {manifest.get('config_hash', '')[:8]}, "
               f"git {manifest.get('git_sha', '')[:8]}, "
               f"topology "
-              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest))}"
+              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest), registry.run_overlap_depth(manifest))}"
               f") -> {ledger}")
     if ledger is None:
         ap.error("one of --ledger / --runs_dir is required")
@@ -216,10 +233,11 @@ def main(argv=None):
     if not metrics:
         print(f"{ledger}: no gateable metrics (empty ledger?)")
         return 1
-    dc, pc, ms, wd, ak = resolve_topology(
+    dc, pc, ms, wd, ak, od = resolve_topology(
         manifest, records, args.device_count, args.process_count,
-        args.mesh_shape, args.wire_dtype, args.async_k)
-    topo = gate.topology_key(dc, pc, ms, wd, ak)
+        args.mesh_shape, args.wire_dtype, args.async_k,
+        args.overlap_depth)
+    topo = gate.topology_key(dc, pc, ms, wd, ak, od)
     print(f"{ledger}: {len(metrics)} metric(s) extracted "
           f"(topology {topo})")
     chash = (manifest or {}).get("config_hash", "")
@@ -233,7 +251,7 @@ def main(argv=None):
         chain = " -> ".join(
             gate.topology_key(s.get("device_count"),
                               s.get("process_count"),
-                              s.get("mesh_shape"), wd, ak)
+                              s.get("mesh_shape"), wd, ak, od)
             for s in segs)
         print(f"perf gate: REFUSED — run resumed across a mid-run "
               f"topology change ({len(segs)} segments: {chain}); its "
@@ -258,7 +276,7 @@ def main(argv=None):
                   "with --write-baseline first")
             return 1
         existing = gate.load_baseline(gate_path)
-        entry = gate.baseline_entry(existing, dc, pc, ms, wd, ak)
+        entry = gate.baseline_entry(existing, dc, pc, ms, wd, ak, od)
         if entry is None and args.write_baseline and not args.check:
             # first capture of a NEW topology point: nothing to gate
             # this run against, other points stay untouched
@@ -281,7 +299,7 @@ def main(argv=None):
                                    mad_k=args.mad_k,
                                    device_count=dc, process_count=pc,
                                    mesh_shape=ms, wire_dtype=wd,
-                                   async_k=ak)
+                                   async_k=ak, overlap_depth=od)
             print(gate.render_verdict(verdict))
 
     if args.write_baseline:
@@ -298,7 +316,8 @@ def main(argv=None):
                                  source=os.path.abspath(ledger),
                                  device_count=dc, process_count=pc,
                                  config_hash=chash, mesh_shape=ms,
-                                 wire_dtype=wd, async_k=ak),
+                                 wire_dtype=wd, async_k=ak,
+                                 overlap_depth=od),
             args.write_baseline)
         print(f"baseline[{topo}] -> {args.write_baseline}")
 

@@ -43,6 +43,17 @@ therefore its perf-gate topology key — gets the ``q<dtype>`` suffix
 (``d8p1qint8``): a quantized point is guarded by its own baseline
 entry and never compared against the f32 curve.
 
+``--overlap_depths`` appends one point per round-pipeline chunk
+depth (e.g. ``1,2,4``) on the largest requested device count, each
+with ``--sketch_dtype int8`` (the wire the pipeline is built to
+hide). Each point's config carries its ``--overlap_depth``, so its
+manifest — and therefore its perf-gate topology key — gets the
+``o<N>`` suffix for depths > 1 (``d8p1qint8o2``): a pipelined point
+is guarded by its own baseline entry, never compared across depths.
+The point's ``overlapped_fraction`` column (hidden collective time
+over total collective time, from the ledger's ``overlapped_s``
+buckets) is the headline the sweep exists to show.
+
 ``--resize CxM:C2xM2`` appends an elastic-resume pair: the workload
 runs on the first mesh, checkpoints, and the SAME run resumes on the
 second mesh (a different device count) — the resumed point's
@@ -114,7 +125,8 @@ def worker(args):
                  num_workers=W, local_batch_size=B,
                  num_clients=W * 2, dataset_name="CIFAR10", seed=0,
                  k=16, num_rows=3, num_cols=256, mesh=args.mesh,
-                 sketch_dtype=args.sketch_dtype)
+                 sketch_dtype=args.sketch_dtype,
+                 overlap_depth=args.overlap_depth)
     cfg.ledger = args.ledger
     cfg.do_profile = True
 
@@ -169,8 +181,10 @@ def worker(args):
         eff = 1.0
 
     # the ledger this run just wrote explains the curve: collective
-    # fraction of the round window + worst straggler skew
+    # fraction of the round window + worst straggler skew + how much
+    # of the collective time the chunk pipeline hid under compute
     coll_fracs, skews = [], []
+    coll_total, ovl_total = 0.0, 0.0
     with open(args.ledger) as f:
         for line in f:
             rec = json.loads(line)
@@ -181,6 +195,8 @@ def worker(args):
             if dt_rec.get("window_s"):
                 coll_fracs.append(dt_rec.get("collective_s", 0.0)
                                   / dt_rec["window_s"])
+            coll_total += dt_rec.get("collective_s", 0.0)
+            ovl_total += dt_rec.get("overlapped_s", 0.0)
             skew = dt_rec.get("skew") or {}
             if skew.get("max_enter_delta_s") is not None:
                 skews.append(skew["max_enter_delta_s"])
@@ -191,6 +207,9 @@ def worker(args):
         "process_count": int(jax.process_count()),
         "mesh_shape": mesh_shape,
         "sketch_dtype": args.sketch_dtype,
+        "overlap_depth": int(args.overlap_depth),
+        "overlapped_fraction": round(ovl_total / coll_total, 4)
+        if coll_total > 0 else 0.0,
         "upload_wire_bytes_per_client": float(
             cfg.upload_wire_bytes_per_client),
         "clients_per_s": round(clients_per_s, 2),
@@ -314,6 +333,12 @@ def main(argv=None):
                          "on the largest requested device count; "
                          "each point's perf-gate key gets a q<dtype> "
                          "suffix")
+    ap.add_argument("--overlap_depths", default="",
+                    help="comma-separated round-pipeline chunk "
+                         "depths (e.g. 1,2,4) to append as extra "
+                         "int8-wire points on the largest requested "
+                         "device count; each depth>1 point's "
+                         "perf-gate key gets an o<N> suffix")
     ap.add_argument("--resize", default="",
                     help="elastic-resume pair 'CxM:C2xM2': run the "
                          "workload on the first mesh, checkpoint it, "
@@ -334,6 +359,8 @@ def main(argv=None):
                     help=argparse.SUPPRESS)
     ap.add_argument("--mesh", default="", help=argparse.SUPPRESS)
     ap.add_argument("--sketch_dtype", default="f32",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--overlap_depth", type=int, default=1,
                     help=argparse.SUPPRESS)
     ap.add_argument("--ledger", default="", help=argparse.SUPPRESS)
     ap.add_argument("--ckpt_save", default="", help=argparse.SUPPRESS)
@@ -370,6 +397,11 @@ def main(argv=None):
     for dt in dtypes:
         if dt not in ("f32", "bf16", "int8", "fp8"):
             ap.error(f"unknown sketch dtype {dt}")
+    depths = [int(s) for s in args.overlap_depths.split(",")
+              if s.strip()]
+    for n2 in depths:
+        if n2 < 1:
+            ap.error(f"overlap depth {n2} must be >= 1")
     resize = []
     if args.resize:
         halves = args.resize.lower().split(":")
@@ -423,6 +455,20 @@ def main(argv=None):
         show(f"d{n}p1 q{dt} "
              f"({point['upload_wire_bytes_per_client']:.0f} B/client)",
              point)
+
+    for n2 in depths:
+        n = max(counts) if counts else 1
+        point, _ = _run_point(
+            n, args, ref, stamp,
+            extra_cmd=["--overlap_depth", str(n2),
+                       "--sketch_dtype", "int8"],
+            tag=f"o{n2}" if n2 > 1 else "o1")
+        if ref is None:
+            ref = (point["clients_per_s"], n)
+        points.append(point)
+        show(f"d{n}p1 qint8 o{n2} (overlapped "
+             f"{point['overlapped_fraction'] * 100:.1f}% of "
+             "collective)", point)
 
     if resize:
         (c1, m1), (c2, m2) = resize

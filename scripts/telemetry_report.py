@@ -185,6 +185,15 @@ def summarize(records) -> dict:
                 "mean_ms": round(1e3 * sum(sv) / len(sv), 3),
                 "p50_ms": round(1e3 * _pct(sv, 50), 3),
                 "p95_ms": round(1e3 * _pct(sv, 95), 3)}
+    # overlap fraction (--overlap_depth pipelining): how much of the
+    # round's collective wall time ran hidden under some lane's
+    # compute — 0.0 for serial rounds, the pipeline's win otherwise
+    overlap_fraction = None
+    if "overlapped_s" in device_vals and "collective_s" in device_vals:
+        coll_total = sum(device_vals["collective_s"])
+        if coll_total > 0:
+            overlap_fraction = round(
+                sum(device_vals["overlapped_s"]) / coll_total, 4)
     per_device = {}
     for dev, buckets in sorted(lane_vals.items()):
         per_device[dev] = {
@@ -216,6 +225,7 @@ def summarize(records) -> dict:
         "downlink_bytes": downlink,
         "spans": spans,
         "device_time": device_time,
+        "overlap_fraction": overlap_fraction,
         "per_device": per_device,
         "collective_skew": collective_skew,
         "shards": shards,
@@ -274,6 +284,11 @@ def render_summary(s, label="") -> str:
             lines.append(f"  device {name}: mean {v['mean_ms']} "
                          f"ms/round (p50 {v['p50_ms']}, "
                          f"p95 {v['p95_ms']}, {v['n']} rounds)")
+    if s.get("overlap_fraction") is not None:
+        lines.append(
+            f"  overlap: {100 * s['overlap_fraction']:.1f}% of "
+            "collective time hidden under compute "
+            "(serial share = collective - overlapped)")
     for dev, buckets in s.get("per_device", {}).items():
         bits = ", ".join(f"{b.replace('_s', '')} {v} ms"
                          for b, v in buckets.items())
@@ -351,6 +366,9 @@ def diff_summaries(a: dict, b: dict) -> dict:
         dev_diff[name] = entry
     if dev_diff:
         out["device_time"] = dev_diff
+    fa, fb = a.get("overlap_fraction"), b.get("overlap_fraction")
+    if fa is not None or fb is not None:
+        out["overlap_fraction"] = {"a": fa, "b": fb}
     for key in ("uplink_bytes", "downlink_bytes"):
         entry = {"a": a[key], "b": b[key],
                  "delta": b[key] - a[key]}
@@ -399,6 +417,11 @@ def render_diff(d, label_a, label_b) -> str:
         r = f" ({e['ratio']}x)" if "ratio" in e else ""
         unit = "" if name == "roofline_utilization" else " ms/round"
         lines.append(f"  device {name}: {e['a']} -> {e['b']}{unit}{r}")
+    if "overlap_fraction" in d:
+        e = d["overlap_fraction"]
+        fmt = lambda v: f"{100 * v:.1f}%" if v is not None else "-"
+        lines.append(f"  overlap fraction: {fmt(e['a'])} -> "
+                     f"{fmt(e['b'])} of collective hidden")
     for key in ("uplink_bytes", "downlink_bytes"):
         e = d[key]
         r = f" ({e['ratio']}x)" if "ratio" in e else ""
@@ -444,6 +467,7 @@ def scaling_curves(manifests) -> list:
                 "clients_per_s": sc.get("clients_per_s"),
                 "parallel_efficiency": sc.get("parallel_efficiency"),
                 "collective_fraction": sc.get("collective_fraction"),
+                "overlapped_fraction": sc.get("overlapped_fraction"),
                 "max_skew_s": sc.get("max_skew_s"),
                 "manifest": path})
         curves.append({"config_hash": chash, "points": points})
@@ -467,6 +491,10 @@ def render_scaling_curves(curves) -> str:
             if isinstance(p["collective_fraction"], (int, float)):
                 bits.append(
                     f"collective {100 * p['collective_fraction']:.1f}%")
+            if p.get("overlapped_fraction"):
+                bits.append(
+                    f"overlapped "
+                    f"{100 * p['overlapped_fraction']:.1f}%")
             if isinstance(p["max_skew_s"], (int, float)):
                 bits.append(f"skew max {p['max_skew_s']:.6g} s")
             lines.append(f"  d{dc}p{pc}: " + ", ".join(bits))

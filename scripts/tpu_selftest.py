@@ -219,6 +219,77 @@ def quant_smoke():
             f"{errs['fp8']:.3f}; uplink {ratio:.2f}x smaller at int8")
 
 
+def overlap_smoke():
+    """Latency-hiding round pipeline (--overlap_depth) on the REAL
+    backend: a depth-2 chunked int8 round must be BIT-IDENTICAL to
+    the depth-1 serial round (per-row scales make every chunk the
+    exact row slice of the whole-table algebra — the pipeline
+    reorders the schedule, never the math), and a traced pipelined
+    round must land an ``overlapped_s`` bucket in its device-time
+    attribution for the observatory to read."""
+    import shutil
+    import tempfile
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.core.rounds import (ClientStates,
+                                               build_client_round)
+    from commefficient_tpu.parallel.mesh import client_sharding, make_mesh
+    from commefficient_tpu.telemetry import trace
+    from commefficient_tpu.telemetry.profiler import trace_window
+
+    W, B, d = 8, 4, 1 << 16
+
+    def lin_loss(p, b):
+        n = jnp.maximum(jnp.sum(b["mask"]), 1.0)
+        loss = jnp.sum((b["c"] @ p) * b["mask"]) / n
+        return loss, (loss * 0.0,)
+
+    rng = np.random.RandomState(0)
+    batch = {"c": jnp.asarray(rng.randn(W, B, d).astype(np.float32)),
+             "mask": jnp.ones((W, B), jnp.float32)}
+    flat = jnp.zeros((d,), jnp.float32)
+    mesh = make_mesh()
+    sharded = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, client_sharding(mesh)), batch)
+    aggs, rounds = {}, {}
+    for depth in (1, 2):
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     num_workers=W, local_batch_size=B, k=500,
+                     num_rows=4, num_cols=16384, seed=21,
+                     sketch_dtype="int8", overlap_depth=depth)
+        cfg.grad_size = d
+        cr = jax.jit(build_client_round(cfg, lin_loss, B, mesh=mesh))
+        res = cr(flat, ClientStates.init(cfg, W, flat), sharded,
+                 jnp.arange(W, dtype=jnp.int32),
+                 jax.random.PRNGKey(0), 1.0)
+        aggs[depth] = np.asarray(res.aggregated)
+        rounds[depth] = (cr, res.client_states)
+    assert aggs[1].tobytes() == aggs[2].tobytes(), \
+        "depth-2 pipelined round != depth-1 serial round"
+
+    # a traced pipelined round must carry the overlapped_s bucket
+    logdir = tempfile.mkdtemp(prefix="overlap_smoke_")
+    try:
+        cr, cs = rounds[2]
+        with trace_window(logdir):
+            trace.begin_round_marker(0)
+            cr(flat, cs, sharded, jnp.arange(W, dtype=jnp.int32),
+               jax.random.PRNGKey(1), 1.0
+               ).aggregated.block_until_ready()
+        buckets = trace.attribute_logdir(logdir)
+        assert buckets, "no rounds attributed"
+        b0 = buckets[sorted(buckets)[0]]
+        ovl = b0.get("overlapped_s")
+        assert ovl is not None and ovl >= 0.0, b0
+        assert ovl <= b0["collective_s"] + 1e-9, b0
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)
+    return (f"depth-2 bitwise == depth-1; overlapped "
+            f"{ovl * 1e3:.2f} ms of "
+            f"{b0['collective_s'] * 1e3:.2f} ms collective")
+
+
 def async_smoke():
     """Buffered asynchronous rounds (asyncfed) on the REAL backend:
     the degenerate configuration — buffer size == cohort, staleness
@@ -663,6 +734,7 @@ def main():
     check("bf16_flagship_round", bf16_round_trains)
     check("probe_smoke", probe_smoke)
     check("quant_smoke", quant_smoke)
+    check("overlap_smoke", overlap_smoke)
     check("async_smoke", async_smoke)
     check("audit_smoke", audit_smoke)
     check("trace_smoke", trace_smoke)

@@ -1,0 +1,218 @@
+"""--overlap_depth latency-hiding pipeline: chunked sketch emission
+with compute-overlapped wire collectives must be invisible to the
+numbers. Per-row quantization scales make every row chunk's
+quantize/harmonize/collective exactly the row slice of the
+whole-table algebra, so the folded table is BIT-identical to the
+serial program at any depth, on any mesh, for every wire dtype —
+asserted here against both the engine's own depth-1 program and the
+NumPy reference mirror. Dead dropout slots must stay neutral per
+chunk, and the 2D (clients x model) sharded round must keep its 1-D
+oracle parity under overlap."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.core.rounds import (ClientStates,
+                                           build_client_round)
+from commefficient_tpu.core.server import fold_row_chunks
+from commefficient_tpu.ops import quant
+from commefficient_tpu.parallel.mesh import (client_sharding,
+                                             make_mesh, make_mesh2d)
+from commefficient_tpu.parallel.wire import row_chunks
+
+from reference_mirror import np_qdq_table, np_quantize_table
+from test_modes import linear_loss
+from test_mesh2d import _assert_state_close, _run_rounds
+from test_sharding import _batch, _setup
+
+WIRES = ["f32", "bf16", "int8", "fp8"]
+SCALED = ["bf16", "int8", "fp8"]
+
+
+def test_row_chunks_cover_rows_disjointly():
+    """Ceil-sized chunks (at most min(depth, r) of them), in row
+    order, exactly covering [0, r) — the contract every chunked path
+    folds on."""
+    assert row_chunks(3, 1) == [(0, 3)]
+    assert row_chunks(3, 2) == [(0, 2), (2, 1)]
+    assert row_chunks(3, 4) == [(0, 1), (1, 1), (2, 1)]
+    assert row_chunks(8, 2) == [(0, 4), (4, 4)]
+    assert row_chunks(5, 4) == [(0, 2), (2, 2), (4, 1)]
+    for r in (1, 3, 5, 8):
+        for depth in (1, 2, 3, 4, 7, 16):
+            chunks = row_chunks(r, depth)
+            assert 1 <= len(chunks) <= min(depth, r)
+            assert chunks[0][0] == 0
+            assert sum(c for _, c in chunks) == r
+            for (o1, c1), (o2, _) in zip(chunks, chunks[1:]):
+                assert o1 + c1 == o2
+
+
+def _wild_table(r=5, c=64, seed=2):
+    rng = np.random.RandomState(seed)
+    t = rng.randn(r, c).astype(np.float32)
+    t *= np.power(10.0, rng.randint(-3, 4, (r, 1))).astype(np.float32)
+    t[1] = 0.0  # all-zero row: the 0/0 scale guard, per chunk
+    return t
+
+
+class TestChunkAlgebra:
+    """The linearity argument, stated on tables: a chunk's wire
+    crossing IS the row slice of the whole table's (scales are
+    per-row), in the mirror and in the jax ops, bit for bit."""
+
+    @pytest.mark.parametrize("wire", SCALED)
+    def test_mirror_chunk_qdq_is_row_slice_of_whole(self, wire):
+        t = _wild_table()
+        whole = np_qdq_table(t, wire)
+        for depth in (2, 3, 5):
+            folded = np.concatenate(
+                [np_qdq_table(t[off:off + cnt], wire)
+                 for off, cnt in row_chunks(t.shape[0], depth)])
+            np.testing.assert_array_equal(folded, whole)
+
+    @pytest.mark.parametrize("wire", SCALED)
+    def test_jax_chunk_quantize_matches_mirror_bitwise(self, wire):
+        """Per-chunk scales: quantize_table over a row chunk must
+        equal np_quantize_table over the same slice bit for bit —
+        wire payload AND the per-row scale side-channel."""
+        t = _wild_table(seed=9)
+        for off, cnt in row_chunks(t.shape[0], 3):
+            qj, sj = quant.quantize_table(
+                jnp.asarray(t[off:off + cnt]), wire)
+            qn, sn = np_quantize_table(t[off:off + cnt], wire)
+            assert np.asarray(qj).tobytes() == qn.tobytes()
+            if wire == "bf16":
+                assert sj is None and sn is None
+            else:
+                assert np.asarray(sj).tobytes() == sn.tobytes()
+
+    def test_fold_row_chunks_restores_row_order(self):
+        t = _wild_table()
+        chunks = [jnp.asarray(t[off:off + cnt])
+                  for off, cnt in row_chunks(t.shape[0], 3)]
+        np.testing.assert_array_equal(
+            np.asarray(fold_row_chunks(iter(chunks))), t)
+
+
+def _aggregated(cfg, mesh=None, shard=False, batch_seed=0,
+                mutate=None):
+    """One client round's aggregated table for ``cfg`` (optionally on
+    a mesh, optionally with the batch mutated first)."""
+    batch, ids = _batch(seed=batch_seed)
+    if mutate is not None:
+        batch = mutate(batch)
+    fn = jax.jit(build_client_round(cfg, linear_loss,
+                                    batch["x"].shape[1], mesh=mesh))
+    ps = jnp.zeros(cfg.grad_size, jnp.float32).at[0].set(0.5)
+    cs = ClientStates.init(cfg, 16, ps)
+    if shard and mesh is not None:
+        sh = client_sharding(mesh)
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), batch)
+        ids = jax.device_put(ids, sh)
+    res = fn(ps, cs, batch, ids, jax.random.PRNGKey(0),
+             jnp.float32(1.0))
+    return np.asarray(res.aggregated)
+
+
+class TestDepthParity:
+    """The acceptance bit: the aggregated table at --overlap_depth
+    2/4 must equal the serial depth-1 table BYTE for byte — per wire
+    dtype, per mesh topology. A failure here means chunking changed
+    the numbers, which the whole design forbids."""
+
+    @pytest.mark.parametrize("wire", WIRES)
+    def test_single_device_bitwise(self, wire):
+        outs = [_aggregated(_setup(sketch_dtype=wire,
+                                   overlap_depth=depth))
+                for depth in (1, 2, 4)]
+        assert outs[0].tobytes() == outs[1].tobytes(), wire
+        assert outs[0].tobytes() == outs[2].tobytes(), wire
+
+    @pytest.mark.parametrize("wire", WIRES)
+    def test_mesh1d_bitwise(self, devices, wire):
+        outs = [_aggregated(_setup(sketch_dtype=wire,
+                                   overlap_depth=depth),
+                            mesh=make_mesh(devices), shard=True)
+                for depth in (1, 2, 4)]
+        assert outs[0].tobytes() == outs[1].tobytes(), wire
+        assert outs[0].tobytes() == outs[2].tobytes(), wire
+
+    @pytest.mark.parametrize("wire", ["f32", "int8"])
+    def test_mesh2d_bitwise(self, devices, wire):
+        outs = [_aggregated(_setup(sketch_dtype=wire,
+                                   overlap_depth=depth),
+                            mesh=make_mesh2d(4, 2))
+                for depth in (1, 2, 4)]
+        assert outs[0].tobytes() == outs[1].tobytes(), wire
+        assert outs[0].tobytes() == outs[2].tobytes(), wire
+
+    def test_dead_slot_neutral_per_chunk(self, devices):
+        """A dead dropout/padding slot (all-zero mask) must stay
+        neutral in EVERY chunk: its garbage data cannot perturb any
+        chunk's quantize scale or collective payload. Pinned by
+        swapping the dead slot's features for different garbage and
+        requiring a byte-identical aggregate, at depth 1 and 2."""
+
+        def kill(slot, poison):
+            def mutate(batch):
+                mask = np.asarray(batch["mask"]).copy()
+                mask[slot] = 0.0
+                x = np.asarray(batch["x"]).copy()
+                x[slot] = poison
+                return {"x": jnp.asarray(x), "y": batch["y"],
+                        "mask": jnp.asarray(mask)}
+            return mutate
+
+        mesh = make_mesh(devices)
+        for depth in (1, 2):
+            cfg = _setup(sketch_dtype="int8", overlap_depth=depth)
+            a = _aggregated(cfg, mesh=mesh, shard=True,
+                            mutate=kill(3, 7.5))
+            b = _aggregated(cfg, mesh=mesh, shard=True,
+                            mutate=kill(3, -123.0))
+            assert a.tobytes() == b.tobytes(), depth
+            if depth == 1:
+                serial = a
+        assert serial.tobytes() == a.tobytes()
+
+
+class TestOverlapEndToEnd:
+    """Multi-round state evolution under overlap: the 2D sharded
+    round keeps its 1-D oracle parity, and the quantized 2D round is
+    byte-identical to its own serial program over full rounds
+    (client state, server momentum/error and params included)."""
+
+    def test_2d_overlap_matches_1d_oracle_f32(self, devices):
+        cfg = _setup("sketch", weight_decay=5e-4)
+        ref = _run_rounds(cfg, None)
+        got = _run_rounds(dataclasses.replace(cfg, overlap_depth=2),
+                          make_mesh2d(4, 2))
+        _assert_state_close(ref, got)
+
+    def test_2d_overlap_int8_bitwise_vs_serial(self, devices):
+        cfg = _setup("sketch", sketch_dtype="int8")
+        ref = _run_rounds(cfg, make_mesh2d(4, 2))
+        got = _run_rounds(dataclasses.replace(cfg, overlap_depth=4),
+                          make_mesh2d(4, 2))
+        for x, y in zip(ref[:4], got[:4]):
+            assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+
+def test_overlap_depth_validation():
+    """depth >= 1 always; depth > 1 is sketch-mode only (the other
+    modes have no table to chunk) — enforced at config validation so
+    a bad flag dies before tracing."""
+    with pytest.raises(Exception):
+        Config(mode="sketch", overlap_depth=0).validate()
+    cfg = _setup("uncompressed", error_type="none",
+                 virtual_momentum=0.9, overlap_depth=2)
+    with pytest.raises(Exception):
+        cfg.validate_runtime()
+    _setup(overlap_depth=2).validate_runtime()  # sketch: fine

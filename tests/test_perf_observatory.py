@@ -713,6 +713,8 @@ class TestProfileIntegration:
 
 SKEW_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
                             "skew.trace.json.gz")
+OVERLAP_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                               "overlap.trace.json.gz")
 
 AGG_KEYS = ("window_s", "busy_s", "compute_s", "collective_s",
             "transfer_s", "host_gap_s")
@@ -767,7 +769,7 @@ class TestSkewAttribution:
         EXACTLY (wire is computed as the rounded difference, so the
         identity survives 6-dp rounding), and each lane's busy time
         must partition into compute + collective + transfer."""
-        for fixture in (FIXTURE, SKEW_FIXTURE):
+        for fixture in (FIXTURE, SKEW_FIXTURE, OVERLAP_FIXTURE):
             buckets = trace.attribute_rounds(
                 trace.load_trace_events(fixture))
             for b in buckets.values():
@@ -800,6 +802,62 @@ class TestSkewAttribution:
         back = json.loads(json.dumps(rec))
         assert validate_record(back) == []
         assert back["device_time"] == rec["device_time"]
+
+    def test_overlap_fixture_golden_buckets(self):
+        """``overlap.trace.json.gz``: two TPU lanes, round 0 in the
+        pipelined shape (all-reduce.5 [1400,1600) runs while TPU:1 is
+        still inside fusion.3 until 1450 — 50 us of the pooled
+        collective union intersects some lane's compute), round 1 the
+        serial shape (all-reduce.7 starts only after every fusion has
+        ended — zero intersection). All values hand-computed."""
+        buckets = trace.attribute_rounds(
+            trace.load_trace_events(OVERLAP_FIXTURE))
+        assert sorted(buckets) == [0, 1]
+        b0 = buckets[0]
+        assert {k: b0[k] for k in AGG_KEYS} == {
+            "window_s": 0.001, "busy_s": 0.0007,
+            "compute_s": 0.0004, "collective_s": 0.0002,
+            "transfer_s": 0.0001, "host_gap_s": 0.0003}
+        assert b0["overlapped_s"] == 5e-05
+        assert b0["per_device"] == {
+            "TPU:0": {"busy_s": 0.0007, "compute_s": 0.0005,
+                      "collective_s": 0.0002, "transfer_s": 0.0,
+                      "wait_s": 5e-05, "wire_s": 0.00015},
+            "TPU:1": {"busy_s": 0.0004, "compute_s": 0.00015,
+                      "collective_s": 0.00015, "transfer_s": 0.0001,
+                      "wait_s": 0.0, "wire_s": 0.00015}}
+        assert b0["skew"] == {
+            "n_collectives": 1, "max_enter_delta_s": 5e-05,
+            "p95_enter_delta_s": 5e-05, "straggler_device": "TPU:1"}
+        b1 = buckets[1]
+        assert {k: b1[k] for k in AGG_KEYS} == {
+            "window_s": 0.001, "busy_s": 0.0004,
+            "compute_s": 0.0002, "collective_s": 0.0002,
+            "transfer_s": 0.0, "host_gap_s": 0.0006}
+        assert b1["overlapped_s"] == 0.0
+        assert b1["per_device"] == {
+            "TPU:0": {"busy_s": 0.0004, "compute_s": 0.0002,
+                      "collective_s": 0.0002, "transfer_s": 0.0,
+                      "wait_s": 0.0, "wire_s": 0.0002},
+            "TPU:1": {"busy_s": 0.00035, "compute_s": 0.00015,
+                      "collective_s": 0.0002, "transfer_s": 0.0,
+                      "wait_s": 0.0, "wire_s": 0.0002}}
+
+    def test_overlapped_is_an_overlay_not_a_fifth_bucket(self):
+        """``overlapped_s`` bounds and partition exactness on every
+        checked-in fixture: 0 <= overlapped <= collective, and the
+        four real buckets still sum to the window to 1e-12 — the
+        overlay must never perturb the partition."""
+        for fixture in (FIXTURE, SKEW_FIXTURE, OVERLAP_FIXTURE):
+            buckets = trace.attribute_rounds(
+                trace.load_trace_events(fixture))
+            for b in buckets.values():
+                assert 0.0 <= b["overlapped_s"] <= \
+                    b["collective_s"] + 1e-12, fixture
+                parts = (b["compute_s"] + b["collective_s"]
+                         + b["transfer_s"] + b["host_gap_s"])
+                assert parts == pytest.approx(b["window_s"],
+                                              abs=1e-12), fixture
 
     def test_skew_metrics_reach_the_gate(self):
         rec = make_round_record(0)
@@ -1183,15 +1241,15 @@ class TestTopologyGate:
         records = pg.load_ledger_records(ledger)
         # pre-fleet metas never recorded process_count: defaults to 1
         assert pg.resolve_topology(None, records) == \
-            (8, 1, None, None, None)
+            (8, 1, None, None, None, None)
         # CLI overrides win
         assert pg.resolve_topology(None, records,
                                    device_count=2,
                                    process_count=2) == \
-            (2, 2, None, None, None)
+            (2, 2, None, None, None, None)
         manifest = {"device_count": 16, "process_count": 4}
         assert pg.resolve_topology(manifest, records) == \
-            (16, 4, None, None, None)
+            (16, 4, None, None, None, None)
 
     def test_resolve_mesh_shape_chain(self, tmp_path):
         """Mesh layout resolution: CLI "CxM" wins, then the manifest
@@ -1205,7 +1263,7 @@ class TestTopologyGate:
                 "mesh_shape": {"clients": 4, "model": 2}}) + "\n")
         records = pg.load_ledger_records(ledger)
         assert pg.resolve_topology(None, records) == \
-            (8, 1, {"clients": 4, "model": 2}, None, None)
+            (8, 1, {"clients": 4, "model": 2}, None, None, None)
         manifest = {"device_count": 8, "process_count": 1,
                     "mesh_shape": {"clients": 2, "model": 4}}
         assert pg.resolve_topology(manifest, records)[2] == \

@@ -301,7 +301,11 @@ def test_probes_off_program_identical(mode, error_type):
         # asyncfed knobs without --async_buffer_size: the staleness
         # weight and alarm threshold are host/trace-gated and must
         # not perturb a synchronous build
-        async_staleness_weight=0.7, alarm_async_staleness=4.0)
+        async_staleness_weight=0.7, alarm_async_staleness=4.0,
+        # --overlap_depth 1 is the serial program by construction:
+        # none of the chunked-emission branches trace (the HLO
+        # fingerprint identity every audit baseline pins on)
+        overlap_depth=1)
     assert _lower_text(
         build_client_round(inert_cfg, linear_loss, 3,
                            transmit_transform=None),
@@ -312,6 +316,17 @@ def test_probes_off_program_identical(mode, error_type):
     assert _lower_text(
         build_client_round(cfg, linear_loss, 3, client_weights=False),
         cfg) == default
+    # ...while an ACTIVE overlap pipeline (sketch only, and only
+    # once a quantized wire gives the chunks something to trace on a
+    # single shard) changes the program: per-chunk qdq vs one
+    # whole-table qdq
+    if mode == "sketch":
+        q1_cfg = dataclasses.replace(cfg, sketch_dtype="int8")
+        q2_cfg = dataclasses.replace(q1_cfg, overlap_depth=2)
+        assert _lower_text(build_client_round(q2_cfg, linear_loss, 3),
+                           q2_cfg) != \
+            _lower_text(build_client_round(q1_cfg, linear_loss, 3),
+                        q1_cfg)
     # an ACTIVE robust fold, by contrast, changes the program
     med_cfg = dataclasses.replace(cfg, robust_agg="median")
     assert _lower_text(build_client_round(med_cfg, linear_loss, 3),
