@@ -522,6 +522,74 @@ def _check_byte_literal(rel, lines, tree):
     return hits
 
 
+# --- rule: knob-mutation -----------------------------------------------
+
+
+_KNOB_ATTRS = {"sketch_dtype", "num_rows", "num_cols",
+               "approx_recall"}
+_CONFIG_RECEIVERS = {"cfg", "args", "config"}
+
+
+def _check_knob_mutation(rel, lines, tree):
+    """The compression knobs (``k``/``num_rows``/``num_cols``/
+    ``sketch_dtype``/``approx_recall``) are autopilot state: between
+    rounds the controller moves them ONLY through its sanctioned
+    re-plan API (``autopilot.apply_knobs`` onto the bucketed re-jit
+    cache), which keeps the compiled round variant, the byte
+    accounting and the replay record consistent. A direct store
+    anywhere else silently diverges the dispatched program from the
+    config that priced it — the exact bug class the variant cache
+    exists to remove. ``autopilot/`` is exempt (it IS the re-plan
+    API); ``config.py`` owns the initial values. Flagged: attribute
+    stores of the knob names (``.k`` only on config-shaped receivers
+    — cfg/args/config/self.args — so loop counters named ``k`` stay
+    legal), and ``replace(...)``/``dataclasses.replace(...)`` calls
+    passing knob keywords."""
+    if _top(rel) == "autopilot" or rel.as_posix() == "config.py":
+        return []
+
+    def recv(v):
+        if isinstance(v, ast.Name):
+            return v.id
+        if isinstance(v, ast.Attribute) \
+                and isinstance(v.value, ast.Name) \
+                and v.value.id == "self":
+            return v.attr
+        return None
+
+    hits = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if not isinstance(t, ast.Attribute):
+                    continue
+                if t.attr in _KNOB_ATTRS or (
+                        t.attr == "k"
+                        and recv(t.value) in _CONFIG_RECEIVERS):
+                    hits.append((t.lineno,
+                                 f"direct write to .{t.attr} outside "
+                                 "autopilot/ — knob moves must go "
+                                 "through autopilot.apply_knobs so "
+                                 "the re-jit cache, accounting and "
+                                 "replay record stay consistent"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None)
+            if name != "replace":
+                continue
+            knobs = sorted(kw.arg for kw in node.keywords
+                           if kw.arg in _KNOB_ATTRS | {"k"})
+            if knobs:
+                hits.append((node.lineno,
+                             f"replace({', '.join(knobs)}=...) "
+                             "outside autopilot/ — knob moves must "
+                             "go through autopilot.apply_knobs"))
+    return hits
+
+
 # --- rule: mutable-default-arg -----------------------------------------
 
 
@@ -576,6 +644,9 @@ ALL_RULES = [
     Rule("byte-literal",
          "inline byte-width multiply in runtime/telemetry accounting",
          _check_byte_literal),
+    Rule("knob-mutation",
+         "compression knob written outside autopilot's re-plan API",
+         _check_knob_mutation),
     Rule("mutable-default-arg",
          "mutable default argument",
          _check_mutable_default),

@@ -1,0 +1,31 @@
+"""Adaptive compression autopilot.
+
+A seeded, deterministic, replayable between-rounds controller
+(controller.py) that reads the round's probe scalars and walks the
+discrete knob lattice (lattice.py) toward the cheapest round program
+whose sketch recovery error stays inside ``--autopilot_band LO:HI``,
+dispatching through a bounded LRU of jitted round variants (cache.py)
+so a revisited point never recompiles. ``lattice.apply_knobs`` is the
+ONLY sanctioned way compression knobs change after construction — the
+knob-mutation lint rule (analysis/lint.py) hard-fails direct writes
+everywhere else.
+"""
+
+from commefficient_tpu.autopilot.cache import RoundVariantCache
+from commefficient_tpu.autopilot.controller import (AutopilotController,
+                                                    build_controller,
+                                                    replay_record)
+from commefficient_tpu.autopilot.lattice import (VariantKey,
+                                                 apply_knobs,
+                                                 band_str,
+                                                 build_ladder, key_of,
+                                                 key_str, parse_band,
+                                                 parse_key,
+                                                 variant_bytes)
+
+__all__ = [
+    "AutopilotController", "RoundVariantCache", "VariantKey",
+    "apply_knobs", "band_str", "build_controller", "build_ladder",
+    "key_of", "key_str", "parse_band", "parse_key", "replay_record",
+    "variant_bytes",
+]

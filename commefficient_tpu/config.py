@@ -403,6 +403,43 @@ class Config:
     # round's max folded staleness (rounds) exceeds this. 0 = off;
     # shares the --on_divergence action.
     alarm_async_staleness: float = 0.0
+    # adaptive compression autopilot (commefficient_tpu/autopilot):
+    # "on" runs the seeded between-rounds controller that walks the
+    # discrete knob lattice (sketch_dtype x k x rows x cols x recall)
+    # toward the cheapest round program whose recovery error stays
+    # inside --autopilot_band, dispatching through a bounded LRU of
+    # jitted round variants (re-jit cache). "off" (default): no
+    # controller, and the compiled program is bit-identical to a
+    # build without the flag (the base variant is built from THIS
+    # config object unchanged).
+    autopilot: str = "off"
+    # target recovery-error band "LO:HI" (required with --autopilot
+    # on): the controller cheapens below LO after the cooldown, backs
+    # off above HI immediately and never re-enters the offending
+    # point. The LO..HI gap is the hysteresis that prevents
+    # oscillation.
+    autopilot_band: str = ""
+    # in-band probed rounds to wait between cheapening moves (back-off
+    # ignores it — safety beats cooldown)
+    autopilot_cooldown: int = 2
+    # bound of the round-variant LRU (jitted programs kept alive);
+    # evicted variants recompile on re-visit, stamped in the ledger
+    autopilot_cache_size: int = 4
+    # pre-compile a decided move's round variant under the current
+    # round's host phase (AOT lower+compile), so the switch round
+    # never stalls on XLA; only DECIDED points are ever warmed —
+    # unvisited lattice points never compile eagerly
+    autopilot_warm_ahead: bool = True
+    # hold the controller at one lattice point (variant-key spelling,
+    # e.g. "int8-k50000-r5-c500000-re9500"): the full autopilot
+    # machinery engages (cache, trajectory, manifest record) but no
+    # move is ever made — bit-identical to the equivalent static
+    # config
+    autopilot_pin: str = ""
+    # let the ladder extend past the dtype axis into column-halving
+    # geometry steps; a geometry move changes the sketch table shape
+    # and RESETS server momentum/error feedback (runtime/fed_model.py)
+    autopilot_geometry: bool = False
 
     # populated at runtime (reference sets args.grad_size the same way,
     # fed_aggregator.py:88)
@@ -464,6 +501,30 @@ class Config:
             "--async_staleness_weight must be >= 0"
         assert self.alarm_async_staleness >= 0, \
             "--alarm_async_staleness must be >= 0 (0 = rule off)"
+        assert self.autopilot in ("off", "on"), \
+            "--autopilot must be off|on"
+        assert self.autopilot_cooldown >= 0, \
+            "--autopilot_cooldown must be >= 0"
+        assert self.autopilot_cache_size >= 1, \
+            "--autopilot_cache_size must be >= 1"
+        if self.autopilot == "on":
+            assert self.mode == "sketch", \
+                "--autopilot on requires --mode sketch (the knob " \
+                "lattice is sketch geometry + wire dtype)"
+            assert self.autopilot_band, \
+                "--autopilot on requires --autopilot_band LO:HI"
+            try:
+                lo, hi = (float(p)
+                          for p in self.autopilot_band.split(":"))
+            except ValueError:
+                raise AssertionError(
+                    "--autopilot_band must be LO:HI, e.g. 0.2:0.6 "
+                    f"(got {self.autopilot_band!r})") from None
+            assert 0.0 <= lo < hi, \
+                "--autopilot_band needs 0 <= LO < HI"
+            assert self.probe_period > 0, \
+                "--autopilot on needs probes (--probe_every N > 0): " \
+                "the controller steers on the recovery-error probe"
         if self.async_buffer_size > 0:
             assert self.async_buffer_size <= self.num_workers, \
                 "--async_buffer_size must be <= --num_workers " \
@@ -950,6 +1011,47 @@ def build_parser(default_lr: Optional[float] = None,
                         "round's max folded staleness exceeds this "
                         "many rounds (0 = off; action from "
                         "--on_divergence)")
+    parser.add_argument("--autopilot", type=str, default="off",
+                        choices=["off", "on"],
+                        help="adaptive compression autopilot "
+                        "(commefficient_tpu/autopilot): walk the "
+                        "discrete knob lattice (sketch_dtype x k x "
+                        "rows x cols x recall) toward the cheapest "
+                        "round program whose recovery error stays "
+                        "inside --autopilot_band, re-jitting round "
+                        "variants through a bounded LRU cache. off "
+                        "(default) compiles bit-identical to a build "
+                        "without the flag")
+    parser.add_argument("--autopilot_band", type=str, default="",
+                        help="target recovery-error band LO:HI "
+                        "(required with --autopilot on); cheapen "
+                        "below LO after the cooldown, back off above "
+                        "HI immediately and never re-enter the "
+                        "offending point")
+    parser.add_argument("--autopilot_cooldown", type=int, default=2,
+                        help="in-band probed rounds between "
+                        "cheapening moves (back-off ignores it)")
+    parser.add_argument("--autopilot_cache_size", type=int, default=4,
+                        help="round-variant LRU bound; evicted "
+                        "variants recompile on re-visit (ledger-"
+                        "stamped)")
+    parser.add_argument("--autopilot_warm_ahead", type=int, default=1,
+                        help="1 = AOT-compile a decided move's round "
+                        "variant under the current round's host "
+                        "phase; 0 = lazy compile at the switch "
+                        "round's dispatch")
+    parser.add_argument("--autopilot_pin", type=str, default="",
+                        help="hold the controller at one lattice "
+                        "point (variant-key spelling, e.g. "
+                        "int8-k50000-r5-c500000-re9500) — full "
+                        "autopilot machinery, zero moves, "
+                        "bit-identical to the equivalent static "
+                        "config")
+    parser.add_argument("--autopilot_geometry", action="store_true",
+                        help="extend the knob ladder past the dtype "
+                        "axis into column-halving geometry steps "
+                        "(a geometry move resets server momentum/"
+                        "error feedback)")
 
     return parser
 

@@ -187,6 +187,22 @@ def round_plan(cfg: Config) -> dict:
                           "rot_lanes": resolve_rot_lanes(cfg)}
     if cfg.mode in ("true_topk", "local_topk"):
         plan["k"] = int(cfg.k)
+    if str(getattr(cfg, "autopilot", "off")) == "on":
+        # knob-lattice walk parameters: enough to interpret (and
+        # replay-check) a ledger whose rounds were dispatched through
+        # the bucketed re-jit cache rather than one static program
+        from commefficient_tpu.autopilot.lattice import (build_ladder,
+                                                         key_of,
+                                                         key_str)
+        plan["autopilot"] = {
+            "band": str(cfg.autopilot_band),
+            "cooldown": int(cfg.autopilot_cooldown),
+            "cache_size": int(cfg.autopilot_cache_size),
+            "warm_ahead": bool(cfg.autopilot_warm_ahead),
+            "pin": str(getattr(cfg, "autopilot_pin", "") or ""),
+            "base": key_str(key_of(cfg)),
+            "ladder": [key_str(k) for k in build_ladder(cfg)],
+        }
     return plan
 
 

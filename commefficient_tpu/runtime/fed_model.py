@@ -40,6 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from commefficient_tpu import accounting
+from commefficient_tpu.autopilot import (RoundVariantCache, apply_knobs,
+                                         build_controller, key_of,
+                                         key_str)
 from commefficient_tpu.clientstore import (HostClientStore,
                                            StorePrefetcher,
                                            resolve_clientstore,
@@ -51,6 +54,7 @@ from commefficient_tpu.core.rounds import (ClientStates,
                                            build_val_fn, round_plan)
 from commefficient_tpu.core.server import ServerState
 from commefficient_tpu.telemetry import build_telemetry, clock, trace
+from commefficient_tpu.telemetry.core import compile_delta, compile_mark
 from commefficient_tpu.ops.vec import flatten_params
 from commefficient_tpu.parallel import make_mesh, make_mesh2d
 from commefficient_tpu.parallel.mesh import (client_sharding,
@@ -75,6 +79,28 @@ def _host(arr) -> np.ndarray:
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(arr,
                                                         tiled=True))
+
+
+class _RoundVariant:
+    """One lattice point's executable bundle: the knob-substituted
+    Config plus its jitted round programs. jit is lazy, so building a
+    variant costs a closure — XLA compiles on the variant's first
+    dispatch (or under the autopilot's warm-ahead, which AOT-compiles
+    into ``aot`` during the previous round's host phase). ``compiled``
+    tracks which flavors have been charged to the ledger's per-variant
+    ``vcompile_*:<key>`` counters."""
+
+    __slots__ = ("key", "cfg", "round_fn", "round_probed", "server_fn",
+                 "aot", "compiled")
+
+    def __init__(self, key, cfg, round_fn, round_probed):
+        self.key = key
+        self.cfg = cfg
+        self.round_fn = round_fn
+        self.round_probed = round_probed
+        self.server_fn = None   # built by FedOptimizer on first use
+        self.aot = {}           # flavor -> AOT-compiled executable
+        self.compiled = set()   # flavors already compile-stamped
 
 
 class FedModel:
@@ -229,10 +255,10 @@ class FedModel:
         self.probe_period = int(getattr(args, "probe_period", 0) or 0)
         probes_on = self.probe_period > 0
 
-        def _build_round(with_probes, with_recovery):
+        def _build_round(cfg, with_probes, with_recovery):
             return jax.jit(
                 build_client_round(
-                    args, None, padded_batch_size,
+                    cfg, None, padded_batch_size,
                     mesh=self.mesh, stats_fn=stats_fn_flat,
                     tree_loss=loss_tree,
                     unravel=self.unravel,
@@ -242,10 +268,36 @@ class FedModel:
                     client_weights=(self.async_k > 0)),
                 donate_argnums=(1,))
 
-        self._client_round = _build_round(probes_on, False)
-        self._client_round_probed = (
-            _build_round(True, True)
-            if probes_on and args.mode == "sketch" else None)
+        # bucketed re-jit cache: round programs live in a bounded LRU
+        # keyed by the discrete knob lattice point they were built for
+        # (autopilot/). The base variant's config IS ``args`` itself
+        # (apply_knobs returns the same object at the base key), so
+        # with the autopilot off the dispatched program — and its HLO —
+        # is byte-identical to building jax.jit(build_client_round(
+        # args, ...)) directly.
+        def _build_variant(key):
+            cfg = apply_knobs(args, key)
+            return _RoundVariant(
+                key, cfg, _build_round(cfg, probes_on, False),
+                (_build_round(cfg, True, True)
+                 if probes_on and cfg.mode == "sketch" else None))
+
+        self._variants = RoundVariantCache(
+            _build_variant,
+            max_size=int(getattr(args, "autopilot_cache_size", 4) or 4))
+        self._variant_key = key_of(args)
+        self._autopilot = build_controller(args)
+        if self._autopilot is not None:
+            # --autopilot_pin starts (and holds) at the pinned point
+            self._variant_key = self._autopilot.key
+            if self._variant_key != key_of(args):
+                self.args = args = apply_knobs(args, self._variant_key)
+        self.pending_variant_key = self._variant_key
+        # abstract round-call signature (ShapeDtypeStructs incl.
+        # shardings), captured at the first dispatch; warm-ahead AOT
+        # compiles against it. Input shapes are knob-independent — the
+        # lattice only moves the sketch geometry/wire INSIDE the round.
+        self._round_abstract = None
         if stats_fn is not None:
             self._val_fn = jax.jit(build_val_fn(
                 args, loss_flat_val_state, stateful=True))
@@ -572,26 +624,46 @@ class FedModel:
                 rows = self._gather_rows(ids_np)
             with tel.span("h2d_state"):
                 cs_in = self._rows_to_states(rows)
-        round_fn = self._client_round
-        if (self._client_round_probed is not None
-                and ridx % self.probe_period == 0):
-            round_fn = self._client_round_probed
+        var = self._variants.get(self._variant_key)
+        probed = (var.round_probed is not None
+                  and ridx % self.probe_period == 0)
+        flavor = "probed" if probed else "plain"
+        jit_fn = var.round_probed if probed else var.round_fn
+        # prefer the warm-ahead AOT executable when the switch compiled
+        # one; otherwise the jit wrapper compiles lazily right here
+        round_fn = var.aot.get(flavor, jit_fn)
+        # the server pass must consume this aggregate with the SAME
+        # variant's program — record the dispatch-time key, not
+        # whatever the controller moves to afterwards
+        self.pending_variant_key = var.key
         # staleness rides as a seventh positional arg only when the
         # async driver is on — the synchronous call site stays
         # byte-identical (and so does its compiled program)
         sargs = (() if staleness is None
                  else (shard_batch(self.mesh, jnp.asarray(staleness)),))
+        rargs = (self.ps_weights, cs_in, dev_batch, ids, rng,
+                 jnp.float32(self.fedavg_lr)) + sargs
         if (self._cost_model is None and tel.enabled
                 and getattr(args, "do_profile", False)):
             # roofline expectation from this round's lowered program —
-            # once per run, text-only (no second compile)
-            self._emit_cost_model(
-                round_fn, (self.ps_weights, cs_in, dev_batch, ids,
-                           rng, jnp.float32(self.fedavg_lr)) + sargs)
+            # once per run, text-only (no second compile; always the
+            # jit wrapper — AOT executables don't re-lower)
+            self._emit_cost_model(jit_fn, rargs)
+        if self._round_abstract is None:
+            self._round_abstract = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype,
+                    sharding=getattr(a, "sharding", None)), rargs)
+        cmark = (compile_mark() if flavor not in var.compiled
+                 else None)
         with tel.span("round_dispatch"), trace.phase("round_dispatch"):
-            res = round_fn(self.ps_weights, cs_in,
-                           dev_batch, ids, rng,
-                           jnp.float32(self.fedavg_lr), *sargs)
+            res = round_fn(*rargs)
+        if cmark is not None:
+            # ledger compile events carry the variant cache key — jit
+            # compiles synchronously inside the dispatch, so the delta
+            # around a variant's first call is its compile
+            var.compiled.add(flavor)
+            self._stamp_vcompile(var.key, cmark)
         self.client_states = res.client_states
         self.pending_aggregated = res.aggregated
         # dead slots (dropout / loader padding) must carry the
@@ -642,7 +714,8 @@ class FedModel:
             # until then; probe scalars stay DEVICE arrays in
             # _probe_log (no sync) and materialise at the same replay
             self._oplog.append(("account", ids_np,
-                                np.asarray(batch["mask"]), ridx))
+                                np.asarray(batch["mask"]), ridx,
+                                var.cfg))
             self._inflight.append(list(res.metrics))
             if res.probes is not None:
                 self._probe_log.setdefault(ridx, {}).update(res.probes)
@@ -687,7 +760,8 @@ class FedModel:
                 len(ids_np), -1).sum(axis=1) > 0
             acct_ids = ids_np[alive]
             acct_mask = np.asarray(acct_mask)[alive]
-        down, up = self._account_bytes(acct_ids, acct_mask)
+        down, up = self._account_bytes(acct_ids, acct_mask,
+                                       cfg=var.cfg)
         tel.set_round_bytes(ridx, float(down.sum()), float(up.sum()))
         return metrics + [down, up]
 
@@ -722,7 +796,8 @@ class FedModel:
                         vals = {k: float(_host(v))
                                 for k, v in pd.items()}
                     self._finish_probes(op[3], vals)
-                down, up = self._account_bytes(op[1], op[2])
+                down, up = self._account_bytes(op[1], op[2],
+                                               cfg=op[4])
                 self.telemetry.set_round_bytes(
                     op[3], float(down.sum()), float(up.sum()))
                 results.append(next(rounds) + [down, up])
@@ -749,6 +824,71 @@ class FedModel:
         self.telemetry.merge_round_probes(ridx, full)
         if self.alarm_engine is not None:
             self.alarm_engine.check(ridx, full)
+        if self._autopilot is not None:
+            # between-rounds knob control: one observation per finished
+            # round, in dispatch order on both the sync and
+            # flush-replay paths — the controller (and so its manifest
+            # trajectory) sees exactly the probe stream the run saw
+            new_key = self._autopilot.observe(ridx, full)
+            if new_key is not None:
+                self._switch_variant(new_key)
+
+    def _switch_variant(self, key):
+        """Move the dispatch point to lattice point ``key``: fetch (or
+        lazily build) its variant from the re-jit cache, optionally
+        AOT-compile the flavor the NEXT round will dispatch — under the
+        CURRENT round's host phase, so the compile hides behind work
+        the host was doing anyway, and only ever for the point the
+        controller just committed to visiting (warm-ahead never touches
+        an unvisited lattice point) — and swap ``self.args`` to the
+        variant's config so byte accounting reprices from the next
+        round on."""
+        tel = self.telemetry
+        var = self._variants.get(key)
+        self._variant_key = key
+        nridx = self.round_index  # next round to dispatch
+        probed = (var.round_probed is not None
+                  and self.probe_period > 0
+                  and nridx % self.probe_period == 0)
+        flavor = "probed" if probed else "plain"
+        if (getattr(self.args, "autopilot_warm_ahead", True)
+                and self._round_abstract is not None
+                and flavor not in var.compiled
+                and flavor not in var.aot):
+            fn = var.round_probed if probed else var.round_fn
+            cmark = compile_mark()
+            try:
+                with tel.span("autopilot_warm"):
+                    var.aot[flavor] = fn.lower(
+                        *self._round_abstract).compile()
+                var.compiled.add(flavor)
+                self._stamp_vcompile(var.key, cmark)
+            except Exception:
+                # AOT lowering is best-effort: the lazy jit wrapper
+                # compiles at first dispatch instead
+                var.aot.pop(flavor, None)
+        self.args = var.cfg
+        tel.count("autopilot_moves")
+
+    def _stamp_vcompile(self, key, mark):
+        """Charge the compile activity since ``mark`` to lattice point
+        ``key`` on the current ledger record: raw jax.monitoring event
+        count + seconds, plus one ``vcompile_programs`` unit per
+        actually-compiled executable (telemetry_report's per-variant
+        compile table reads these)."""
+        ev, secs = compile_delta(mark)
+        if ev:
+            ks = key_str(key)
+            tel = self.telemetry
+            tel.count(f"vcompile_events:{ks}", ev)
+            tel.count(f"vcompile_secs:{ks}", round(secs, 6))
+            tel.count(f"vcompile_programs:{ks}", 1)
+
+    def autopilot_record(self):
+        """The controller's replayable trajectory record (manifest
+        ``autopilot`` block), or None with the autopilot off."""
+        return (None if self._autopilot is None
+                else self._autopilot.record())
 
     def _emit_cost_model(self, round_fn, round_args):
         """Roofline expectation for this run's round program
@@ -794,21 +934,26 @@ class FedModel:
             self.last_updated + 1,
             minlength=self._update_round + 2).astype(np.int64)
 
-    def _account_bytes(self, ids_np, mask=None):
+    def _account_bytes(self, ids_np, mask=None, cfg=None):
         """Per-round download/upload byte accounting (see module
         docstring; reference fed_aggregator.py:171-196, 240-300).
         ``mask`` (W, B) derives which clients completed the round:
         dropped clients (--dropout_prob) downloaded weights but
         uploaded nothing. All byte widths route through
         ``accounting`` — uploads at the sketch wire dtype, downloads
-        dense-f32 or delta-coded per --downlink_encoding."""
+        dense-f32 or delta-coded per --downlink_encoding. ``cfg`` is
+        the config the round was DISPATCHED under (the dispatch-time
+        round variant's) so autopilot knob moves reprice exactly from
+        the round that first used them, even on pipelined replay."""
+        if cfg is None:
+            cfg = self.args
         download_bytes = np.zeros(self.num_clients)
         suffix = np.cumsum(self._round_counts[::-1])[::-1]
         q = self.client_last_seen[ids_np] + 2
         changed = np.where(
             q < len(suffix), suffix[np.minimum(q, len(suffix) - 1)], 0)
-        if getattr(self.args, "downlink_encoding", "dense") == "delta":
-            wire = getattr(self.args, "sketch_dtype", "f32")
+        if getattr(cfg, "downlink_encoding", "dense") == "delta":
+            wire = getattr(cfg, "sketch_dtype", "f32")
             # a client that saw the PREVIOUS broadcast holds its
             # support list, so repeats delta-code against it; anyone
             # staler downloads every changed coord as (idx, val)
@@ -828,7 +973,7 @@ class FedModel:
         if mask is not None:
             up_ids = ids_np[np.asarray(mask).sum(axis=1) > 0]
         upload_bytes[up_ids] = float(
-            self.args.upload_wire_bytes_per_client)
+            cfg.upload_wire_bytes_per_client)
         return download_bytes, upload_bytes
 
     def _call_val(self, batch):
@@ -970,11 +1115,16 @@ class FedOptimizer:
         # replicated construction.
         mesh = self.model.mesh
         sharded = model_axis_size(mesh) > 1
+        self._mesh, self._sharded = mesh, sharded
         self.server_state = ServerState.init(
             self.args,
             sharding=(server_state_sharding(mesh,
                                             self.args.transmit_shape)
                       if sharded else None))
+        # geometry the live server state was allocated for: a knob
+        # move that changes transmit_shape (--autopilot_geometry)
+        # re-inits the momentum/error tables at the new shape
+        self._server_geom = tuple(self.args.transmit_shape)
         # donate weights + server state: both are replaced by the
         # round's outputs and the stale buffers are never read again —
         # at GPT-2 scale that's ~1 GB of peak HBM saved per step
@@ -1023,16 +1173,48 @@ class FedOptimizer:
         self._step_count += 1
         noise_rng = jax.random.fold_in(self._noise_rng,
                                        self._step_count)
+        server_fn, svar = self._server_round, None
+        if getattr(m, "_autopilot", None) is not None:
+            # the aggregate pending on the model was emitted by a
+            # specific round variant — its server program (the wire
+            # dequant and unsketch geometry are trace-time constants)
+            # must match. Variants hold their own jitted server round;
+            # the static self._server_round is never dispatched, so it
+            # never compiles.
+            svar = m._variants.get(m.pending_variant_key)
+            if svar.server_fn is None:
+                svar.server_fn = jax.jit(
+                    build_server_round(svar.cfg, probes=self._probes,
+                                       mesh=(self._mesh if self._sharded
+                                             else None)),
+                    donate_argnums=(0, 1))
+            geom = tuple(svar.cfg.transmit_shape)
+            if geom != self._server_geom:
+                # geometry move: the sketch-shaped server tables are
+                # re-seeded at the new shape (momentum restarts — the
+                # controller's geometry steps are opt-in for exactly
+                # this reason)
+                self.server_state = ServerState.init(
+                    svar.cfg,
+                    sharding=(server_state_sharding(self._mesh, geom)
+                              if self._sharded else None))
+                self._server_geom = geom
+            server_fn = svar.server_fn
+        sfirst = svar is not None and "server" not in svar.compiled
+        cmark = compile_mark() if sfirst else None
         # round ridx's ledger record is still current (the next
         # _call_train's begin_round closes it), so the server span
         # lands on the round whose aggregate it consumes
         with m.telemetry.span("server"), trace.phase("server"):
-            out = self._server_round(
+            out = server_fn(
                 m.ps_weights, self.server_state,
                 m.pending_aggregated,
                 jnp.asarray(lr, jnp.float32),
                 m.client_states.velocities, m.pending_client_ids,
                 noise_rng)
+        if cmark is not None:
+            svar.compiled.add("server")
+            m._stamp_vcompile(svar.key, cmark)
         sprobes = None
         if self._probes:
             new_ps, self.server_state, new_vel, update, support, \

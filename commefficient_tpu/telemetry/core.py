@@ -76,6 +76,20 @@ _COMPILE = {"events": 0, "secs": 0.0}
 _LISTENER_STATE = {"done": False}
 
 
+def compile_mark():
+    """Snapshot of the process-wide compile accumulator; pair with
+    ``compile_delta`` to attribute the compiles between two points to a
+    specific cause (fed_model stamps first-dispatch compiles of a round
+    variant onto the round record as ``vcompile_*:<key>`` counters)."""
+    return (_COMPILE["events"], _COMPILE["secs"])
+
+
+def compile_delta(mark):
+    """(events, secs) accumulated since ``mark``."""
+    ev0, s0 = mark
+    return (_COMPILE["events"] - ev0, _COMPILE["secs"] - s0)
+
+
 def _ensure_compile_listener():
     if _LISTENER_STATE["done"]:
         return

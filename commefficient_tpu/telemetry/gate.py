@@ -198,35 +198,60 @@ def overlap_suffix(overlap_depth) -> str:
     return f"o{n}" if n > 1 else ""
 
 
+def band_suffix(band) -> str:
+    """Canonical key fragment for an autopilot-controlled run:
+    ``b<lo-hi>`` (``b0.2-0.6``) when ``--autopilot on`` held the
+    recovery error inside ``--autopilot_band LO:HI``, ``""`` for
+    static-knob runs. An autopilot run's wall profile mixes every
+    lattice point the controller visited (plus the re-jit cache's
+    compile stalls), so it is a different experiment from any one
+    static program — and two different bands walk different ladders.
+    Like the wire/async/overlap fragments there is NO fallback: a
+    banded ledger must never resolve (or overwrite) a static pin, nor
+    another band's. Accepts "LO:HI", "LO-HI", or a (lo, hi) pair."""
+    if not band:
+        return ""
+    if isinstance(band, str):
+        s = band.replace(":", "-")
+    else:
+        lo, hi = (float(x) for x in tuple(band)[:2])
+        s = f"{lo:g}-{hi:g}"
+    return f"b{s}"
+
+
 def topology_key(device_count=None, process_count=None,
                  mesh_shape=None, wire_dtype=None,
-                 async_k=None, overlap_depth=None) -> str:
+                 async_k=None, overlap_depth=None, band=None) -> str:
     """Baseline entry key for one topology point. ``d<D>p<P>`` when
     both counts are known — suffixed ``m<C>x<M>`` for 2D-mesh runs
     (a 4x2 and an 8x1 run on the same 8 chips are different programs,
     not one noise band), ``q<dtype>`` for quantized-wire runs
     (int8 vs f32 collectives are different experiments), ``a<K>``
     for buffered-arrival runs (an async fold overlaps work a barrier
-    round waits for) and ``o<N>`` for chunked-emission runs (a
+    round waits for), ``o<N>`` for chunked-emission runs (a
     pipelined collective profile is a different experiment from the
-    serial one) — :data:`ANY_TOPOLOGY` otherwise: unknown
+    serial one) and ``b<lo-hi>`` for autopilot-controlled runs (the
+    knob walk mixes lattice points no static program mixes) —
+    :data:`ANY_TOPOLOGY` otherwise: unknown
     topologies form their own bucket rather than silently matching a
-    counted one. Quantized/async/overlapped runs with unknown counts
-    still split off (``any-q<dtype>``, ``any-a<K>``, ``any-o<N>``)."""
+    counted one. Quantized/async/overlapped/banded runs with unknown
+    counts still split off (``any-q<dtype>``, ``any-a<K>``,
+    ``any-o<N>``, ``any-b<lo-hi>``)."""
     if device_count is None or process_count is None:
         w = (wire_suffix(wire_dtype) + async_suffix(async_k)
-             + overlap_suffix(overlap_depth))
+             + overlap_suffix(overlap_depth) + band_suffix(band))
         return f"{ANY_TOPOLOGY}-{w}" if w else ANY_TOPOLOGY
     return (f"d{int(device_count)}p{int(process_count)}"
             f"{mesh_suffix(mesh_shape)}{wire_suffix(wire_dtype)}"
-            f"{async_suffix(async_k)}{overlap_suffix(overlap_depth)}")
+            f"{async_suffix(async_k)}{overlap_suffix(overlap_depth)}"
+            f"{band_suffix(band)}")
 
 
 def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                         device_count=None, process_count=None,
                         config_hash: str = "", mesh_shape=None,
                         wire_dtype=None, async_k=None,
-                        overlap_depth=None) -> Dict:
+                        overlap_depth=None, band=None) -> Dict:
     entry = {"ts": clock.wall(), "source": source, "metrics": metrics}
     if device_count is not None:
         entry["device_count"] = int(device_count)
@@ -244,6 +269,9 @@ def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
         entry["async_buffer_size"] = int(async_k)
     if overlap_suffix(overlap_depth):
         entry["overlap_depth"] = int(overlap_depth)
+    if band_suffix(band):
+        entry["autopilot_band"] = (str(band) if isinstance(band, str)
+                                   else list(band))
     return entry
 
 
@@ -251,16 +279,18 @@ def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
                   extra: Dict = None, device_count=None,
                   process_count=None, config_hash: str = "",
                   mesh_shape=None, wire_dtype=None,
-                  async_k=None, overlap_depth=None) -> Dict:
+                  async_k=None, overlap_depth=None,
+                  band=None) -> Dict:
     """A fresh schema-2 baseline holding one topology entry."""
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k, overlap_depth)
+                       wire_dtype, async_k, overlap_depth, band)
     base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
             "topologies": {key: make_topology_entry(
                 metrics, source=source, device_count=device_count,
                 process_count=process_count, config_hash=config_hash,
                 mesh_shape=mesh_shape, wire_dtype=wire_dtype,
-                async_k=async_k, overlap_depth=overlap_depth)}}
+                async_k=async_k, overlap_depth=overlap_depth,
+                band=band)}}
     if extra:
         base.update(extra)
     return base
@@ -284,7 +314,8 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
                     source: str = "", device_count=None,
                     process_count=None, config_hash: str = "",
                     mesh_shape=None, wire_dtype=None,
-                    async_k=None, overlap_depth=None) -> Dict:
+                    async_k=None, overlap_depth=None,
+                    band=None) -> Dict:
     """Insert/replace ONE topology's entry, leaving every other
     topology point untouched — how the gate CLI re-captures the
     8-device headline without disturbing the single-chip one.
@@ -294,12 +325,12 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
          "topologies": {}}
     base["topologies"] = dict(base.get("topologies", {}))
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k, overlap_depth)
+                       wire_dtype, async_k, overlap_depth, band)
     base["topologies"][key] = make_topology_entry(
         metrics, source=source, device_count=device_count,
         process_count=process_count, config_hash=config_hash,
         mesh_shape=mesh_shape, wire_dtype=wire_dtype,
-        async_k=async_k, overlap_depth=overlap_depth)
+        async_k=async_k, overlap_depth=overlap_depth, band=band)
     base["ts"] = clock.wall()
     return base
 
@@ -307,7 +338,7 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
 def baseline_entry(baseline: Dict, device_count=None,
                    process_count=None, mesh_shape=None,
                    wire_dtype=None, async_k=None,
-                   overlap_depth=None):
+                   overlap_depth=None, band=None):
     """The topology entry ``compare`` gates against, or None when the
     baseline has no entry for this topology. A 2D-mesh run resolves
     its exact ``d<D>p<P>m<C>x<M>`` entry first and falls back to the
@@ -333,16 +364,18 @@ def baseline_entry(baseline: Dict, device_count=None,
     topologies = baseline.get("topologies", {})
     entry = topologies.get(
         topology_key(device_count, process_count, mesh_shape,
-                     wire_dtype, async_k, overlap_depth))
+                     wire_dtype, async_k, overlap_depth, band))
     if entry is None and mesh_suffix(mesh_shape):
-        # drop only the mesh fragment; the wire, async AND overlap
-        # fragments stay — there is no cross-dtype, cross-mode or
-        # cross-depth fallback (an o2 pipelined round has a different
-        # collective schedule than the serial o1 program)
+        # drop only the mesh fragment; the wire, async, overlap AND
+        # band fragments stay — there is no cross-dtype, cross-mode,
+        # cross-depth or cross-band fallback (an o2 pipelined round
+        # has a different collective schedule than the serial o1
+        # program; a b0.2-0.6 autopilot walk mixes programs no static
+        # pin measured)
         entry = topologies.get(
             topology_key(device_count, process_count,
                          wire_dtype=wire_dtype, async_k=async_k,
-                         overlap_depth=overlap_depth))
+                         overlap_depth=overlap_depth, band=band))
     return entry
 
 
@@ -356,7 +389,7 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
             mad_k: float = MAD_K, device_count=None,
             process_count=None, mesh_shape=None,
             wire_dtype=None, async_k=None,
-            overlap_depth=None) -> Dict:
+            overlap_depth=None, band=None) -> Dict:
     """Gate ``metrics`` against ``baseline``'s entry for this
     topology. Returns::
 
@@ -370,10 +403,10 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
     when the baseline has no entry for this topology — an ungated
     topology point must fail loudly, not pass silently."""
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k, overlap_depth)
+                       wire_dtype, async_k, overlap_depth, band)
     entry = baseline_entry(baseline, device_count, process_count,
                            mesh_shape, wire_dtype, async_k,
-                           overlap_depth)
+                           overlap_depth, band)
     if entry is None:
         have = ", ".join(sorted(baseline.get("topologies", {}))) \
             or "none"

@@ -104,10 +104,21 @@ def run_mesh_shape(manifest: dict):
 def run_wire_dtype(manifest: dict):
     """The run's uplink wire dtype (``--sketch_dtype``) from its
     recorded config, or None for non-sketch / pre-quantization
-    manifests — they only ever carried f32 on the wire."""
+    manifests — they only ever carried f32 on the wire. An autopilot
+    run reports the dtype of the point the controller CONVERGED on
+    (the recorded trajectory's ``final`` key): that is the wire the
+    steady-state rounds — the ones a perf pin should describe —
+    actually moved, so a walk that lands on int8 pins as
+    ``...qint8b<lo-hi>``."""
     cfg = manifest.get("config") or {}
     if cfg.get("mode") != "sketch":
         return None
+    ap = run_autopilot(manifest)
+    final = (ap or {}).get("final") or ""
+    if final:
+        # variant keys are "<dtype>-k..-r..-c..-re.." (autopilot/
+        # lattice.py key_str); the leading segment is the wire dtype
+        return final.split("-", 1)[0] or None
     return cfg.get("sketch_dtype") or None
 
 
@@ -131,6 +142,25 @@ def run_overlap_depth(manifest: dict):
         return None
     n = int(cfg.get("overlap_depth") or 0)
     return n if n > 1 else None
+
+
+def run_autopilot(manifest: dict):
+    """The run's recorded autopilot trajectory block (band, ladder,
+    per-round observations — the bit-exact replay input of
+    ``python -m commefficient_tpu.autopilot.replay``), or None for
+    static-knob / pre-autopilot manifests."""
+    rec = manifest.get("autopilot")
+    return rec if isinstance(rec, dict) else None
+
+
+def run_band(manifest: dict):
+    """The run's ``--autopilot_band LO:HI`` string, or None for
+    static-knob manifests — the band half of the ``b<lo-hi>``
+    topology fragment (telemetry/gate.py band_suffix)."""
+    cfg = manifest.get("config") or {}
+    if str(cfg.get("autopilot") or "off") != "on":
+        return None
+    return cfg.get("autopilot_band") or None
 
 
 def run_segments(manifest: dict) -> list:
@@ -166,13 +196,16 @@ def run_key(manifest: dict) -> tuple:
     experiment, not a regression. 2D-mesh runs append their
     ``m<C>x<M>`` fragment, quantized-wire runs their ``q<dtype>``
     fragment, buffered-arrival runs their ``a<K>`` fragment and
-    chunk-pipelined runs their ``o<N>`` fragment (a 4x2 and an 8x1
-    program on the same chips — or an int8 and an f32 wire, or a
-    buffered and a barrier round, or a depth-2 pipelined and a serial
-    round — are different experiments); 1-D f32 synchronous serial
-    runs keep the historical 3-tuple, so old manifests stay
-    comparable to each other."""
+    chunk-pipelined runs their ``o<N>`` fragment and
+    autopilot-controlled runs their ``b<lo-hi>`` fragment (a 4x2 and
+    an 8x1 program on the same chips — or an int8 and an f32 wire, or
+    a buffered and a barrier round, or a depth-2 pipelined and a
+    serial round, or a knob walk and a static program — are different
+    experiments); 1-D f32 synchronous serial static runs keep the
+    historical 3-tuple, so old manifests stay comparable to each
+    other."""
     from commefficient_tpu.telemetry.gate import (async_suffix,
+                                                  band_suffix,
                                                   mesh_suffix,
                                                   overlap_suffix,
                                                   wire_suffix)
@@ -180,7 +213,8 @@ def run_key(manifest: dict) -> tuple:
     suffix = (mesh_suffix(run_mesh_shape(manifest))
               + wire_suffix(run_wire_dtype(manifest))
               + async_suffix(run_async_k(manifest))
-              + overlap_suffix(run_overlap_depth(manifest)))
+              + overlap_suffix(run_overlap_depth(manifest))
+              + band_suffix(run_band(manifest)))
     return key + (suffix,) if suffix else key
 
 

@@ -503,8 +503,10 @@ def main(argv=None):
 
     if args.do_test:
         # tiny sketch like the reference smoke mode (cv_train.py:329-336)
-        args.k = 10
-        args.num_cols = 10
+        # pre-run CLI override: no round program exists yet for a
+        # knob move to diverge from, so the waivers below are safe
+        args.k = 10  # audit: allow(knob-mutation)
+        args.num_cols = 10  # audit: allow(knob-mutation)
         args.num_rows = 1
         args.num_blocks = 1
 

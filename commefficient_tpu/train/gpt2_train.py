@@ -400,8 +400,10 @@ def main(argv=None):
     args.num_results_train = 1
 
     if args.do_test:
-        args.k = 10
-        args.num_cols = 100
+        # pre-run CLI override: no round program exists yet for a
+        # knob move to diverge from, so the waivers below are safe
+        args.k = 10  # audit: allow(knob-mutation)
+        args.num_cols = 100  # audit: allow(knob-mutation)
         args.num_rows = 1
         args.num_blocks = 1
 

@@ -67,7 +67,7 @@ def load_ledger_records(path):
 def resolve_topology(manifest=None, records=(), device_count=None,
                      process_count=None, mesh_shape=None,
                      wire_dtype=None, async_k=None,
-                     overlap_depth=None):
+                     overlap_depth=None, band=None):
     """The run's (device_count, process_count, mesh_shape,
     wire_dtype, async_k, overlap_depth) for baseline keying: CLI
     overrides win, then the run manifest, then the ledger's meta
@@ -84,12 +84,20 @@ def resolve_topology(manifest=None, records=(), device_count=None,
     ``async_buffer_size``, the meta record's round plan; synchronous
     and pre-async runs resolve to None. ``overlap_depth`` likewise:
     CLI, the manifest config, the meta record's round plan; depth-1
-    (serial) and pre-overlap runs resolve to None."""
+    (serial) and pre-overlap runs resolve to None. ``band``
+    likewise: a CLI "LO:HI" string, the manifest config's
+    ``autopilot_band``, the meta record's round plan; static-knob
+    runs resolve to None (no ``b<lo-hi>`` fragment). A band never
+    falls back across bands: an autopilot run gates only against a
+    baseline entry pinned under the SAME band — its wall profile
+    mixes every knob point the controller visited, which no static
+    pin describes."""
     dc, pc = device_count, process_count
     ms = parse_mesh_shape(mesh_shape)
     wd = wire_dtype
     ak = async_k
     od = overlap_depth
+    bd = band
     if manifest is not None:
         mdc, mpc = registry.run_topology(manifest)
         dc = mdc if dc is None else dc
@@ -102,8 +110,10 @@ def resolve_topology(manifest=None, records=(), device_count=None,
             ak = registry.run_async_k(manifest)
         if od is None:
             od = registry.run_overlap_depth(manifest)
+        if bd is None:
+            bd = registry.run_band(manifest)
     if dc is None or pc is None or ms is None or wd is None \
-            or ak is None or od is None:
+            or ak is None or od is None or bd is None:
         for rec in records:
             if rec.get("kind") != "meta":
                 continue
@@ -126,9 +136,12 @@ def resolve_topology(manifest=None, records=(), device_count=None,
                 ak = int(plan["async_buffer_size"])
             if od is None and plan.get("overlap_depth"):
                 od = int(plan["overlap_depth"])
+            if bd is None and isinstance(plan.get("autopilot"), dict):
+                bd = plan["autopilot"].get("band") or None
             if (dc is not None and pc is not None
                     and ms is not None and wd is not None
-                    and ak is not None and od is not None):
+                    and ak is not None and od is not None
+                    and bd is not None):
                 break
     if wd == "f32":
         wd = None  # historical unsuffixed key
@@ -136,7 +149,9 @@ def resolve_topology(manifest=None, records=(), device_count=None,
         ak = None  # synchronous runs keep the historical key
     if not od or int(od) <= 1:
         od = None  # serial rounds keep the historical key
-    return dc, pc, ms, wd, ak, od
+    if not bd:
+        bd = None  # static-knob runs keep the unbanded key
+    return dc, pc, ms, wd, ak, od, bd
 
 
 def parse_mesh_shape(mesh_shape):
@@ -208,6 +223,13 @@ def main(argv=None):
                          "manifest config / ledger meta plan; "
                          "depth-1 serial runs keep the historical "
                          "unsuffixed key)")
+    ap.add_argument("--band", default=None,
+                    help="override the run's --autopilot_band "
+                         "(\"LO:HI\") for baseline keying (normally "
+                         "read from the manifest config / ledger "
+                         "meta plan; static-knob runs keep the "
+                         "unbanded key). Banded entries NEVER gate "
+                         "against another band or an unbanded pin.")
     args = ap.parse_args(argv)
 
     ledger = args.ledger
@@ -223,7 +245,7 @@ def main(argv=None):
         print(f"run: {mpath} (config {manifest.get('config_hash', '')[:8]}, "
               f"git {manifest.get('git_sha', '')[:8]}, "
               f"topology "
-              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest), registry.run_overlap_depth(manifest))}"
+              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest), registry.run_overlap_depth(manifest), registry.run_band(manifest))}"
               f") -> {ledger}")
     if ledger is None:
         ap.error("one of --ledger / --runs_dir is required")
@@ -233,11 +255,11 @@ def main(argv=None):
     if not metrics:
         print(f"{ledger}: no gateable metrics (empty ledger?)")
         return 1
-    dc, pc, ms, wd, ak, od = resolve_topology(
+    dc, pc, ms, wd, ak, od, bd = resolve_topology(
         manifest, records, args.device_count, args.process_count,
         args.mesh_shape, args.wire_dtype, args.async_k,
-        args.overlap_depth)
-    topo = gate.topology_key(dc, pc, ms, wd, ak, od)
+        args.overlap_depth, args.band)
+    topo = gate.topology_key(dc, pc, ms, wd, ak, od, bd)
     print(f"{ledger}: {len(metrics)} metric(s) extracted "
           f"(topology {topo})")
     chash = (manifest or {}).get("config_hash", "")
@@ -251,7 +273,7 @@ def main(argv=None):
         chain = " -> ".join(
             gate.topology_key(s.get("device_count"),
                               s.get("process_count"),
-                              s.get("mesh_shape"), wd, ak, od)
+                              s.get("mesh_shape"), wd, ak, od, bd)
             for s in segs)
         print(f"perf gate: REFUSED — run resumed across a mid-run "
               f"topology change ({len(segs)} segments: {chain}); its "
@@ -276,7 +298,8 @@ def main(argv=None):
                   "with --write-baseline first")
             return 1
         existing = gate.load_baseline(gate_path)
-        entry = gate.baseline_entry(existing, dc, pc, ms, wd, ak, od)
+        entry = gate.baseline_entry(existing, dc, pc, ms, wd, ak, od,
+                                    bd)
         if entry is None and args.write_baseline and not args.check:
             # first capture of a NEW topology point: nothing to gate
             # this run against, other points stay untouched
@@ -299,7 +322,8 @@ def main(argv=None):
                                    mad_k=args.mad_k,
                                    device_count=dc, process_count=pc,
                                    mesh_shape=ms, wire_dtype=wd,
-                                   async_k=ak, overlap_depth=od)
+                                   async_k=ak, overlap_depth=od,
+                                   band=bd)
             print(gate.render_verdict(verdict))
 
     if args.write_baseline:
@@ -317,7 +341,7 @@ def main(argv=None):
                                  device_count=dc, process_count=pc,
                                  config_hash=chash, mesh_shape=ms,
                                  wire_dtype=wd, async_k=ak,
-                                 overlap_depth=od),
+                                 overlap_depth=od, band=bd),
             args.write_baseline)
         print(f"baseline[{topo}] -> {args.write_baseline}")
 

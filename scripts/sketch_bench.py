@@ -104,6 +104,17 @@ def main():
                     help="append the result as a telemetry JSONL "
                     "bench record and register a run manifest "
                     "(stdout line unchanged)")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="also run the federated autopilot acceptance "
+                    "leg: an 8-round CPU sketch loop launched at f32 "
+                    "where the controller must converge to a >=2x "
+                    "cheaper wire dtype with recovery error in band "
+                    "every round (run under XLA_FLAGS=--xla_force_"
+                    "host_platform_device_count=8 JAX_PLATFORMS=cpu)")
+    ap.add_argument("--autopilot_band", default="0.05:0.6",
+                    help="LO:HI recovery-error band for the "
+                    "--autopilot leg (also keys its baseline pin)")
+    ap.add_argument("--autopilot_rounds", type=int, default=8)
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -216,15 +227,108 @@ def main():
         res["chain_sketch_plus_estimates_ms"] = round(
             (time.perf_counter() - t0) / n * 1e3, 2)
 
+    ap_rec = ap_cfg = None
+    if args.autopilot:
+        ap_res, ap_rec, ap_cfg = run_autopilot_leg(args)
+        res["autopilot"] = ap_res
+
     print(json.dumps(res))
     if args.ledger:
         from commefficient_tpu.telemetry import (append_bench_record,
                                                  registry)
         append_bench_record(args.ledger, "sketch_bench", res,
                             backend=jax.default_backend())
-        registry.maybe_write_manifest(
-            args, bench={"sketch_bench": res},
-            extra={"wire_dtype": wire})
+        if ap_cfg is not None:
+            # manifest carries the FED config (autopilot + band) so
+            # registry.run_band / run_wire_dtype key the pin from the
+            # CONVERGED point, e.g. d8p1qint8b0.05-0.6
+            registry.maybe_write_manifest(
+                ap_cfg, bench={"sketch_bench": res},
+                extra={"autopilot": ap_rec, "wire_dtype": wire})
+        else:
+            registry.maybe_write_manifest(
+                args, bench={"sketch_bench": res},
+                extra={"wire_dtype": wire})
+
+
+def run_autopilot_leg(args):
+    """The acceptance loop behind ``--autopilot``: a small federated
+    sketch run (heavy-tailed synthetic gradients, probes every round)
+    launched at f32 whose controller must walk to a cheaper wire while
+    holding the recovery-error band. Returns ``(summary, record,
+    cfg)`` — the record replays bit-exact via
+    ``commefficient_tpu.autopilot.replay_record`` and rides the run
+    manifest, and cfg (ledger attached) is what the manifest is keyed
+    by."""
+    from commefficient_tpu.autopilot import parse_band, replay_record
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    W, B, d, num_clients = 4, 2, 512, 16
+    cfg = Config(mode="sketch", error_type="virtual",
+                 local_momentum=0.0, virtual_momentum=0.9,
+                 num_workers=W, local_batch_size=B, seed=5,
+                 num_clients=num_clients, k=64, num_rows=5,
+                 num_cols=2048, sketch_dtype="f32", probe_every=1,
+                 autopilot="on", autopilot_band=args.autopilot_band,
+                 autopilot_cooldown=1, ledger=args.ledger)
+    model = FedModel(None, {"w": jnp.zeros((d,), jnp.float32)},
+                     loss, cfg, padded_batch_size=B)
+    opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+    # power-law feature scaling -> heavy-tailed gradients, so top-k
+    # recovery sits far below the dense-iid floor and the band has
+    # room to hold across the dtype walk (same recipe as the tests)
+    scale = (np.arange(1, d + 1) ** -1.5).astype(np.float32)
+    rng = np.random.RandomState(5)
+    t0 = time.perf_counter()
+    for _ in range(args.autopilot_rounds):
+        batch = {
+            "client_ids": rng.choice(num_clients, W, replace=False)
+            .astype(np.int32),
+            "x": jnp.asarray(rng.randn(W, B, d).astype(np.float32)
+                             * scale),
+            "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+            "mask": jnp.ones((W, B), jnp.float32)}
+        model(batch)
+        opt.step()
+    wall = time.perf_counter() - t0
+
+    rec = model.autopilot_record()
+    lo, hi = parse_band(args.autopilot_band)
+    observed = [t for t in rec["trajectory"]
+                if t["recovery_error"] is not None]
+    counters = model._variants.counters()
+    visited = {t["key"] for t in rec["trajectory"]}
+    visited.add(rec["initial"])
+    summary = {
+        "rounds": args.autopilot_rounds,
+        "band": args.autopilot_band,
+        "initial": rec["initial"],
+        "final": rec["final"],
+        "initial_wire_bytes": rec["initial_wire_bytes"],
+        "final_wire_bytes": rec["final_wire_bytes"],
+        "uplink_reduction": round(
+            rec["initial_wire_bytes"] / rec["final_wire_bytes"], 2),
+        "band_held": bool(observed) and all(
+            t["recovery_error"] <= hi for t in observed),
+        "panics": sum(t["action"] == "panic"
+                      for t in rec["trajectory"]),
+        "variant_compiles": counters["misses"],
+        "lattice_points_visited": len(visited),
+        "compiles_within_visited": counters["misses"] <= len(visited),
+        "replay_exact": replay_record(rec)
+        == [t["key"] for t in rec["trajectory"]],
+        "wall_s": round(wall, 2),
+    }
+    model.finalize()
+    return summary, rec, cfg
 
 
 if __name__ == "__main__":

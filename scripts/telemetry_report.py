@@ -85,6 +85,9 @@ def summarize(records) -> dict:
     skew_vals = {}           # v4: skew stat -> [seconds, ...]
     stragglers = {}          # v4: device id -> straggler-round count
     shard_vals = {}          # merged ledgers: "p<k>" -> aggregates
+    variant_first = {}       # autopilot variant key -> first round a
+                             # compile was stamped under it
+    frontier_pts = []        # (uplink_bytes, recovery_error, round)
     uplink = downlink = 0.0
     rss_peak = hbm_peak = None
     for r in rounds:
@@ -136,8 +139,21 @@ def summarize(records) -> dict:
                     entry["rss_peak"] = rss
         for name, n in r["counters"].items():
             counters[name] = counters.get(name, 0) + n
+            # autopilot re-jit cache: each compile is stamped with
+            # its variant key — the round it first appears is the
+            # round that variant entered the program (the ledger-side
+            # view of the controller's knob trajectory)
+            if name.startswith("vcompile_programs:"):
+                key = name.split(":", 1)[1]
+                variant_first.setdefault(key, r["round"])
         uplink += r.get("uplink_bytes") or 0.0
         downlink += r.get("downlink_bytes") or 0.0
+        rerr = (r.get("probes") or {}).get("recovery_error")
+        rup = r.get("uplink_bytes")
+        if isinstance(rerr, (int, float)) and \
+                isinstance(rup, (int, float)):
+            frontier_pts.append((float(rup), float(rerr),
+                                 r["round"]))
         # v2-only keys: absent on v1 records, hence .get
         for key, val in (r.get("probes") or {}).items():
             if isinstance(val, (int, float)):
@@ -217,6 +233,37 @@ def summarize(records) -> dict:
             "host_gap_mean_ms": (round(1e3 * sum(hg) / len(hg), 3)
                                  if hg else None),
             "host_rss_peak_bytes": entry["rss_peak"]}
+    # per-variant compile cost (autopilot re-jit cache): the
+    # vcompile_* counter triplet keyed by variant cache key —
+    # raw XLA compile events, wall seconds, and whole executables
+    variant_compiles = {}
+    for name, n in counters.items():
+        if not name.startswith("vcompile_"):
+            continue
+        kind, key = name.split(":", 1)
+        slot = variant_compiles.setdefault(
+            key, {"events": 0, "secs": 0.0, "programs": 0,
+                  "first_round": variant_first.get(key)})
+        if kind == "vcompile_events":
+            slot["events"] = int(n)
+        elif kind == "vcompile_secs":
+            slot["secs"] = round(float(n), 3)
+        elif kind == "vcompile_programs":
+            slot["programs"] = int(n)
+    # bytes-vs-recovery-error frontier: one point per uplink level
+    # the controller settled on — what each byte budget bought in
+    # recovery error (cheapest in-band point is the autopilot target)
+    frontier = []
+    by_bytes = {}
+    for up, err, ridx in frontier_pts:
+        by_bytes.setdefault(up, []).append((err, ridx))
+    for up in sorted(by_bytes, reverse=True):
+        errs = [e for e, _ in by_bytes[up]]
+        frontier.append({
+            "uplink_bytes": up, "rounds": len(errs),
+            "first_round": min(r for _, r in by_bytes[up]),
+            "err_mean": sum(errs) / len(errs),
+            "err_max": max(errs)})
     return {
         "meta": next((r for r in records if r["kind"] == "meta"),
                      None),
@@ -234,6 +281,8 @@ def summarize(records) -> dict:
              if r["kind"] == "meta" and r.get("cost_model")), None),
         "probes": probes,
         "alarm_rounds": alarm_rounds,
+        "variant_compiles": dict(sorted(variant_compiles.items())),
+        "frontier": frontier,
         "counters": dict(sorted(counters.items())),
         "host_rss_peak_bytes": rss_peak,
         "hbm_peak_bytes": hbm_peak,
@@ -328,6 +377,30 @@ def render_summary(s, label="") -> str:
     for a in s.get("alarm_rounds", []):
         names = ", ".join(al.get("rule", "?") for al in a["alarms"])
         lines.append(f"  ALARM round {a['round']}: {names}")
+    vc = s.get("variant_compiles") or {}
+    if vc:
+        # knob trajectory, ledger view: variants in first-dispatch
+        # order (the manifest's autopilot record holds the full
+        # per-round decision log for bit-exact replay)
+        order = sorted(vc, key=lambda k: (
+            vc[k].get("first_round")
+            if vc[k].get("first_round") is not None else 1 << 30))
+        lines.append("  knob trajectory: " + " -> ".join(
+            f"{k}@r{vc[k]['first_round']}"
+            if vc[k].get("first_round") is not None else k
+            for k in order))
+        for k in order:
+            v = vc[k]
+            lines.append(
+                f"  variant {k}: {v['programs']} program(s) "
+                f"compiled in {v['secs']} s "
+                f"({v['events']} XLA events)")
+    for p in s.get("frontier") or []:
+        lines.append(
+            f"  frontier {_mib(p['uplink_bytes'])}/round: "
+            f"recovery err mean {p['err_mean']:.4g}, "
+            f"max {p['err_max']:.4g} "
+            f"({p['rounds']} round(s), from r{p['first_round']})")
     if s["counters"]:
         lines.append(f"  counters: {s['counters']}")
     if s["host_rss_peak_bytes"] is not None:
@@ -399,6 +472,18 @@ def diff_summaries(a: dict, b: dict) -> dict:
         probe_diff[name] = entry
     if probe_diff:
         out["probes"] = probe_diff
+    vc_diff = {}
+    va = a.get("variant_compiles") or {}
+    vb = b.get("variant_compiles") or {}
+    for key in sorted(set(va) | set(vb)):
+        ea, eb = va.get(key), vb.get(key)
+        vc_diff[key] = {
+            "a_secs": ea["secs"] if ea else None,
+            "b_secs": eb["secs"] if eb else None,
+            "a_programs": ea["programs"] if ea else None,
+            "b_programs": eb["programs"] if eb else None}
+    if vc_diff:
+        out["variant_compiles"] = vc_diff
     aa = [x["round"] for x in a.get("alarm_rounds", [])]
     ab = [x["round"] for x in b.get("alarm_rounds", [])]
     if aa or ab:
@@ -434,6 +519,13 @@ def render_diff(d, label_a, label_b) -> str:
         r = f" ({e['ratio']}x)" if "ratio" in e else ""
         lines.append(f"  probe {name}: mean {e['a_mean']:.6g} -> "
                      f"{e['b_mean']:.6g}{r}")
+    for key, e in d.get("variant_compiles", {}).items():
+        fmt = lambda s, p: (f"{s} s / {p} prog"
+                            if s is not None else "-")
+        lines.append(
+            f"  variant {key} compile: "
+            f"{fmt(e['a_secs'], e['a_programs'])} -> "
+            f"{fmt(e['b_secs'], e['b_programs'])}")
     if "alarm_rounds" in d:
         e = d["alarm_rounds"]
         lines.append(f"  ALARM rounds: {e['a']} -> {e['b']}")

@@ -364,6 +364,68 @@ def async_smoke():
             f"staleness hist {hist}")
 
 
+def autopilot_smoke():
+    """Adaptive compression autopilot on the REAL backend: from an f32
+    launch the probe-driven controller must walk to a cheaper wire
+    dtype while the recovery error holds the band on every observed
+    round, with the re-jit cache compiling no more round variants than
+    lattice points actually visited (warm-ahead never compiles an
+    unvisited point) and the recorded trajectory replaying
+    bit-exactly."""
+    from commefficient_tpu.autopilot import parse_band, replay_record
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+
+    W, B, d = 4, 2, 512
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    cfg = Config(mode="sketch", error_type="virtual",
+                 local_momentum=0.0, virtual_momentum=0.9,
+                 num_workers=W, local_batch_size=B, seed=5,
+                 num_clients=16, k=64, num_rows=5, num_cols=2048,
+                 sketch_dtype="f32", probe_every=1, autopilot="on",
+                 autopilot_band="0.05:0.6", autopilot_cooldown=1)
+    model = FedModel(None, {"w": jnp.zeros((d,), jnp.float32)},
+                     loss, cfg, padded_batch_size=B)
+    opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+    scale = (np.arange(1, d + 1) ** -1.5).astype(np.float32)
+    rng = np.random.RandomState(5)
+    for _ in range(8):
+        model({"client_ids": rng.choice(16, W, replace=False)
+               .astype(np.int32),
+               "x": jnp.asarray(rng.randn(W, B, d).astype(np.float32)
+                                * scale),
+               "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+               "mask": jnp.ones((W, B), jnp.float32)})
+        opt.step()
+    rec = model.autopilot_record()
+    counters = model._variants.counters()
+    model.finalize()
+
+    lo, hi = parse_band(cfg.autopilot_band)
+    observed = [t for t in rec["trajectory"]
+                if t["recovery_error"] is not None]
+    assert observed, "no recovery observations reached the controller"
+    assert all(t["recovery_error"] <= hi for t in observed), observed
+    assert not any(t["action"] == "panic"
+                   for t in rec["trajectory"]), rec["trajectory"]
+    assert rec["final_wire_bytes"] * 2 <= rec["initial_wire_bytes"], rec
+    visited = {t["key"] for t in rec["trajectory"]}
+    visited.add(rec["initial"])
+    assert counters["misses"] <= len(visited), (counters, visited)
+    assert replay_record(rec) == [t["key"] for t in rec["trajectory"]]
+    return (f"{rec['initial'].split('-', 1)[0]} -> {rec['final']}, "
+            f"uplink {rec['initial_wire_bytes'] / rec['final_wire_bytes']:.1f}x "
+            f"smaller, {counters['misses']} compiles / "
+            f"{len(visited)} points visited")
+
+
 def audit_smoke():
     """Static audit on the REAL backend: zero unwaived lint hits, and
     the sketch fused round compiled for this topology is donation-
@@ -736,6 +798,7 @@ def main():
     check("quant_smoke", quant_smoke)
     check("overlap_smoke", overlap_smoke)
     check("async_smoke", async_smoke)
+    check("autopilot_smoke", autopilot_smoke)
     check("audit_smoke", audit_smoke)
     check("trace_smoke", trace_smoke)
     check("scaling_smoke", scaling_smoke)
