@@ -252,6 +252,62 @@ def _check_python_rng(rel, lines, tree):
     return hits
 
 
+# --- rule: noise-confinement -------------------------------------------
+
+
+_NOISE_FNS = {"PRNGKey", "normal", "truncated_normal", "laplace",
+              "gumbel", "cauchy"}
+
+
+def _check_noise_confinement(rel, lines, tree):
+    """Raw ``jax.random.PRNGKey``/``jax.random.normal`` (and friends)
+    outside ``privacy/`` are hard audit failures: every noise draw and
+    every key-stream genesis must route through privacy/mechanism.py
+    (``noise_stream`` / ``gaussian_noise`` / ``add_table_noise``) so
+    the DP accountant's claim — "all injected randomness is calibrated
+    and charged" — is checkable by construction. A stray
+    ``jax.random.normal`` anywhere else is either unaccounted noise
+    (a silent privacy hole) or an unseeded stream the replay contract
+    cannot reproduce. Exempt: ``privacy/`` (the owner), ``models/``
+    (parameter *initialisation* is pre-release randomness, not noise
+    injected into a private release), and ``data/chaos.py`` (the
+    test/bench-only fault injector, already fenced off by
+    chaos-confinement). Key *consumption* — ``fold_in``, ``split``,
+    threading keys through round plans — stays legal everywhere; only
+    genesis and draws are confined."""
+    if _top(rel) in ("privacy", "models") \
+            or rel.as_posix() == "data/chaos.py":
+        return []
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _NOISE_FNS):
+            continue
+        v = f.value
+        jax_random = (isinstance(v, ast.Attribute)
+                      and v.attr == "random"
+                      and isinstance(v.value, ast.Name)
+                      and v.value.id == "jax")
+        bare_random = isinstance(v, ast.Name) and v.id == "random"
+        if not (jax_random or bare_random):
+            continue
+        if f.attr == "PRNGKey":
+            hits.append((node.lineno,
+                         "raw jax.random.PRNGKey() outside privacy/ — "
+                         "mint streams via privacy.noise_stream so "
+                         "every injected-randomness source has one "
+                         "accountable owner"))
+        else:
+            hits.append((node.lineno,
+                         f"raw jax.random.{f.attr}() noise draw "
+                         "outside privacy/ — route through "
+                         "privacy.gaussian_noise/add_table_noise so "
+                         "the accountant charges it"))
+    return hits
+
+
 # --- rule: raw-devices -------------------------------------------------
 
 
@@ -626,6 +682,9 @@ ALL_RULES = [
     Rule("python-rng",
          "stdlib/NumPy RNG in compiled scope",
          _check_python_rng),
+    Rule("noise-confinement",
+         "raw jax.random.PRNGKey/normal noise call outside privacy/",
+         _check_noise_confinement),
     Rule("raw-devices",
          "raw jax.devices()/jax.local_devices() inside telemetry/",
          _check_raw_devices),

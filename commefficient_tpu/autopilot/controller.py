@@ -33,6 +33,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from commefficient_tpu.autopilot.lattice import (VariantKey,
+                                                 apply_knobs,
                                                  build_ladder,
                                                  key_of, key_str,
                                                  ladder_index,
@@ -132,20 +133,64 @@ class AutopilotController:
         }
 
 
+def _budget_feasible(cfg: Config):
+    """``--dp sketch`` with a hard ε budget: a lattice point is
+    feasible only if running the ENTIRE remaining run at it never
+    exhausts the budget sooner than the launch point would —
+    equivalently, its per-round RDP cost at the variant's
+    (recalibrated) ``dp_noise_mult`` fits at least as many rounds
+    under ``--dp_epsilon`` as the base σ does (privacy/accountant.py
+    steps_to_budget on the composed curve). Returns the keep
+    predicate; always-true when the constraint is off."""
+    if (str(getattr(cfg, "dp", "off")) == "off"
+            or float(getattr(cfg, "dp_epsilon", 0.0) or 0.0) <= 0
+            or float(getattr(cfg, "dp_noise_mult", 0.0) or 0.0) <= 0):
+        return lambda key: True
+    from commefficient_tpu.privacy import (sample_rate_of,
+                                           steps_to_budget)
+    q = sample_rate_of(cfg)
+    delta = float(cfg.dp_delta)
+    budget = float(cfg.dp_epsilon)
+    base_rounds = steps_to_budget(float(cfg.dp_noise_mult), q,
+                                  delta, budget)
+
+    def keep(key: VariantKey) -> bool:
+        sigma = float(apply_knobs(cfg, key).dp_noise_mult)
+        return steps_to_budget(sigma, q, delta, budget) >= base_rounds
+
+    return keep
+
+
 def build_controller(cfg: Config) -> Optional[AutopilotController]:
     """Controller for a Config, or None with the autopilot off. The
     ladder's base is the launch config's own lattice point;
     ``--autopilot_pin`` starts (and holds) at the named point, adding
-    it as a one-point ladder when it is off the automatic walk."""
+    it as a one-point ladder when it is off the automatic walk.
+
+    Under ``--dp sketch`` with a hard budget (``--dp_epsilon`` > 0)
+    the ladder is pre-filtered to budget-feasible points — the
+    controller can then NEVER visit a point that would exhaust ε
+    before the launch plan would, by construction rather than by a
+    runtime guard. A pinned point that violates the budget is a
+    launch error, not a silent fallback."""
     if str(getattr(cfg, "autopilot", "off")) != "on":
         return None
     band = parse_band(cfg.autopilot_band)
-    ladder = build_ladder(cfg)
+    keep = _budget_feasible(cfg)
+    ladder = [k for k in build_ladder(cfg) if keep(k)]
+    # index 0 (the launch point) is feasible by definition — its σ IS
+    # the budget plan's σ
+    assert ladder, "budget filter removed the launch point"
     start, pinned = 0, False
     pin = str(getattr(cfg, "autopilot_pin", "") or "")
     if pin:
         pinned = True
         pin_key = parse_key(pin)
+        if not keep(pin_key):
+            raise ValueError(
+                f"--autopilot_pin {pin} violates the ε budget: its "
+                f"noise multiplier spends --dp_epsilon "
+                f"{cfg.dp_epsilon:g} faster than the launch config")
         idx = ladder_index(ladder, pin_key)
         if idx is None:
             ladder = ladder + [pin_key]

@@ -17,6 +17,7 @@ feature.
 
 from __future__ import annotations
 
+import math
 from typing import List, NamedTuple, Optional, Tuple
 
 from commefficient_tpu.config import Config
@@ -87,12 +88,24 @@ def apply_knobs(cfg: Config, key: VariantKey) -> Config:
     Returns ``cfg`` itself (same object) when the knobs already match,
     so the base variant's round build is bit-for-bit the build a
     feature-less runtime performs. The replaced copy keeps every
-    non-knob field — including the runtime-populated ``grad_size``."""
+    non-knob field — including the runtime-populated ``grad_size``.
+
+    Under ``--dp sketch`` a rows-changing move recalibrates
+    ``dp_noise_mult`` by sqrt(rows_base/rows_new): the mechanism's
+    table noise std is σ·sqrt(rows)·clip/W (privacy/mechanism.py), so
+    the rescale holds the ABSOLUTE noise at the launch calibration —
+    the variant's σ is what the accountant charges that round
+    (runtime/fed_model.py _charge_privacy)."""
     if key_of(cfg) == key:
         return cfg
-    return cfg.replace(sketch_dtype=key.dtype, k=key.k,
-                       num_rows=key.rows, num_cols=key.cols,
-                       approx_recall=key.recall_bp / RECALL_SCALE)
+    knobs = dict(sketch_dtype=key.dtype, k=key.k,
+                 num_rows=key.rows, num_cols=key.cols,
+                 approx_recall=key.recall_bp / RECALL_SCALE)
+    if (str(getattr(cfg, "dp", "off")) != "off"
+            and key.rows != int(cfg.num_rows)):
+        knobs["dp_noise_mult"] = float(cfg.dp_noise_mult) * math.sqrt(
+            int(cfg.num_rows) / key.rows)
+    return cfg.replace(**knobs)
 
 
 def parse_band(band: str) -> Tuple[float, float]:

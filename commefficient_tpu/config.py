@@ -146,11 +146,28 @@ class Config:
     personality_permutations: int = 1
     eval_before_start: bool = False
 
-    # differential privacy
+    # differential privacy (legacy reference-parity worker/server
+    # mechanism — kept bit-for-bit; see --dp below for the
+    # accountant-backed sketch mechanism)
     do_dp: bool = False
     dp_mode: str = "worker"
     l2_norm_clip: float = 1.0
     noise_multiplier: float = 0.0
+    # DP sketching (privacy/): "sketch" L2-clips each client's dense
+    # gradient to --dp_clip and adds calibrated Gaussian noise to the
+    # aggregated sketch table BEFORE wire quantization, with an RDP
+    # accountant riding the ledger. "off" traces nothing — the round
+    # program is HLO-identical to a build without the feature.
+    dp: str = "off"
+    dp_clip: float = 1.0
+    dp_noise_mult: float = 0.0
+    # accountant target δ and total ε budget (0 = unlimited). A
+    # finite budget arms the privacy_budget_exhausted alarm
+    # (--on_divergence semantics) and hard-constrains the autopilot
+    # knob ladder (no lattice point that exhausts ε before
+    # --num_rounds is ever visited).
+    dp_delta: float = 1e-5
+    dp_epsilon: float = 0.0
 
     # --- TPU-native additions (no reference equivalent) ---
     # 2D pod mesh "CxM": C devices data-parallel over ``clients`` ×
@@ -455,6 +472,23 @@ class Config:
         assert self.mode in MODES, self.mode
         assert self.error_type in ERROR_TYPES, self.error_type
         assert self.dp_mode in DP_MODES, self.dp_mode
+        assert self.dp in ("off", "sketch"), \
+            "--dp must be off|sketch"
+        assert self.dp_clip > 0, "--dp_clip must be > 0"
+        assert self.dp_noise_mult >= 0, \
+            "--dp_noise_mult must be >= 0"
+        assert 0.0 < self.dp_delta < 1.0, \
+            "--dp_delta must be in (0, 1)"
+        assert self.dp_epsilon >= 0, \
+            "--dp_epsilon must be >= 0 (0 = unlimited budget)"
+        if self.dp_epsilon > 0:
+            assert self.dp != "off", \
+                "--dp_epsilon budget needs --dp sketch (nothing " \
+                "spends the budget otherwise)"
+            assert self.dp_noise_mult > 0, \
+                "--dp_epsilon budget needs --dp_noise_mult > 0 " \
+                "(a noiseless release exhausts any finite ε " \
+                "immediately)"
         assert 0.0 < self.approx_recall <= 1.0, \
             "--approx_recall must be in (0, 1]"
         assert self.pipeline_depth >= 1, \
@@ -585,6 +619,17 @@ class Config:
             assert self.mode == "sketch", \
                 "--overlap_depth > 1 requires --mode sketch " \
                 "(only the sketch table emits in row chunks)"
+        if self.dp != "off":
+            assert self.mode == "sketch", \
+                "--dp sketch requires --mode sketch (the mechanism " \
+                "noises the aggregated sketch table)"
+            assert not self.do_dp, \
+                "--dp sketch replaces the legacy --do_dp worker/" \
+                "server mechanism; enable only one"
+            assert self.client_chunk == 0, \
+                "--dp sketch noises the round's aggregated table " \
+                "once; incompatible with --client_chunk (the " \
+                "chunked scan never materialises it pre-wire)"
         if self.mode == "sketch":
             # sketched SGD with local error/momentum is undefined: we
             # can't know which part of a sketch is "error"
@@ -817,7 +862,29 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--eval_before_start", action="store_true")
 
     # differential privacy args
-    parser.add_argument("--dp", action="store_true", dest="do_dp")
+    parser.add_argument("--dp", choices=["off", "sketch"],
+                        default="off",
+                        help="DP sketching (privacy/): clip each "
+                        "client's dense gradient to --dp_clip and "
+                        "add calibrated Gaussian noise to the "
+                        "aggregated sketch table before wire "
+                        "quantization; an RDP accountant rides the "
+                        "ledger")
+    parser.add_argument("--dp_clip", type=float, default=1.0,
+                        help="per-client L2 clip cap for --dp sketch")
+    parser.add_argument("--dp_noise_mult", type=float, default=0.0,
+                        help="noise multiplier σ for --dp sketch "
+                        "(noise std = σ × per-client table "
+                        "sensitivity)")
+    parser.add_argument("--dp_delta", type=float, default=1e-5,
+                        help="accountant δ for the ε(δ) conversion")
+    parser.add_argument("--dp_epsilon", type=float, default=0.0,
+                        help="total ε budget (0 = unlimited): arms "
+                        "the privacy_budget_exhausted alarm and "
+                        "hard-constrains the autopilot ladder")
+    # legacy reference-parity worker/server DP (was spelled --dp
+    # before the sketch mechanism took that flag)
+    parser.add_argument("--do_dp", action="store_true", dest="do_dp")
     parser.add_argument("--dp_mode", choices=DP_MODES, default="worker")
     parser.add_argument("--l2_norm_clip", type=float, default=1.0)
     parser.add_argument("--noise_multiplier", type=float, default=0.0)

@@ -121,14 +121,27 @@ def make_forward_grad(cfg: Config,
         if cfg.weight_decay != 0:
             g = g + (cfg.weight_decay / cfg.num_workers) * params_flat
 
-        # differential privacy (fed_worker.py:306-311)
+        # differential privacy (fed_worker.py:306-311); the noise
+        # draw routes through privacy/ — the one module allowed raw
+        # jax.random noise (analysis/lint.py noise-confinement)
         if cfg.do_dp:
+            from commefficient_tpu.privacy import gaussian_noise
             g = clip_by_l2(g, cfg.l2_norm_clip)
             if cfg.dp_mode == "worker":
                 assert noise_rng is not None
-                noise = cfg.noise_multiplier * jax.random.normal(
-                    noise_rng, g.shape, g.dtype)
+                noise = gaussian_noise(noise_rng, g.shape, g.dtype,
+                                       std=cfg.noise_multiplier)
                 g = g + noise * jnp.sqrt(float(cfg.num_workers))
+
+        # DP sketching (--dp sketch, privacy/): L2-clip the client's
+        # per-datapoint-mean dense gradient BEFORE sketching —
+        # sketching is linear, so the aggregated table is the sketch
+        # of the clipped mean and the calibrated table noise
+        # (core/rounds.py) covers a sqrt(r)·dp_clip/W sensitivity.
+        # Trace-time gate: "off" emits today's program bit-for-bit.
+        if getattr(cfg, "dp", "off") == "sketch":
+            from commefficient_tpu.privacy import dp_clip
+            g = dp_clip(g, cfg.dp_clip)
 
         # compression (fed_worker.py:314-322)
         if cfg.mode == "sketch":

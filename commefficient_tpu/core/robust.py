@@ -38,6 +38,20 @@ ROBUST_MODES = ("median", "trimmed", "clip")
 _TINY = 1e-12
 
 
+def clip_factors(norms, tau):
+    """Per-vector norm-clip scale: min(1, tau / max(norm, tiny)).
+
+    The ONE clip algebra shared by the ``clip`` robust fold below and
+    the DP per-client clip (privacy/mechanism.py) — the factor is
+    exactly 1.0 for any vector already inside the cap, so clipping is
+    a no-op there bit-for-bit, and the _TINY guard keeps an all-zero
+    vector at zero instead of NaN. ``norms`` and ``tau`` broadcast.
+    The NumPy mirror (tests/reference_mirror.py np_clip_factors)
+    restates this formula with the same _TINY constant.
+    """
+    return jnp.minimum(1.0, tau / jnp.maximum(norms, _TINY))
+
+
 def _masked_median(vals, alive):
     """Coordinate-wise median over the alive rows of vals (G, D).
 
@@ -143,7 +157,7 @@ def robust_fold(cfg, transmit, batch, probes=False, weights=None):
             tau = jnp.float32(cfg.robust_clip_norm)
         else:
             tau = _masked_median(norms[:, None], alive)[0]
-        scale = jnp.minimum(1.0, tau / jnp.maximum(norms, _TINY))
+        scale = clip_factors(norms, tau)
         # weight-preserving: clipped transmits keep their datapoint
         # weights, so the fold stays the plain fold when nothing clips
         agg = jnp.sum(scale[:, None] * flatT, axis=0) / total

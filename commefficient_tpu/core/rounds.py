@@ -145,6 +145,7 @@ def fused_grad_eligible(cfg: Config) -> bool:
     return (cfg.mode in ("sketch", "uncompressed", "true_topk")
             and cfg.local_momentum == 0 and cfg.error_type != "local"
             and not cfg.do_topk_down and not cfg.do_dp
+            and getattr(cfg, "dp", "off") == "off"
             and cfg.max_grad_norm is None and cfg.microbatch_size <= 0
             and getattr(cfg, "robust_agg", "none") == "none")
 
@@ -176,6 +177,14 @@ def round_plan(cfg: Config) -> dict:
     plan["sketch_dtype"] = getattr(cfg, "sketch_dtype", "f32")
     plan["downlink_encoding"] = getattr(cfg, "downlink_encoding",
                                         "dense")
+    if getattr(cfg, "dp", "off") != "off":
+        # enough to re-derive the accountant (and the perf-gate's
+        # p<eps> key fragment) from the ledger alone
+        plan["dp"] = {"mode": str(cfg.dp),
+                      "clip": float(cfg.dp_clip),
+                      "noise_mult": float(cfg.dp_noise_mult),
+                      "delta": float(cfg.dp_delta),
+                      "epsilon_budget": float(cfg.dp_epsilon)}
     plan["upload_wire_bytes_per_client"] = float(
         cfg.upload_wire_bytes_per_client)
     if cfg.mode == "sketch":
@@ -360,6 +369,20 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
     # bit-identical (pinned by test_quant_f32_program_identical).
     wire = getattr(cfg, "sketch_dtype", "f32")
     quantized = cfg.mode == "sketch" and wire != "f32"
+
+    # DP sketching (--dp sketch, privacy/): the calibrated Gaussian
+    # noise lands on the f32 AGGREGATED table — after the fold's
+    # datapoint normalisation, before any wire quantization — so the
+    # released value is exactly what the accountant charges for and
+    # the int8/fp8 qdq that follows is free post-processing. Inner
+    # per-client / collective quantization is therefore disabled
+    # under DP (tables cross at f32) and the round's one qdq runs on
+    # the noisy table below. Trace-time gate: "off" traces nothing
+    # and the program is bit-identical to a build without the flag.
+    dp_on = getattr(cfg, "dp", "off") == "sketch"
+    dp_qdq = quantized and dp_on
+    if dp_on:
+        quantized = False
 
     # Latency-hiding round pipeline (--overlap_depth, sketch mode):
     # emit and cross the table in min(depth, r) disjoint row chunks,
@@ -825,9 +848,26 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             aggregated = _sketch_after_local_sum(
                 sketch, t_fold, mesh,
                 emit=_partial_table_emit if shard2d_late else None,
-                wire=wire, depth=depth if overlap else 1) / total
+                wire="f32" if dp_on else wire,
+                depth=depth if overlap else 1) / total
         else:
             aggregated = jnp.sum(t_fold, axis=0) / total
+
+        if dp_on:
+            # the release: one seeded Gaussian draw on the aggregated
+            # table (the noise key is a distinguished fold of the
+            # round key — disjoint from every per-client stream), then
+            # the deferred wire qdq on the NOISY table. Same rng, same
+            # round ⇒ bit-identical noise, including across resume.
+            from commefficient_tpu.privacy import (add_table_noise,
+                                                   round_noise_key,
+                                                   table_noise_std)
+            aggregated = add_table_noise(aggregated,
+                                         round_noise_key(rng),
+                                         table_noise_std(cfg))
+            if dp_qdq:
+                aggregated = (_qdq_local_overlapped(aggregated)
+                              if overlap else _qdq_local(aggregated))
 
         pr = None
         if probes:

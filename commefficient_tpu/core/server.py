@@ -211,9 +211,12 @@ def _uncompressed(cfg, gradient, state, lr, sketch, noise_rng,
             "server-mode DP with noise needs a noise_rng"
         # the reference adds the noise in place on Vvelocity
         # (``grad`` aliases it, fed_aggregator.py:506-510), so the
-        # noise persists into the momentum buffer — keep that
-        Vvel = Vvel + cfg.noise_multiplier * jax.random.normal(
-            noise_rng, Vvel.shape, Vvel.dtype)
+        # noise persists into the momentum buffer — keep that; the
+        # draw routes through privacy/ (lint: noise-confinement)
+        from commefficient_tpu.privacy import gaussian_noise
+        Vvel = Vvel + gaussian_noise(noise_rng, Vvel.shape,
+                                     Vvel.dtype,
+                                     std=cfg.noise_multiplier)
     new_state = ServerState(Vvel, state.Verror)
     pr = _state_probes(_l2(Vvel * lr), new_state) if probes else None
     return ServerUpdate(Vvel * lr, new_state, None, probes=pr)

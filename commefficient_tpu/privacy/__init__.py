@@ -1,0 +1,24 @@
+"""Differentially-private sketching (--dp) and its accountant.
+
+Two halves, matching the FedSKETCH recipe (PAPERS.md — clip-then-
+noise *inside* the count-sketch costs no extra wire bytes):
+
+- ``mechanism``: the in-round DP primitives — per-client L2 clipping
+  (the shared clip algebra from core/robust.py) and calibrated
+  Gaussian noise on the *aggregated* sketch table, drawn from seeded
+  per-round PRNG keys so runs replay bit-exactly. Every noise draw in
+  the codebase routes through here (analysis/lint.py
+  ``noise-confinement`` makes raw draws elsewhere an audit failure).
+- ``accountant``: Rényi-DP composition of the subsampled Gaussian
+  mechanism with an ε(δ) conversion — client subsampling, staleness-
+  weighted folds (weights scale sensitivity), and quantization
+  post-processing (free) are all accounted; state round-trips JSON-
+  exactly through elastic checkpoints.
+"""
+
+from commefficient_tpu.privacy.accountant import (  # noqa: F401
+    PrivacyAccountant, build_accountant, eps_from_rdp,
+    rdp_subsampled_gaussian, sample_rate_of, steps_to_budget)
+from commefficient_tpu.privacy.mechanism import (  # noqa: F401
+    add_table_noise, dp_clip, gaussian_noise, noise_stream,
+    np_dp_clip, np_dp_noise, round_noise_key, table_noise_std)

@@ -1,0 +1,131 @@
+"""In-round DP primitives: per-client clip, aggregated-table noise.
+
+The ``--dp sketch`` mechanism (FedSKETCH, PAPERS.md):
+
+1. each participating client's per-datapoint-mean dense gradient is
+   L2-clipped to ``--dp_clip`` (``dp_clip`` below — the shared clip
+   algebra from core/robust.py, so the robust ``clip`` fold and the
+   DP clip cannot drift);
+2. the round's *aggregated* sketch table — after the fold and its
+   datapoint normalisation, BEFORE any wire quantization — receives
+   one Gaussian noise draw with std ``table_noise_std(cfg)``. The
+   released value is therefore exactly what the accountant charges
+   for; the int8/fp8 wire qdq that follows is post-processing (free).
+
+Sensitivity: every count-sketch row receives the full clipped vector,
+so a client's table has L2 norm ≤ sqrt(num_rows)·dp_clip; the fold is
+a datapoint-weighted mean over ``num_workers`` clients, so one
+client's contribution to the released aggregate is bounded by
+sqrt(num_rows)·dp_clip/num_workers (exact at equal batch sizes, an
+upper bound when padding/dropout shrinks a client's share). Noise std
+is ``dp_noise_mult`` times that bound, so the accountant's per-round
+noise multiplier is exactly ``cfg.dp_noise_mult``. Asyncfed staleness
+weights w ≤ 1 only shrink a client's contribution — the accountant
+credits the observed weight scale (accountant.py).
+
+Replayability: the one noise key per round is a distinguished
+``fold_in`` of the round key already threaded through
+core/rounds.py — per-client streams fold in client ids (< 2^31-1),
+so the tag below can never collide with them. Same seed, same round
+index ⇒ bit-identical noise, including across elastic resume.
+
+This module is the ONLY place raw ``jax.random`` noise draws are
+allowed (analysis/lint.py ``noise-confinement``); everything else —
+the legacy reference-parity worker/server DP in core/grad.py /
+core/server.py included — routes through ``noise_stream`` /
+``gaussian_noise``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.core.robust import _TINY, clip_factors
+
+DP_MODES_NEW = ("off", "sketch")
+
+# out-of-range for any client id (ids are int32 client indices), so
+# the round's noise stream can never collide with a per-client stream
+_NOISE_TAG = 0x7FFFFFFF
+
+
+def noise_stream(seed: int):
+    """A dedicated noise PRNG root. The one sanctioned way to mint a
+    noise key chain outside this package (lint: noise-confinement)."""
+    return jax.random.PRNGKey(seed)
+
+
+def round_noise_key(rng):
+    """The round's single table-noise key, derived from the round key
+    that core/rounds.py already threads — disjoint from every
+    per-client stream by the out-of-range fold tag."""
+    return jax.random.fold_in(rng, _NOISE_TAG)
+
+
+def gaussian_noise(rng, shape, dtype=jnp.float32, std=1.0):
+    """std · N(0, 1) of the given shape — the shared draw primitive
+    (legacy worker/server DP noise routes through here too)."""
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def dp_clip(g, cap):
+    """L2-clip one dense gradient to ``cap`` — the same
+    min(1, cap/max(norm, tiny)) factor as the robust clip fold
+    (core/robust.clip_factors), exact identity inside the cap."""
+    norm = jnp.sqrt(jnp.sum(jax.lax.square(g)))
+    return g * clip_factors(norm, jnp.float32(cap))
+
+
+def table_sensitivity(num_rows: int, clip: float,
+                      num_workers: int) -> float:
+    """One client's max L2 contribution to the aggregated table:
+    sqrt(r)·C/W (every sketch row carries the full clipped vector;
+    the fold is a W-client datapoint-weighted mean)."""
+    return math.sqrt(num_rows) * float(clip) / float(num_workers)
+
+
+def table_noise_std(cfg) -> float:
+    """The mechanism's noise std: dp_noise_mult × sensitivity. A
+    trace-time Python float — the compiled round bakes it in."""
+    return float(cfg.dp_noise_mult) * table_sensitivity(
+        cfg.num_rows, cfg.dp_clip, cfg.num_workers)
+
+
+def add_table_noise(table, noise_key, std: float):
+    """The release: aggregated table + N(0, std²). Called before any
+    wire quantization so the accountant's charged value is exactly
+    what leaves the round."""
+    return table + gaussian_noise(noise_key, table.shape, table.dtype,
+                                  std=std)
+
+
+# ---------------------------------------------------------------- #
+# NumPy mirrors (tests/reference_mirror.py discipline: restate the  #
+# algebra independently; must match the jitted path to 1e-6 — the   #
+# clip exactly, the noise to ulp level given the same key: the      #
+# threefry bits are identical, only the uniform->normal tail may    #
+# fuse differently inside the round jit).                           #
+# ---------------------------------------------------------------- #
+
+def np_dp_clip(g: np.ndarray, cap: float) -> np.ndarray:
+    """Mirror of ``dp_clip``: same formula, same _TINY guard, norm
+    taken in f32 like the jitted path."""
+    norm = np.float32(np.sqrt(np.sum(np.square(
+        g.astype(np.float32)))))
+    scale = np.float32(min(1.0, float(cap) / max(float(norm), _TINY)))
+    return g.astype(np.float32) * scale
+
+
+def np_dp_noise(noise_key, shape, std: float) -> np.ndarray:
+    """Mirror of the table noise draw. The std calibration is
+    restated host-side by the caller (np mirror of table_noise_std);
+    the N(0,1) stream itself is *defined* as JAX's threefry draw for
+    the given key — the mirror pins the scaling and placement, and
+    the draw is evaluated outside jit so any jit-only transform of
+    the noise would be caught."""
+    return np.asarray(
+        std * jax.random.normal(noise_key, shape, jnp.float32))

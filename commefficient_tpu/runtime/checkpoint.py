@@ -341,6 +341,13 @@ def save_checkpoint(path: str, model, opt, scheduler=None,
         arrays["async_issue"] = st["issue"]
         for k, v in st["slots"].items():
             arrays["async:slot:" + k] = v
+    acc = getattr(model, "_accountant", None)
+    if acc is not None:
+        # --dp sketch: the accountant's per-order RDP totals ride as
+        # JSON floats (bit-exact round-trip), so a resumed run's ε
+        # trajectory continues the unbroken run's exactly — the spent
+        # budget survives preemption like every other piece of state
+        meta["privacy"] = acc.state_dict()
     if scheduler is not None:
         meta["scheduler_step"] = int(scheduler._step)
     if sampler is not None and hasattr(sampler.rng, "get_state"):
@@ -625,7 +632,6 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
                 # rather than refusing the whole restore — weights and
                 # optimizer state are still bit-exact, only the running
                 # statistics restart their blend
-                import warnings
                 warnings.warn(
                     "checkpoint has no BN running stats "
                     "(pre-batchnorm format); resuming with freshly "
@@ -717,6 +723,27 @@ def load_checkpoint(path: str, model, opt, scheduler=None,
                 "arrival(s) but this run is synchronous — resume with "
                 "--async_buffer_size or the buffered rounds in flight "
                 f"are dropped ({path})")
+
+        # DP accountant: restore the spent-budget state bit-exactly.
+        # Presence mismatches are hard decisions — a DP resume from a
+        # DP-less checkpoint would silently RESET the spent ε to zero
+        # (a privacy violation, not an inconvenience), so it refuses;
+        # the reverse direction only drops observability and warns.
+        ck_priv = meta.get("privacy")
+        acc = getattr(model, "_accountant", None)
+        if acc is not None and ck_priv is not None:
+            from commefficient_tpu.privacy import PrivacyAccountant
+            model._accountant = PrivacyAccountant.load_state(ck_priv)
+        elif acc is not None:
+            raise ValueError(
+                "checkpoint has no privacy accountant state but this "
+                "run is --dp sketch; resuming would reset the spent "
+                f"ε budget to zero ({path})")
+        elif ck_priv is not None:
+            warnings.warn(
+                "checkpoint carries a privacy accountant (written by "
+                "a --dp sketch run) but this run has DP off; the "
+                "spent-budget state is dropped")
 
         # lineage, for manifests (resume_manifest_extra) and the next
         # save's meta["segments"] chain

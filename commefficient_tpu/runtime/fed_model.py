@@ -53,6 +53,7 @@ from commefficient_tpu.core.rounds import (ClientStates,
                                            build_server_round,
                                            build_val_fn, round_plan)
 from commefficient_tpu.core.server import ServerState
+from commefficient_tpu.privacy import build_accountant, noise_stream
 from commefficient_tpu.telemetry import build_telemetry, clock, trace
 from commefficient_tpu.telemetry.core import compile_delta, compile_mark
 from commefficient_tpu.ops.vec import flatten_params
@@ -318,7 +319,9 @@ class FedModel:
         # made round 0 take full-gradient local steps (diverges
         # instantly at ResNet9 scale).
         self.fedavg_lr = 0.0
-        self._rng = jax.random.PRNGKey(args.seed)
+        # round-key stream genesis (data order / client sampling), not
+        # a noise source — noise streams live in privacy/mechanism.py
+        self._rng = jax.random.PRNGKey(args.seed)  # audit: allow(noise-confinement)
 
         # communication accounting
         self.last_updated = np.full(args.grad_size, -1, np.int64)
@@ -366,6 +369,13 @@ class FedModel:
             # profiler's bucket merge calls straight into the engine
             self.telemetry.on_device_time = \
                 self.alarm_engine.check_device_time
+        # --dp sketch: the run's RDP accountant (privacy/). Charged
+        # once per DISPATCHED round — the round program releases the
+        # noised table whether or not its metrics ever materialise —
+        # so pipelined rounds spend budget in dispatch order too. Its
+        # cumulative ε lands on the schema-v5 ledger keys and feeds
+        # the privacy_budget_exhausted alarm. None with --dp off.
+        self._accountant = build_accountant(args)
         # roofline cost model (analysis/cost.py), computed lazily at
         # the first --profile'd round from the lowered round program
         self._cost_model = None
@@ -697,6 +707,9 @@ class FedModel:
                 self._submit_prefetch()
         else:
             self.pending_client_ids = _state_ids(ids, dev_batch)
+        if self._accountant is not None:
+            self._charge_privacy(ridx, var.cfg, staleness,
+                                 np.asarray(batch["mask"]))
         self.round_index += 1
         if res.bn_stats is not None:
             # running-stats blend (torch BN momentum 0.1); a fully
@@ -804,6 +817,44 @@ class FedModel:
             else:
                 self._apply_note(op[1])
         return results
+
+    def _charge_privacy(self, ridx: int, cfg, staleness, mask):
+        """Charge round ``ridx``'s DP release to the accountant and
+        stamp the schema-v5 ledger keys. σ is the DISPATCHED variant's
+        ``dp_noise_mult`` (autopilot geometry moves recalibrate it so
+        the absolute table noise holds — autopilot/lattice.py); the
+        weight scale is the round's max staleness fold weight over
+        alive slots: every client contribution is scaled by at most w,
+        so the round's sensitivity shrinks to w·Δ and the effective
+        noise multiplier grows to σ/w. A fully-dead round (every slot
+        dropped or padding) charges w = 1 — conservative: its release
+        reveals nothing, but the accountant never under-counts. With a
+        hard budget (``--dp_epsilon`` > 0) the post-charge ε routes
+        through the alarm engine, so ``--on_divergence abort`` stops
+        the run AT the exhausting round."""
+        acc = self._accountant
+        sigma = float(cfg.dp_noise_mult)
+        w = 1.0
+        alpha = float(getattr(cfg, "async_staleness_weight", 0.0)
+                      or 0.0)
+        if staleness is not None and alpha != 0.0:
+            alive = mask.reshape(len(staleness), -1).sum(axis=1) > 0
+            if alive.any():
+                s_min = float(np.asarray(staleness)[alive].min())
+                w = min(float((1.0 + s_min) ** (-alpha)), 1.0)
+        acc.step(weight_scale=w, sigma=sigma)
+        eps = acc.epsilon()
+        sigma_eff = sigma / w if sigma > 0 else 0.0
+        self.telemetry.set_round_privacy(ridx, eps, acc.delta,
+                                         sigma_eff)
+        budget = float(getattr(cfg, "dp_epsilon", 0.0) or 0.0)
+        if self.alarm_engine is not None and budget > 0:
+            self.alarm_engine.check(ridx, {
+                "dp_epsilon": eps,
+                "dp_delta": acc.delta,
+                "dp_sigma": sigma_eff,
+                "dp_rounds_left": acc.rounds_left(budget,
+                                                  sigma=sigma)})
 
     def _finish_probes(self, ridx: int, vals: dict):
         """Complete round ``ridx``'s probe dict host-side: fold in any
@@ -1134,7 +1185,10 @@ class FedOptimizer:
             build_server_round(self.args, probes=self._probes,
                                mesh=mesh if sharded else None),
             donate_argnums=(0, 1))
-        self._noise_rng = jax.random.PRNGKey(self.args.seed + 1)
+        # legacy --do_dp server-mode noise stream: the seed+1 root key
+        # comes from privacy/ (the one module allowed raw jax.random
+        # noise — analysis/lint.py noise-confinement)
+        self._noise_rng = noise_stream(self.args.seed + 1)
         self._step_count = 0
 
     def get_lr(self):

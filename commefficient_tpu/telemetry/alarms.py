@@ -50,6 +50,20 @@ round instead of silently training on garbage. Three rules:
                        outrunning the fold cadence (the buffer drains
                        older and older mass) — the serving analogue
                        of the residual-growth rule.
+``privacy_budget_exhausted`` — DP runs (``--dp sketch``) with a hard
+                       budget (``--dp_epsilon`` > 0): the accountant's
+                       cumulative ε(δ) reached the budget. The runtime
+                       routes the post-round ε through ``check`` as
+                       the ``dp_epsilon`` probe (stamped on the v5
+                       record either way), so under ``--on_divergence
+                       abort`` the run stops AT the first round whose
+                       release exhausted the budget — the noised
+                       table was already released, so the abort is
+                       "spend no further", not "unrelease". The alarm
+                       dict carries ``rounds_left`` (the accountant's
+                       pre-charge projection, 0 when already over) so
+                       the ledger names the predicted exhaustion
+                       round.
 ``collective_skew``  — trace-derived (schema-v4 ``device_time``): a
                        profiled round's straggler wait dominates its
                        collective bucket — max cross-device
@@ -125,6 +139,9 @@ class AlarmEngine:
             getattr(cfg, "alarm_fold_rejection", 0.0) or 0.0)
         self.async_staleness = float(
             getattr(cfg, "alarm_async_staleness", 0.0) or 0.0)
+        self.privacy_budget = (
+            float(getattr(cfg, "dp_epsilon", 0.0) or 0.0)
+            if str(getattr(cfg, "dp", "off")) != "off" else 0.0)
         self.telemetry = telemetry
         self._consecutive = 0
         self._step_times = deque(maxlen=self.step_time_window)
@@ -198,6 +215,18 @@ class AlarmEngine:
                     "buffer_occupancy": probes.get(
                         "async_buffer_occupancy"),
                     "backlog": probes.get("async_backlog")})
+
+        if self.privacy_budget > 0:
+            eps = probes.get("dp_epsilon")
+            if eps is not None and (not _finite(eps)
+                                    or eps >= self.privacy_budget):
+                fired.append({
+                    "rule": "privacy_budget_exhausted",
+                    "value": float(eps),
+                    "threshold": self.privacy_budget,
+                    "dp_delta": probes.get("dp_delta"),
+                    "dp_sigma": probes.get("dp_sigma"),
+                    "rounds_left": probes.get("dp_rounds_left")})
 
         return self._escalate(round_index, fired)
 
@@ -282,6 +311,9 @@ def build_alarm_engine(cfg, telemetry=None):
             or float(getattr(cfg, "alarm_fold_rejection", 0.0)
                      or 0.0) > 0
             or float(getattr(cfg, "alarm_async_staleness", 0.0)
-                     or 0.0) > 0):
+                     or 0.0) > 0
+            or (str(getattr(cfg, "dp", "off")) != "off"
+                and float(getattr(cfg, "dp_epsilon", 0.0) or 0.0)
+                > 0)):
         return AlarmEngine(cfg, telemetry)
     return None

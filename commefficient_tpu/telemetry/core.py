@@ -236,6 +236,19 @@ class Telemetry:
         rec["uplink_bytes"] = float(uplink)
         self._drain()
 
+    def set_round_privacy(self, index: int, epsilon, delta, sigma):
+        """Stamp the round's DP ledger trail (schema v5): cumulative
+        ε(δ) after the round was charged, the δ it is stated at, and
+        the effective noise multiplier charged. Arrives right after
+        the accountant steps (runtime/fed_model.py) — always before
+        emission, which waits on ``set_round_bytes``."""
+        rec = self._records.get(index)
+        if rec is None:
+            return
+        rec["dp_epsilon"] = float(epsilon)
+        rec["dp_delta"] = float(delta)
+        rec["dp_sigma"] = float(sigma)
+
     def merge_round_probes(self, index: int, probes: dict):
         """Merge algorithm-probe values onto round ``index``'s record
         (schema v2). Client-pass probes land inside ``metrics_host``;

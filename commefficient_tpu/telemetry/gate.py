@@ -219,9 +219,30 @@ def band_suffix(band) -> str:
     return f"b{s}"
 
 
+def privacy_suffix(dp_epsilon) -> str:
+    """Canonical key fragment for a differentially-private run:
+    ``p<eps>`` (``p3.5``; ``p0`` is DP with an unlimited budget) when
+    ``--dp sketch`` clipped the clients and noised the aggregated
+    table, ``""`` for the noiseless runs every pre-privacy pin
+    measured. The calibrated Gaussian changes both what the ledger's
+    recovery probes see and the round's wall profile (per-client
+    clip, the noise draw, the forced-f32 wire), so a DP round is a
+    different experiment from the same config without it — and two
+    different budgets drive different autopilot walks. Like the
+    wire/async/overlap/band fragments there is NO fallback in either
+    direction: a DP ledger must never resolve (or overwrite) a
+    noiseless pin, nor another budget's. ``dp_epsilon`` must be None
+    for non-DP runs — 0.0 is a real value (unlimited budget), not an
+    absence."""
+    if dp_epsilon is None:
+        return ""
+    return f"p{float(dp_epsilon):g}"
+
+
 def topology_key(device_count=None, process_count=None,
                  mesh_shape=None, wire_dtype=None,
-                 async_k=None, overlap_depth=None, band=None) -> str:
+                 async_k=None, overlap_depth=None, band=None,
+                 dp_epsilon=None) -> str:
     """Baseline entry key for one topology point. ``d<D>p<P>`` when
     both counts are known — suffixed ``m<C>x<M>`` for 2D-mesh runs
     (a 4x2 and an 8x1 run on the same 8 chips are different programs,
@@ -230,28 +251,32 @@ def topology_key(device_count=None, process_count=None,
     for buffered-arrival runs (an async fold overlaps work a barrier
     round waits for), ``o<N>`` for chunked-emission runs (a
     pipelined collective profile is a different experiment from the
-    serial one) and ``b<lo-hi>`` for autopilot-controlled runs (the
-    knob walk mixes lattice points no static program mixes) —
+    serial one), ``b<lo-hi>`` for autopilot-controlled runs (the
+    knob walk mixes lattice points no static program mixes) and
+    ``p<eps>`` for differentially-private runs (the clip + table
+    noise is a different experiment from the noiseless program) —
     :data:`ANY_TOPOLOGY` otherwise: unknown
     topologies form their own bucket rather than silently matching a
-    counted one. Quantized/async/overlapped/banded runs with unknown
-    counts still split off (``any-q<dtype>``, ``any-a<K>``,
-    ``any-o<N>``, ``any-b<lo-hi>``)."""
+    counted one. Quantized/async/overlapped/banded/private runs with
+    unknown counts still split off (``any-q<dtype>``, ``any-a<K>``,
+    ``any-o<N>``, ``any-b<lo-hi>``, ``any-p<eps>``)."""
     if device_count is None or process_count is None:
         w = (wire_suffix(wire_dtype) + async_suffix(async_k)
-             + overlap_suffix(overlap_depth) + band_suffix(band))
+             + overlap_suffix(overlap_depth) + band_suffix(band)
+             + privacy_suffix(dp_epsilon))
         return f"{ANY_TOPOLOGY}-{w}" if w else ANY_TOPOLOGY
     return (f"d{int(device_count)}p{int(process_count)}"
             f"{mesh_suffix(mesh_shape)}{wire_suffix(wire_dtype)}"
             f"{async_suffix(async_k)}{overlap_suffix(overlap_depth)}"
-            f"{band_suffix(band)}")
+            f"{band_suffix(band)}{privacy_suffix(dp_epsilon)}")
 
 
 def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                         device_count=None, process_count=None,
                         config_hash: str = "", mesh_shape=None,
                         wire_dtype=None, async_k=None,
-                        overlap_depth=None, band=None) -> Dict:
+                        overlap_depth=None, band=None,
+                        dp_epsilon=None) -> Dict:
     entry = {"ts": clock.wall(), "source": source, "metrics": metrics}
     if device_count is not None:
         entry["device_count"] = int(device_count)
@@ -272,6 +297,8 @@ def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
     if band_suffix(band):
         entry["autopilot_band"] = (str(band) if isinstance(band, str)
                                    else list(band))
+    if privacy_suffix(dp_epsilon):
+        entry["dp_epsilon"] = float(dp_epsilon)
     return entry
 
 
@@ -280,17 +307,18 @@ def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
                   process_count=None, config_hash: str = "",
                   mesh_shape=None, wire_dtype=None,
                   async_k=None, overlap_depth=None,
-                  band=None) -> Dict:
+                  band=None, dp_epsilon=None) -> Dict:
     """A fresh schema-2 baseline holding one topology entry."""
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k, overlap_depth, band)
+                       wire_dtype, async_k, overlap_depth, band,
+                       dp_epsilon)
     base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
             "topologies": {key: make_topology_entry(
                 metrics, source=source, device_count=device_count,
                 process_count=process_count, config_hash=config_hash,
                 mesh_shape=mesh_shape, wire_dtype=wire_dtype,
                 async_k=async_k, overlap_depth=overlap_depth,
-                band=band)}}
+                band=band, dp_epsilon=dp_epsilon)}}
     if extra:
         base.update(extra)
     return base
@@ -315,7 +343,7 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
                     process_count=None, config_hash: str = "",
                     mesh_shape=None, wire_dtype=None,
                     async_k=None, overlap_depth=None,
-                    band=None) -> Dict:
+                    band=None, dp_epsilon=None) -> Dict:
     """Insert/replace ONE topology's entry, leaving every other
     topology point untouched — how the gate CLI re-captures the
     8-device headline without disturbing the single-chip one.
@@ -325,12 +353,14 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
          "topologies": {}}
     base["topologies"] = dict(base.get("topologies", {}))
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k, overlap_depth, band)
+                       wire_dtype, async_k, overlap_depth, band,
+                       dp_epsilon)
     base["topologies"][key] = make_topology_entry(
         metrics, source=source, device_count=device_count,
         process_count=process_count, config_hash=config_hash,
         mesh_shape=mesh_shape, wire_dtype=wire_dtype,
-        async_k=async_k, overlap_depth=overlap_depth, band=band)
+        async_k=async_k, overlap_depth=overlap_depth, band=band,
+        dp_epsilon=dp_epsilon)
     base["ts"] = clock.wall()
     return base
 
@@ -338,7 +368,7 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
 def baseline_entry(baseline: Dict, device_count=None,
                    process_count=None, mesh_shape=None,
                    wire_dtype=None, async_k=None,
-                   overlap_depth=None, band=None):
+                   overlap_depth=None, band=None, dp_epsilon=None):
     """The topology entry ``compare`` gates against, or None when the
     baseline has no entry for this topology. A 2D-mesh run resolves
     its exact ``d<D>p<P>m<C>x<M>`` entry first and falls back to the
@@ -364,18 +394,21 @@ def baseline_entry(baseline: Dict, device_count=None,
     topologies = baseline.get("topologies", {})
     entry = topologies.get(
         topology_key(device_count, process_count, mesh_shape,
-                     wire_dtype, async_k, overlap_depth, band))
+                     wire_dtype, async_k, overlap_depth, band,
+                     dp_epsilon))
     if entry is None and mesh_suffix(mesh_shape):
-        # drop only the mesh fragment; the wire, async, overlap AND
-        # band fragments stay — there is no cross-dtype, cross-mode,
-        # cross-depth or cross-band fallback (an o2 pipelined round
-        # has a different collective schedule than the serial o1
-        # program; a b0.2-0.6 autopilot walk mixes programs no static
-        # pin measured)
+        # drop only the mesh fragment; the wire, async, overlap, band
+        # AND privacy fragments stay — there is no cross-dtype,
+        # cross-mode, cross-depth, cross-band or cross-budget fallback
+        # (an o2 pipelined round has a different collective schedule
+        # than the serial o1 program; a b0.2-0.6 autopilot walk mixes
+        # programs no static pin measured; a p3.5 run's probes carry
+        # calibrated noise no noiseless pin ever saw)
         entry = topologies.get(
             topology_key(device_count, process_count,
                          wire_dtype=wire_dtype, async_k=async_k,
-                         overlap_depth=overlap_depth, band=band))
+                         overlap_depth=overlap_depth, band=band,
+                         dp_epsilon=dp_epsilon))
     return entry
 
 
@@ -389,7 +422,7 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
             mad_k: float = MAD_K, device_count=None,
             process_count=None, mesh_shape=None,
             wire_dtype=None, async_k=None,
-            overlap_depth=None, band=None) -> Dict:
+            overlap_depth=None, band=None, dp_epsilon=None) -> Dict:
     """Gate ``metrics`` against ``baseline``'s entry for this
     topology. Returns::
 
@@ -403,10 +436,11 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
     when the baseline has no entry for this topology — an ungated
     topology point must fail loudly, not pass silently."""
     key = topology_key(device_count, process_count, mesh_shape,
-                       wire_dtype, async_k, overlap_depth, band)
+                       wire_dtype, async_k, overlap_depth, band,
+                       dp_epsilon)
     entry = baseline_entry(baseline, device_count, process_count,
                            mesh_shape, wire_dtype, async_k,
-                           overlap_depth, band)
+                           overlap_depth, band, dp_epsilon)
     if entry is None:
         have = ", ".join(sorted(baseline.get("topologies", {}))) \
             or "none"

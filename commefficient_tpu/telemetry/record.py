@@ -71,17 +71,33 @@ changes:
              (``<ledger>.p<k>.jsonl``, telemetry/core.py) so merged
              multi-host ledgers (scripts/ledger_merge.py) stay
              attributable.
+
+Schema v5 adds three keys to round records (the DP ledger trail,
+privacy/):
+
+``dp_epsilon`` — None outside ``--dp sketch`` runs, else the
+             accountant's cumulative ε(δ) AFTER this round was
+             charged — the record stream is the spend trajectory, and
+             the ``privacy_budget_exhausted`` alarm reads the same
+             value.
+``dp_delta``   — the δ the ε above is stated at (``--dp_delta``);
+             None outside DP runs.
+``dp_sigma``   — the effective noise multiplier this round was
+             charged at (the dispatched variant's ``dp_noise_mult``
+             over the round's staleness weight scale); None outside
+             DP runs.
 """
 
 from __future__ import annotations
 
 from commefficient_tpu.telemetry import clock
 
-LEDGER_SCHEMA_VERSION = 4
+LEDGER_SCHEMA_VERSION = 5
 
-# versions validate_record accepts: v1 (pre-probe), v2 (pre-trace) and
-# v3 (pre-fleet) ledgers stay readable by the report tooling
-READABLE_SCHEMA_VERSIONS = (1, 2, 3, 4)
+# versions validate_record accepts: v1 (pre-probe), v2 (pre-trace),
+# v3 (pre-fleet) and v4 (pre-DP) ledgers stay readable by the report
+# tooling
+READABLE_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
 
 # device_time keys whose values are nested dicts (v4); every other
 # bucket value must be numeric
@@ -106,6 +122,13 @@ ROUND_V2_KEYS = (
 # v3 additions (not required of v1/v2 records)
 ROUND_V3_KEYS = (
     "device_time",                         # None outside --profile
+)
+
+# v5 additions (not required of v1-v4 records)
+ROUND_V5_KEYS = (
+    "dp_epsilon",                          # None outside --dp runs
+    "dp_delta",                            # None outside --dp runs
+    "dp_sigma",                            # None outside --dp runs
 )
 
 
@@ -133,6 +156,9 @@ def make_round_record(round_index: int) -> dict:
         "probes": None,
         "alarms": [],
         "device_time": None,
+        "dp_epsilon": None,
+        "dp_delta": None,
+        "dp_sigma": None,
     })
     return rec
 
@@ -178,6 +204,8 @@ def validate_record(rec) -> list:
             required = required + ROUND_V2_KEYS
         if isinstance(schema, int) and schema >= 3:
             required = required + ROUND_V3_KEYS
+        if isinstance(schema, int) and schema >= 5:
+            required = required + ROUND_V5_KEYS
         for key in required:
             if key not in rec:
                 problems.append(f"round record missing {key!r}")
@@ -188,7 +216,7 @@ def validate_record(rec) -> list:
             problems.append("non-numeric span value")
         if not isinstance(rec.get("counters"), dict):
             problems.append("counters is not a dict")
-        for key in ("uplink_bytes", "downlink_bytes"):
+        for key in ("uplink_bytes", "downlink_bytes") + ROUND_V5_KEYS:
             v = rec.get(key)
             if v is not None and not isinstance(v, (int, float)):
                 problems.append(f"{key} is non-numeric")

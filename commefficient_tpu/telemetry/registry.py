@@ -163,6 +163,19 @@ def run_band(manifest: dict):
     return cfg.get("autopilot_band") or None
 
 
+def run_dp_epsilon(manifest: dict):
+    """The run's privacy budget (``--dp_epsilon``) from its recorded
+    config when the run was differentially private (``--dp`` != off),
+    or None for noiseless / pre-privacy manifests — the budget half
+    of the ``p<eps>`` topology fragment (telemetry/gate.py
+    privacy_suffix). 0.0 is a REAL return (DP on, unlimited budget):
+    such a run keys ``p0``, never the bare noiseless key."""
+    cfg = manifest.get("config") or {}
+    if str(cfg.get("dp") or "off") == "off":
+        return None
+    return float(cfg.get("dp_epsilon") or 0.0)
+
+
 def run_segments(manifest: dict) -> list:
     """The run's per-topology segments (``topology_segments``, stamped
     by the trainers from checkpoint lineage for resumed runs). Empty
@@ -197,24 +210,27 @@ def run_key(manifest: dict) -> tuple:
     ``m<C>x<M>`` fragment, quantized-wire runs their ``q<dtype>``
     fragment, buffered-arrival runs their ``a<K>`` fragment and
     chunk-pipelined runs their ``o<N>`` fragment and
-    autopilot-controlled runs their ``b<lo-hi>`` fragment (a 4x2 and
+    autopilot-controlled runs their ``b<lo-hi>`` fragment and
+    differentially-private runs their ``p<eps>`` fragment (a 4x2 and
     an 8x1 program on the same chips — or an int8 and an f32 wire, or
     a buffered and a barrier round, or a depth-2 pipelined and a
-    serial round, or a knob walk and a static program — are different
-    experiments); 1-D f32 synchronous serial static runs keep the
-    historical 3-tuple, so old manifests stay comparable to each
-    other."""
+    serial round, or a knob walk and a static program, or a noised
+    table and a noiseless one — are different experiments); 1-D f32
+    synchronous serial static noiseless runs keep the historical
+    3-tuple, so old manifests stay comparable to each other."""
     from commefficient_tpu.telemetry.gate import (async_suffix,
                                                   band_suffix,
                                                   mesh_suffix,
                                                   overlap_suffix,
+                                                  privacy_suffix,
                                                   wire_suffix)
     key = (manifest.get("config_hash") or "",) + run_topology(manifest)
     suffix = (mesh_suffix(run_mesh_shape(manifest))
               + wire_suffix(run_wire_dtype(manifest))
               + async_suffix(run_async_k(manifest))
               + overlap_suffix(run_overlap_depth(manifest))
-              + band_suffix(run_band(manifest)))
+              + band_suffix(run_band(manifest))
+              + privacy_suffix(run_dp_epsilon(manifest)))
     return key + (suffix,) if suffix else key
 
 

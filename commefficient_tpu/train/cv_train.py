@@ -419,6 +419,7 @@ def build_model(args: Config, rng=None):
     if args.do_test and hasattr(model_cls, "test_config"):
         kw.update(model_cls.test_config(num_classes))
     module = model_cls(**kw)
+    # model-init stream, not noise  # audit: allow(noise-confinement)
     rng = rng if rng is not None else jax.random.PRNGKey(args.seed)
     # EMNIST is 28x28 grayscale, ImageNet 224x224 (reference dataset
     # table at utils.py:37-41 + transforms.py)

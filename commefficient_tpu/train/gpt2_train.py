@@ -319,6 +319,7 @@ def build_model_and_tokenizer(args: Config):
         cfg = dataclasses.replace(cfg, attn_impl=args.attn_impl)
     module = GPT2DoubleHeads(cfg)
     dummy = jnp.zeros((1, args.num_candidates, 8), jnp.int32)
+    # model-init stream, not noise  # audit: allow(noise-confinement)
     params = module.init(jax.random.PRNGKey(args.seed), dummy,
                          jnp.zeros((1, args.num_candidates),
                                    jnp.int32), dummy)["params"]

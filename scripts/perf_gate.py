@@ -67,7 +67,7 @@ def load_ledger_records(path):
 def resolve_topology(manifest=None, records=(), device_count=None,
                      process_count=None, mesh_shape=None,
                      wire_dtype=None, async_k=None,
-                     overlap_depth=None, band=None):
+                     overlap_depth=None, band=None, dp_epsilon=None):
     """The run's (device_count, process_count, mesh_shape,
     wire_dtype, async_k, overlap_depth) for baseline keying: CLI
     overrides win, then the run manifest, then the ledger's meta
@@ -91,13 +91,20 @@ def resolve_topology(manifest=None, records=(), device_count=None,
     falls back across bands: an autopilot run gates only against a
     baseline entry pinned under the SAME band — its wall profile
     mixes every knob point the controller visited, which no static
-    pin describes."""
+    pin describes. ``dp_epsilon`` likewise: a CLI float, the manifest
+    config's ``dp_epsilon`` when ``dp`` != off, the meta record's
+    ``plan.dp.epsilon_budget``; noiseless runs resolve to None (no
+    ``p<eps>`` fragment) and a DP run with an unlimited budget keys
+    ``p0``. A budget never falls back across budgets or to the
+    noiseless pin: the calibrated table noise changes what the
+    recovery probes measure."""
     dc, pc = device_count, process_count
     ms = parse_mesh_shape(mesh_shape)
     wd = wire_dtype
     ak = async_k
     od = overlap_depth
     bd = band
+    de = dp_epsilon
     if manifest is not None:
         mdc, mpc = registry.run_topology(manifest)
         dc = mdc if dc is None else dc
@@ -112,8 +119,11 @@ def resolve_topology(manifest=None, records=(), device_count=None,
             od = registry.run_overlap_depth(manifest)
         if bd is None:
             bd = registry.run_band(manifest)
+        if de is None:
+            de = registry.run_dp_epsilon(manifest)
     if dc is None or pc is None or ms is None or wd is None \
-            or ak is None or od is None or bd is None:
+            or ak is None or od is None or bd is None \
+            or de is None:
         for rec in records:
             if rec.get("kind") != "meta":
                 continue
@@ -138,10 +148,16 @@ def resolve_topology(manifest=None, records=(), device_count=None,
                 od = int(plan["overlap_depth"])
             if bd is None and isinstance(plan.get("autopilot"), dict):
                 bd = plan["autopilot"].get("band") or None
+            if de is None and isinstance(plan.get("dp"), dict):
+                # 0.0 is a real budget (unlimited) — "or None" would
+                # erase the p0 fragment and let a DP ledger resolve
+                # the noiseless pin
+                eps = plan["dp"].get("epsilon_budget")
+                de = float(eps) if eps is not None else 0.0
             if (dc is not None and pc is not None
                     and ms is not None and wd is not None
                     and ak is not None and od is not None
-                    and bd is not None):
+                    and bd is not None and de is not None):
                 break
     if wd == "f32":
         wd = None  # historical unsuffixed key
@@ -151,7 +167,7 @@ def resolve_topology(manifest=None, records=(), device_count=None,
         od = None  # serial rounds keep the historical key
     if not bd:
         bd = None  # static-knob runs keep the unbanded key
-    return dc, pc, ms, wd, ak, od, bd
+    return dc, pc, ms, wd, ak, od, bd, de
 
 
 def parse_mesh_shape(mesh_shape):
@@ -230,6 +246,14 @@ def main(argv=None):
                          "meta plan; static-knob runs keep the "
                          "unbanded key). Banded entries NEVER gate "
                          "against another band or an unbanded pin.")
+    ap.add_argument("--dp_epsilon", type=float, default=None,
+                    help="override the run's --dp_epsilon privacy "
+                         "budget for baseline keying (normally read "
+                         "from the manifest config / ledger meta "
+                         "plan; noiseless runs keep the unsuffixed "
+                         "key, a DP run with no budget cap keys p0). "
+                         "Private entries NEVER gate against another "
+                         "budget or a noiseless pin.")
     args = ap.parse_args(argv)
 
     ledger = args.ledger
@@ -245,7 +269,7 @@ def main(argv=None):
         print(f"run: {mpath} (config {manifest.get('config_hash', '')[:8]}, "
               f"git {manifest.get('git_sha', '')[:8]}, "
               f"topology "
-              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest), registry.run_overlap_depth(manifest), registry.run_band(manifest))}"
+              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest), registry.run_overlap_depth(manifest), registry.run_band(manifest), registry.run_dp_epsilon(manifest))}"
               f") -> {ledger}")
     if ledger is None:
         ap.error("one of --ledger / --runs_dir is required")
@@ -255,11 +279,11 @@ def main(argv=None):
     if not metrics:
         print(f"{ledger}: no gateable metrics (empty ledger?)")
         return 1
-    dc, pc, ms, wd, ak, od, bd = resolve_topology(
+    dc, pc, ms, wd, ak, od, bd, de = resolve_topology(
         manifest, records, args.device_count, args.process_count,
         args.mesh_shape, args.wire_dtype, args.async_k,
-        args.overlap_depth, args.band)
-    topo = gate.topology_key(dc, pc, ms, wd, ak, od, bd)
+        args.overlap_depth, args.band, args.dp_epsilon)
+    topo = gate.topology_key(dc, pc, ms, wd, ak, od, bd, de)
     print(f"{ledger}: {len(metrics)} metric(s) extracted "
           f"(topology {topo})")
     chash = (manifest or {}).get("config_hash", "")
@@ -273,7 +297,8 @@ def main(argv=None):
         chain = " -> ".join(
             gate.topology_key(s.get("device_count"),
                               s.get("process_count"),
-                              s.get("mesh_shape"), wd, ak, od, bd)
+                              s.get("mesh_shape"), wd, ak, od, bd,
+                              de)
             for s in segs)
         print(f"perf gate: REFUSED — run resumed across a mid-run "
               f"topology change ({len(segs)} segments: {chain}); its "
@@ -299,7 +324,7 @@ def main(argv=None):
             return 1
         existing = gate.load_baseline(gate_path)
         entry = gate.baseline_entry(existing, dc, pc, ms, wd, ak, od,
-                                    bd)
+                                    bd, de)
         if entry is None and args.write_baseline and not args.check:
             # first capture of a NEW topology point: nothing to gate
             # this run against, other points stay untouched
@@ -323,7 +348,7 @@ def main(argv=None):
                                    device_count=dc, process_count=pc,
                                    mesh_shape=ms, wire_dtype=wd,
                                    async_k=ak, overlap_depth=od,
-                                   band=bd)
+                                   band=bd, dp_epsilon=de)
             print(gate.render_verdict(verdict))
 
     if args.write_baseline:
@@ -341,7 +366,8 @@ def main(argv=None):
                                  device_count=dc, process_count=pc,
                                  config_hash=chash, mesh_shape=ms,
                                  wire_dtype=wd, async_k=ak,
-                                 overlap_depth=od, band=bd),
+                                 overlap_depth=od, band=bd,
+                                 dp_epsilon=de),
             args.write_baseline)
         print(f"baseline[{topo}] -> {args.write_baseline}")
 

@@ -115,6 +115,19 @@ def main():
                     help="LO:HI recovery-error band for the "
                     "--autopilot leg (also keys its baseline pin)")
     ap.add_argument("--autopilot_rounds", type=int, default=8)
+    ap.add_argument("--dp", action="store_true",
+                    help="also run the DP acceptance leg: a federated "
+                    "sketch loop with the full --dp sketch mechanism "
+                    "armed (per-client clip + table noise at "
+                    "sigma > 0) whose recovery error must hold the "
+                    "--dp_band every probed round while the "
+                    "accountant's eps grows monotonically")
+    ap.add_argument("--dp_noise_mult", type=float, default=0.02,
+                    help="noise multiplier for the --dp leg "
+                    "(sigma > 0 is the point of the check)")
+    ap.add_argument("--dp_band", default="0:0.9",
+                    help="LO:HI recovery-error band for the --dp leg")
+    ap.add_argument("--dp_rounds", type=int, default=8)
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -227,10 +240,13 @@ def main():
         res["chain_sketch_plus_estimates_ms"] = round(
             (time.perf_counter() - t0) / n * 1e3, 2)
 
-    ap_rec = ap_cfg = None
+    ap_rec = ap_cfg = dp_cfg = None
     if args.autopilot:
         ap_res, ap_rec, ap_cfg = run_autopilot_leg(args)
         res["autopilot"] = ap_res
+    if args.dp:
+        dp_res, dp_cfg = run_dp_leg(args)
+        res["dp"] = dp_res
 
     print(json.dumps(res))
     if args.ledger:
@@ -245,6 +261,13 @@ def main():
             registry.maybe_write_manifest(
                 ap_cfg, bench={"sketch_bench": res},
                 extra={"autopilot": ap_rec, "wire_dtype": wire})
+        elif dp_cfg is not None:
+            # DP leg: the manifest config carries dp/dp_epsilon so
+            # the perf gate keys this pin under its privacy budget
+            # (p<eps> fragment) — never comparable to a dp-off run
+            registry.maybe_write_manifest(
+                dp_cfg, bench={"sketch_bench": res},
+                extra={"wire_dtype": wire})
         else:
             registry.maybe_write_manifest(
                 args, bench={"sketch_bench": res},
@@ -329,6 +352,101 @@ def run_autopilot_leg(args):
     }
     model.finalize()
     return summary, rec, cfg
+
+
+def run_dp_leg(args):
+    """The acceptance loop behind ``--dp``: the same small federated
+    sketch run with the full ``--dp sketch`` mechanism armed —
+    per-client L2 clip plus calibrated table noise at sigma > 0.
+    Acceptance: every probed round's recovery error holds the
+    ``--dp_band`` despite the noise, and the accountant's ε trail in
+    the ledger is strictly increasing. Returns ``(summary, cfg)``;
+    the summary's (sigma, recovery-error) pair is the BENCHMARKS
+    noise-vs-recovery row, and cfg keys the run manifest under its
+    privacy budget."""
+    import tempfile
+
+    from commefficient_tpu.autopilot import parse_band
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.privacy import table_noise_std
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    W, B, d, num_clients = 4, 2, 512, 16
+    led = args.ledger
+    tmpdir = None
+    if not led:
+        tmpdir = tempfile.mkdtemp(prefix="sketch_bench_dp_")
+        led = os.path.join(tmpdir, "dp_ledger.jsonl")
+    assert args.dp_noise_mult > 0, "--dp leg needs sigma > 0"
+    cfg = Config(mode="sketch", error_type="virtual",
+                 local_momentum=0.0, virtual_momentum=0.9,
+                 num_workers=W, local_batch_size=B, seed=5,
+                 num_clients=num_clients, k=64, num_rows=5,
+                 num_cols=2048, probe_every=1, dp="sketch",
+                 dp_clip=1.0, dp_noise_mult=args.dp_noise_mult,
+                 dp_delta=1e-5, ledger=led)
+    model = FedModel(None, {"w": jnp.zeros((d,), jnp.float32)},
+                     loss, cfg, padded_batch_size=B)
+    opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+    # shared-w_true regression (not iid noise targets): client
+    # gradients ALIGN, so the aggregate keeps the per-client scale
+    # and the noise-vs-signal ratio is set by the mechanism, not by
+    # cross-client cancellation
+    scale = (np.arange(1, d + 1) ** -1.5).astype(np.float32)
+    rng = np.random.RandomState(5)
+    w_true = rng.randn(d).astype(np.float32)
+    t0 = time.perf_counter()
+    for _ in range(args.dp_rounds):
+        x = rng.randn(W, B, d).astype(np.float32) * scale
+        batch = {
+            "client_ids": rng.choice(num_clients, W, replace=False)
+            .astype(np.int32),
+            "x": jnp.asarray(x),
+            "y": jnp.asarray(x.reshape(-1, d) @ w_true)
+            .reshape(W, B),
+            "mask": jnp.ones((W, B), jnp.float32)}
+        model(batch)
+        opt.step()
+    wall = time.perf_counter() - t0
+    model.finalize()
+
+    # acceptance reads the LEDGER, not the model: the ε trail and
+    # the probes must have survived all the way to the v5 records
+    eps_traj, errs = [], []
+    with open(led) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") != "round":
+                continue
+            if isinstance(rec.get("dp_epsilon"), (int, float)):
+                eps_traj.append(float(rec["dp_epsilon"]))
+            rerr = (rec.get("probes") or {}).get("recovery_error")
+            if isinstance(rerr, (int, float)):
+                errs.append(float(rerr))
+    lo, hi = parse_band(args.dp_band)
+    summary = {
+        "rounds": args.dp_rounds,
+        "band": args.dp_band,
+        "dp_noise_mult": args.dp_noise_mult,
+        "table_noise_std": round(table_noise_std(cfg), 6),
+        "eps_spent": eps_traj[-1] if eps_traj else None,
+        "eps_monotone": all(b > a for a, b in
+                            zip(eps_traj, eps_traj[1:])),
+        "charged_rounds": len(eps_traj),
+        "recovery_err_mean": (round(sum(errs) / len(errs), 4)
+                              if errs else None),
+        "recovery_err_max": (round(max(errs), 4) if errs else None),
+        "band_held": bool(errs) and all(e <= hi for e in errs),
+        "wall_s": round(wall, 2),
+    }
+    return summary, cfg
 
 
 if __name__ == "__main__":

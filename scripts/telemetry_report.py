@@ -88,6 +88,9 @@ def summarize(records) -> dict:
     variant_first = {}       # autopilot variant key -> first round a
                              # compile was stamped under it
     frontier_pts = []        # (uplink_bytes, recovery_error, round)
+    privacy_eps = []         # v5: (round, cumulative dp_epsilon)
+    dp_sigma_err = {}        # v5: dp_sigma -> [recovery_error, ...]
+    dp_delta = None          # v5: the accountant's delta (constant)
     uplink = downlink = 0.0
     rss_peak = hbm_peak = None
     for r in rounds:
@@ -154,6 +157,18 @@ def summarize(records) -> dict:
                 isinstance(rup, (int, float)):
             frontier_pts.append((float(rup), float(rerr),
                                  r["round"]))
+        # v5: the privacy accountant's per-round ε stamp, plus the
+        # noise-vs-recovery-error pairing (what each σ level cost in
+        # sketch recovery — the DP analogue of the bytes frontier)
+        eps = r.get("dp_epsilon")
+        if isinstance(eps, (int, float)):
+            privacy_eps.append((r["round"], float(eps)))
+            if isinstance(r.get("dp_delta"), (int, float)):
+                dp_delta = float(r["dp_delta"])
+        sig = r.get("dp_sigma")
+        if isinstance(sig, (int, float)) and \
+                isinstance(rerr, (int, float)):
+            dp_sigma_err.setdefault(float(sig), []).append(float(rerr))
         # v2-only keys: absent on v1 records, hence .get
         for key, val in (r.get("probes") or {}).items():
             if isinstance(val, (int, float)):
@@ -264,6 +279,22 @@ def summarize(records) -> dict:
             "first_round": min(r for _, r in by_bytes[up]),
             "err_mean": sum(errs) / len(errs),
             "err_max": max(errs)})
+    # privacy trajectory (v5 DP runs): the accountant's cumulative
+    # ε stamps plus one noise-vs-recovery-error point per σ level
+    privacy = None
+    if privacy_eps:
+        privacy_eps.sort(key=lambda p: p[0])
+        privacy = {
+            "rounds": len(privacy_eps),
+            "eps_first": privacy_eps[0][1],
+            "eps_last": privacy_eps[-1][1],
+            "delta": dp_delta,
+            "noise_vs_recovery": [
+                {"dp_sigma": s, "rounds": len(v),
+                 "recovery_err_mean": sum(v) / len(v),
+                 "recovery_err_max": max(v)}
+                for s, v in sorted(dp_sigma_err.items())],
+        }
     return {
         "meta": next((r for r in records if r["kind"] == "meta"),
                      None),
@@ -283,6 +314,7 @@ def summarize(records) -> dict:
         "alarm_rounds": alarm_rounds,
         "variant_compiles": dict(sorted(variant_compiles.items())),
         "frontier": frontier,
+        "privacy": privacy,
         "counters": dict(sorted(counters.items())),
         "host_rss_peak_bytes": rss_peak,
         "hbm_peak_bytes": hbm_peak,
@@ -401,6 +433,20 @@ def render_summary(s, label="") -> str:
             f"recovery err mean {p['err_mean']:.4g}, "
             f"max {p['err_max']:.4g} "
             f"({p['rounds']} round(s), from r{p['first_round']})")
+    pv = s.get("privacy")
+    if pv:
+        delta = (f" at delta {pv['delta']:.3g}"
+                 if pv.get("delta") is not None else "")
+        lines.append(
+            f"  privacy: eps {pv['eps_first']:.6g} -> "
+            f"{pv['eps_last']:.6g}{delta} "
+            f"({pv['rounds']} charged round(s))")
+        for pt in pv.get("noise_vs_recovery") or []:
+            lines.append(
+                f"  privacy sigma {pt['dp_sigma']:.6g}: "
+                f"recovery err mean {pt['recovery_err_mean']:.4g}, "
+                f"max {pt['recovery_err_max']:.4g} "
+                f"({pt['rounds']} round(s))")
     if s["counters"]:
         lines.append(f"  counters: {s['counters']}")
     if s["host_rss_peak_bytes"] is not None:
@@ -484,6 +530,13 @@ def diff_summaries(a: dict, b: dict) -> dict:
             "b_programs": eb["programs"] if eb else None}
     if vc_diff:
         out["variant_compiles"] = vc_diff
+    pa, pb = a.get("privacy"), b.get("privacy")
+    if pa or pb:
+        entry = {"a_eps_last": pa["eps_last"] if pa else None,
+                 "b_eps_last": pb["eps_last"] if pb else None}
+        if pa and pb and pa["eps_last"]:
+            entry["ratio"] = round(pb["eps_last"] / pa["eps_last"], 4)
+        out["privacy"] = entry
     aa = [x["round"] for x in a.get("alarm_rounds", [])]
     ab = [x["round"] for x in b.get("alarm_rounds", [])]
     if aa or ab:
@@ -526,6 +579,12 @@ def render_diff(d, label_a, label_b) -> str:
             f"  variant {key} compile: "
             f"{fmt(e['a_secs'], e['a_programs'])} -> "
             f"{fmt(e['b_secs'], e['b_programs'])}")
+    if "privacy" in d:
+        e = d["privacy"]
+        fmt = lambda v: f"{v:.6g}" if v is not None else "-"
+        r = f" ({e['ratio']}x)" if "ratio" in e else ""
+        lines.append(f"  privacy eps spent: {fmt(e['a_eps_last'])} "
+                     f"-> {fmt(e['b_eps_last'])}{r}")
     if "alarm_rounds" in d:
         e = d["alarm_rounds"]
         lines.append(f"  ALARM rounds: {e['a']} -> {e['b']}")

@@ -777,6 +777,80 @@ def chaos_smoke():
     return f"median err {err_med:.1e}; plain mean err {err_plain:.2f}"
 
 
+def dp_smoke():
+    """--dp sketch on the REAL backend: a zero-gradient round's
+    aggregated table is pure calibrated noise (empirical std ==
+    table_noise_std within 5%), one charged round at q=1 matches the
+    Mironov closed form restated inline, and --dp off is lowered-text
+    IDENTICAL to a build that never saw the dp knobs — privacy costs
+    nothing when it is off."""
+    import math
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.core.rounds import (ClientStates,
+                                               build_client_round)
+    from commefficient_tpu.privacy import (build_accountant,
+                                           table_noise_std)
+
+    W, B, d = 8, 4, 1 << 14
+
+    def lin_loss(p, b):
+        n = jnp.maximum(jnp.sum(b["mask"]), 1.0)
+        loss = jnp.sum((b["c"] @ p) * b["mask"]) / n
+        return loss, (loss * 0.0,)
+
+    def cfg_of(**kw):
+        cfg = Config(mode="sketch", error_type="virtual",
+                     local_momentum=0.0, virtual_momentum=0.9,
+                     num_workers=W, local_batch_size=B, k=64,
+                     num_rows=5, num_cols=16384, seed=21,
+                     num_clients=W, dataset_name="CIFAR10", **kw)
+        cfg.grad_size = d
+        return cfg
+
+    # calibrated noise: zero gradients -> the released table IS the
+    # noise draw, so its empirical std must be the mechanism's std
+    cfg = cfg_of(dp="sketch", dp_clip=1.0, dp_noise_mult=1.3)
+    cr = jax.jit(build_client_round(cfg, lin_loss, B))
+    batch = {"c": jnp.zeros((W, B, d), jnp.float32),
+             "mask": jnp.ones((W, B), jnp.float32)}
+    flat = jnp.zeros((d,), jnp.float32)
+    res = cr(flat, ClientStates.init(cfg, W, flat), batch,
+             jnp.arange(W, dtype=jnp.int32), jax.random.PRNGKey(0),
+             1.0)
+    want = table_noise_std(cfg)
+    got = float(np.asarray(res.aggregated).std())
+    assert abs(got - want) / want < 0.05, (got, want)
+
+    # one charged round at q = 1 (num_clients == cohort) must equal
+    # the Mironov subsampled-Gaussian closed form, restated inline
+    # with math-library calls only — independent of the accountant
+    acc = build_accountant(cfg)
+    acc.step()
+    sigma, delta = cfg.dp_noise_mult, cfg.dp_delta
+    closed = min(
+        a / (2.0 * sigma ** 2) + math.log1p(-1.0 / a)
+        - (math.log(delta) + math.log(a)) / (a - 1)
+        for a in range(2, 513))
+    eps = acc.epsilon()
+    assert abs(eps - closed) <= 1e-9 * closed, (eps, closed)
+
+    # --dp off fingerprint identity: inert dp knobs must not perturb
+    # the lowered round program by a single character
+    texts = []
+    for kw in ({}, dict(dp="off", dp_clip=9.9, dp_noise_mult=7.0)):
+        c2 = cfg_of(**kw)
+        f = jax.jit(build_client_round(c2, lin_loss, B))
+        texts.append(f.lower(
+            flat, ClientStates.init(c2, W, flat), batch,
+            jnp.arange(W, dtype=jnp.int32), jax.random.PRNGKey(0),
+            jnp.float32(1.0)).as_text())
+    assert texts[0] == texts[1], "--dp off perturbed the round program"
+    return (f"noise std {got:.4g} (calibrated {want:.4g}); "
+            f"one-round eps {eps:.4g} == closed form; "
+            f"dp-off program identical")
+
+
 def bench_throughput():
     """Headline bench must clear the BASELINE north-star (>= 8x)."""
     import json
@@ -806,6 +880,7 @@ def main():
     check("elastic_smoke", elastic_smoke)
     check("flash_attention_parity", flash_attention_parity)
     check("chaos_smoke", chaos_smoke)
+    check("dp_smoke", dp_smoke)
     check("bench_vs_baseline", bench_throughput)
     if FAILED:
         print(f"\n{len(FAILED)} check(s) failed: {FAILED}")

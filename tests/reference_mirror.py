@@ -32,6 +32,14 @@ def np_topk(v, k):
 _TINY = 1e-12
 
 
+def np_clip_factors(norms, tau):
+    """Mirror of core/robust.clip_factors — the ONE per-vector
+    norm-clip algebra shared by the ``clip`` robust fold and the DP
+    per-client clip (privacy/mechanism.py), restated here in NumPy
+    with the same ``_TINY`` guard."""
+    return np.minimum(1.0, tau / np.maximum(norms, _TINY))
+
+
 def np_masked_median(vals, alive):
     """Coordinate-wise median over alive rows; same rank formula as
     core/robust._masked_median (dead rows sort to +inf)."""
@@ -89,7 +97,7 @@ def np_robust_fold(cfg, transmits, counts):
             tau = float(cfg.robust_clip_norm)
         else:
             tau = float(np_masked_median(norms[:, None], alive)[0])
-        scale = np.minimum(1.0, tau / np.maximum(norms, _TINY))
+        scale = np_clip_factors(norms, tau)
         agg = np.sum(scale[:, None] * T, axis=0) / total
     else:
         raise ValueError(f"unknown robust_agg {mode!r}")
@@ -252,6 +260,11 @@ class MirrorFed:
             norm = np.linalg.norm(g)
             if norm > cfg.l2_norm_clip:
                 g = g * (cfg.l2_norm_clip / norm)
+        if getattr(cfg, "dp", "off") == "sketch":
+            # --dp sketch per-client clip (privacy/mechanism.dp_clip):
+            # the shared clip algebra on the per-datapoint-mean dense
+            # gradient, before sketching
+            g = g * np_clip_factors(np.linalg.norm(g), cfg.dp_clip)
         if cfg.mode == "sketch":
             # dense pre-sketch transmit: ground truth for the
             # recovery-error probe (valid when no table-space
@@ -347,10 +360,13 @@ class MirrorFed:
 
     # round ---------------------------------------------------------------
 
-    def round(self, clients, lr, B=None):
+    def round(self, clients, lr, B=None, rng=None):
         """clients: list of (client_id, X, y). Returns new weights.
         ``B``: the engine round's padded batch size (microbatch
-        boundaries depend on it; None = no padding)."""
+        boundaries depend on it; None = no padding). ``rng``: the
+        round's PRNG key as passed to the engine round — required
+        under ``--dp sketch`` with ``dp_noise_mult > 0`` (the mirror
+        draws the SAME table noise via privacy.round_noise_key)."""
         total = sum(len(y) for _, _, y in clients)
         self._dense_tt = []
         transmits = [self._client_transmit(cid, X, y, B)
@@ -358,6 +374,13 @@ class MirrorFed:
         robust = getattr(self.cfg, "robust_agg", "none") != "none"
         wire = getattr(self.cfg, "sketch_dtype", "f32")
         quantized = self.cfg.mode == "sketch" and wire != "f32"
+        # --dp sketch: the engine disables every pre-noise wire qdq
+        # (noise BEFORE quantization — core/rounds.py) and applies one
+        # qdq to the noisy aggregated table instead
+        dp_on = getattr(self.cfg, "dp", "off") == "sketch"
+        dp_qdq = quantized and dp_on
+        if dp_on:
+            quantized = False
         # where the table crosses the wire (mirrors the engine's path
         # split in core/rounds.py): clip / robust paths upload
         # per-client tables, so each transmit is quantized BEFORE the
@@ -380,6 +403,20 @@ class MirrorFed:
                 / total
         else:
             agg = np.sum(transmits, axis=0) / total
+        if dp_on:
+            from commefficient_tpu.privacy import (np_dp_noise,
+                                                   round_noise_key,
+                                                   table_noise_std)
+            std = table_noise_std(self.cfg)
+            if std > 0:
+                assert rng is not None, \
+                    "MirrorFed.round needs the engine round's rng " \
+                    "under --dp sketch"
+                agg = agg + np_dp_noise(round_noise_key(rng),
+                                        np.shape(agg),
+                                        std).astype(np.float64)
+            if dp_qdq:
+                agg = np_qdq_table(agg, wire).astype(np.float64)
         # sketch-late engine paths materialise DENSE per-client
         # transmits (the table appears only after the local sum), so
         # the transmit-norm probes are over the dense vectors there;

@@ -327,6 +327,75 @@ def test_round_plan_records_autopilot_block():
         dataclasses.replace(make_cfg(), grad_size=64))
 
 
+# --- dp budget constraint -----------------------------------------------
+
+
+def dp_make_cfg(**kw):
+    base = dict(dp="sketch", dp_clip=1.0, dp_noise_mult=1.0,
+                dp_delta=1e-5, num_clients=8)
+    base.update(kw)
+    return make_cfg(**base)
+
+
+def test_apply_knobs_rescales_noise_on_rows_move():
+    """A rows-changing knob move recalibrates dp_noise_mult so the
+    ABSOLUTE table noise stays at the launch calibration."""
+    import math
+
+    from commefficient_tpu.privacy import table_noise_std
+
+    cfg = dp_make_cfg(num_rows=4)
+    moved = apply_knobs(cfg, key_of(cfg)._replace(rows=16))
+    assert moved.dp_noise_mult == pytest.approx(math.sqrt(4 / 16))
+    assert table_noise_std(moved) == pytest.approx(
+        table_noise_std(cfg))
+    # dtype-only move: σ untouched (qdq is free post-processing)
+    assert apply_knobs(cfg, key_of(cfg)._replace(
+        dtype="int8")).dp_noise_mult == cfg.dp_noise_mult
+    # dp off: the knob is inert, never rewritten
+    off = make_cfg(num_rows=4)
+    assert apply_knobs(off, key_of(off)._replace(
+        rows=16)).dp_noise_mult == off.dp_noise_mult
+
+
+def test_budget_feasible_predicate():
+    from commefficient_tpu.autopilot.controller import _budget_feasible
+
+    cfg = dp_make_cfg(dp_epsilon=8.0)
+    keep = _budget_feasible(cfg)
+    assert keep(key_of(cfg))                        # launch point
+    assert keep(key_of(cfg)._replace(rows=1))       # σ grows: slower
+    assert not keep(key_of(cfg)._replace(rows=12))  # σ shrinks: faster
+    # constraint off (no budget / dp off): everything passes
+    assert _budget_feasible(make_cfg())(
+        key_of(cfg)._replace(rows=12))
+    assert _budget_feasible(dp_make_cfg())(
+        key_of(cfg)._replace(rows=12))
+
+
+def test_controller_never_holds_budget_violating_point():
+    """The ladder is pre-filtered: every point the controller can
+    ever visit fits at least as many rounds under --dp_epsilon as
+    the launch plan; an infeasible pin is a launch error, not a
+    silent fallback."""
+    from commefficient_tpu.autopilot.controller import _budget_feasible
+
+    cfg = dp_make_cfg(autopilot="on", autopilot_band="0.05:0.6",
+                      probe_every=1, dp_epsilon=8.0)
+    ctl = build_controller(cfg)
+    keep = _budget_feasible(cfg)
+    assert ctl is not None and all(keep(k) for k in ctl.ladder)
+
+    bad = key_str(key_of(cfg)._replace(rows=12))
+    with pytest.raises(ValueError, match="budget"):
+        build_controller(dataclasses.replace(cfg, autopilot_pin=bad))
+
+    good = key_str(key_of(cfg)._replace(rows=1))
+    pinned = build_controller(dataclasses.replace(cfg,
+                                                  autopilot_pin=good))
+    assert pinned.pinned and key_str(pinned.key) == good
+
+
 # --- FedModel integration ----------------------------------------------
 
 

@@ -25,7 +25,10 @@ import pytest
 from commefficient_tpu.clientstore import HostClientStore, StorePrefetcher
 from commefficient_tpu.clientstore import prefetch as prefetch_mod
 from commefficient_tpu.config import Config
-from commefficient_tpu.core.rounds import ClientStates, build_client_round
+from commefficient_tpu.core.rounds import (ClientStates,
+                                           build_client_round,
+                                           build_server_round)
+from commefficient_tpu.core.server import ServerState
 from commefficient_tpu.data.chaos import (ChaosConfig, ChaosInjector,
                                           FlakyStore,
                                           kill_prefetch_worker)
@@ -333,6 +336,99 @@ def test_attack_matrix_converge_or_alarm(attack, fold):
         # sign_flip hides inside the norm distribution; the fold's
         # own rejection-rate probe is what detects it
         assert "fold_rejection_rate" in rules, (attack, fold, rules)
+
+
+# --- DP x byzantine: privacy noise composes with the robust fold -------
+
+
+def _run_dp_cell(attack, rounds=40):
+    """One DP matrix cell: the sign-flip adversary against a sketch
+    round carrying the FULL --dp sketch mechanism (per-client L2 clip
+    + seeded Gaussian noise on the aggregated table) folded with the
+    robust clip estimator. Same contract as the plain matrix: returns
+    (initial honest loss, final honest loss, fired alarm rules)."""
+    from commefficient_tpu.privacy import table_noise_std
+
+    W, B, d, lr = 8, 20, 16, 0.25
+    cfg = make_cfg(mode="sketch", error_type="virtual", k=8,
+                   num_rows=5, num_cols=128, num_workers=W,
+                   local_batch_size=B, grad_size=d, probe_every=1,
+                   on_divergence="log", alarm_byzantine_ratio=2.5,
+                   alarm_fold_rejection=0.8, robust_agg="clip",
+                   dp="sketch", dp_clip=20.0, dp_noise_mult=0.05)
+    assert table_noise_std(cfg) > 0  # the noise leg is really armed
+    inj = ChaosInjector(_matrix_chaos(attack), W)
+    transform = inj.transmit_transform()
+    if transform is None:
+        def transform(transmit, batch, client_ids, rng):
+            return transmit
+    client_round = jax.jit(build_client_round(
+        cfg, linear_loss, B, probes=True,
+        transmit_transform=transform))
+    server_round = jax.jit(build_server_round(cfg))
+
+    rng = np.random.RandomState(11)
+    w_true = rng.randn(d)
+    X = rng.randn(W, B, d).astype(np.float32)
+    Y = (X.reshape(-1, d) @ w_true).reshape(W, B).astype(np.float32)
+    batch = {"x": jnp.asarray(X), "y": jnp.asarray(Y),
+             "mask": jnp.ones((W, B), jnp.float32)}
+    ids = jnp.asarray(np.arange(W, dtype=np.int32))
+
+    def honest_loss(p):
+        r = X.reshape(-1, d) @ np.asarray(p, np.float64) - Y.ravel()
+        return float(np.mean(r * r))
+
+    alarm_engine = build_alarm_engine(cfg)
+    ps = jnp.zeros((d,), jnp.float32)
+    cs = ClientStates.init(cfg, W, ps)
+    ss = ServerState.init(cfg)
+    key = jax.random.PRNGKey(cfg.seed)
+    init = honest_loss(ps)
+    rules = set()
+    for r in range(rounds):
+        res = client_round(ps, cs, batch, ids,
+                           jax.random.fold_in(key, r),
+                           jnp.float32(lr))
+        cs = res.client_states
+        probes = {k: float(v) for k, v in res.probes.items()}
+        rules |= {a["rule"] for a in alarm_engine.check(r, probes)}
+        ps, ss, new_vel, _, _ = server_round(
+            ps, ss, res.aggregated, jnp.float32(lr),
+            cs.velocities, ids)
+        if new_vel is not None:
+            cs = cs._replace(velocities=new_vel)
+    return init, honest_loss(ps), rules
+
+
+_DP_CLEAN = {}
+
+
+def _dp_clean_cell():
+    if "cell" not in _DP_CLEAN:
+        _DP_CLEAN["cell"] = _run_dp_cell("none")
+    return _DP_CLEAN["cell"]
+
+
+def test_dp_clean_round_converges_without_alarm():
+    """No attack: the DP mechanism alone (clip + table noise + clip
+    fold) converges on the honest objective and trips NO alarm — the
+    privacy noise must not read as a byzantine signature."""
+    init, final, rules = _dp_clean_cell()
+    assert final <= 0.05 * init, (final, init)
+    assert not rules, rules
+
+
+def test_dp_sign_flip_clip_converge_or_alarm():
+    """The headline composition cell: sign_flip byzantines inside a
+    DP round with the clip fold. Same forbidden outcome as the plain
+    matrix — silent >2x degradation vs the DP clean baseline. The
+    per-client DP clip must not blunt the fold, and the table noise
+    must not mask (or fake) the adversary."""
+    _, clean_final, _ = _dp_clean_cell()
+    init, final, rules = _run_dp_cell("sign_flip")
+    converged = final <= max(2.0 * clean_final, 0.05 * init)
+    assert converged or rules, (final, clean_final, init, rules)
 
 
 # --- alarm rules in isolation ------------------------------------------

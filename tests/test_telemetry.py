@@ -8,6 +8,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from commefficient_tpu.telemetry import (NULL_TELEMETRY, Telemetry,
                                          validate_record)
@@ -164,9 +165,9 @@ def test_schema_v4_device_time_round_trip(tmp_path):
     from commefficient_tpu.telemetry.record import (
         READABLE_SCHEMA_VERSIONS, make_round_record)
 
-    assert READABLE_SCHEMA_VERSIONS == (1, 2, 3, 4)
+    assert READABLE_SCHEMA_VERSIONS == (1, 2, 3, 4, 5)
     rec = make_round_record(0)
-    assert rec["schema"] == 4 and rec["device_time"] is None
+    assert rec["schema"] == 5 and rec["device_time"] is None
     assert validate_record(rec) == []
 
     rec["device_time"] = {"window_s": 0.01, "busy_s": 0.004,
@@ -306,6 +307,51 @@ def test_report_diff(tmp_path):
     assert d["uplink_bytes"]["ratio"] == 0.5
     text = report.render_diff(d, "a", "b")
     assert "span server" in text
+
+
+def test_report_privacy_section(tmp_path):
+    """DP runs: the report carries the ε trajectory and the
+    noise-vs-recovery-error pairing; diff shows ε spent a -> b."""
+    report = _load_report_module()
+
+    def write(path, sigma, eps_per_round, err):
+        tel = Telemetry([JSONLSink(str(path))])
+        for r in range(3):
+            tel.begin_round(r)
+            tel.merge_round_probes(r, {"recovery_error": err})
+            tel.set_round_privacy(r, eps_per_round * (r + 1), 1e-5,
+                                  sigma)
+            tel.set_round_bytes(r, 10.0, 10.0)
+        tel.close()
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    write(a, sigma=0.5, eps_per_round=0.1, err=0.2)
+    write(b, sigma=1.0, eps_per_round=0.05, err=0.4)
+    sa = report.summarize(report.load_ledger(str(a))[0])
+    pv = sa["privacy"]
+    assert pv["rounds"] == 3
+    assert pv["eps_first"] == pytest.approx(0.1)
+    assert pv["eps_last"] == pytest.approx(0.3)
+    assert pv["delta"] == pytest.approx(1e-5)
+    assert pv["noise_vs_recovery"] == [
+        {"dp_sigma": 0.5, "rounds": 3,
+         "recovery_err_mean": pytest.approx(0.2),
+         "recovery_err_max": pytest.approx(0.2)}]
+    text = report.render_summary(sa)
+    assert "privacy: eps 0.1 -> 0.3" in text
+    assert "privacy sigma 0.5" in text
+    sb = report.summarize(report.load_ledger(str(b))[0])
+    d = report.diff_summaries(sa, sb)
+    assert d["privacy"]["a_eps_last"] == pytest.approx(0.3)
+    assert d["privacy"]["b_eps_last"] == pytest.approx(0.15)
+    assert "privacy eps spent" in report.render_diff(d, "a", "b")
+    # dp-less ledgers: no privacy section, no diff entry
+    c = tmp_path / "c.jsonl"
+    _write_ledger(c, n_rounds=2, ms_per_round=1.0,
+                  bytes_per_round=1.0)
+    sc = report.summarize(report.load_ledger(str(c))[0])
+    assert sc["privacy"] is None
+    assert "privacy" not in report.diff_summaries(sc, sc)
 
 
 def test_report_flags_invalid_lines(tmp_path):
