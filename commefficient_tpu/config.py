@@ -630,6 +630,22 @@ class Config:
                 "--dp sketch noises the round's aggregated table " \
                 "once; incompatible with --client_chunk (the " \
                 "chunked scan never materialises it pre-wire)"
+            # the accountant charges a per-client sqrt(r)·C/W bound;
+            # median/trimmed releases don't have it (one client can
+            # move a coordinate median by far more than its mean
+            # share), and a cohort-derived clip cap (median of alive
+            # norms) makes every client's scale depend on everyone's
+            # data — also outside the bound
+            assert self.robust_agg in ("none", "clip"), \
+                "--dp sketch composes only with --robust_agg " \
+                "{none,clip}: median/trimmed folds do not have the " \
+                "sqrt(r)*clip/W sensitivity the accountant charges"
+            assert self.robust_agg != "clip" \
+                or self.robust_clip_norm > 0, \
+                "--dp sketch with the clip fold needs a fixed " \
+                "--robust_clip_norm > 0 (the auto median-of-norms " \
+                "cap couples every client's scale to the whole " \
+                "cohort, voiding the per-client sensitivity bound)"
         if self.mode == "sketch":
             # sketched SGD with local error/momentum is undefined: we
             # can't know which part of a sketch is "error"

@@ -134,9 +134,11 @@ def make_forward_grad(cfg: Config,
                 g = g + noise * jnp.sqrt(float(cfg.num_workers))
 
         # DP sketching (--dp sketch, privacy/): L2-clip the client's
-        # per-datapoint-mean dense gradient BEFORE sketching —
-        # sketching is linear, so the aggregated table is the sketch
-        # of the clipped mean and the calibrated table noise
+        # SUMMED dense gradient — the microbatch-accumulated total,
+        # never divided by batch_size, so --dp_clip is calibrated at
+        # summed-gradient scale — BEFORE sketching. Sketching is
+        # linear, so the aggregated table is the sketch of the
+        # clipped sums and the calibrated table noise
         # (core/rounds.py) covers a sqrt(r)·dp_clip/W sensitivity.
         # Trace-time gate: "off" emits today's program bit-for-bit.
         if getattr(cfg, "dp", "off") == "sketch":

@@ -135,7 +135,16 @@ def robust_fold(cfg, transmit, batch, probes=False, weights=None):
         flatT = w[:, None] * flatT
         n = w * n
     alive = n > 0
-    total = jnp.maximum(jnp.sum(n), 1.0)
+    # --dp sketch normalises by the STATIC padded capacity W·B like
+    # the plain fold (core/rounds.py, rationale there): each clipped
+    # transmit is bounded by C·n_i, so only a data-independent
+    # denominator ≥ W·n_i keeps every client's share within the
+    # charged sqrt(r)·C/W sensitivity (privacy/mechanism.py).
+    # Trace-time gate — dp-off folds keep the 1.0 guard unchanged.
+    if getattr(cfg, "dp", "off") == "sketch":
+        total = jnp.float32(float(batch["mask"].size))
+    else:
+        total = jnp.maximum(jnp.sum(n), 1.0)
     plain = jnp.sum(flatT, axis=0) / total
     # per-datapoint client means — the robust estimators operate on a
     # common scale so one big-batch client can't dominate by weight

@@ -827,6 +827,22 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
         # probes below keep reading the UNWEIGHTED per-client
         # transmits — they report what clients sent, not how the
         # fold discounted it.
+        # Under --dp sketch the denominator is the STATIC padded
+        # datapoint capacity W·B (mask.size), not the alive total:
+        # one client's transmit is its clipped gradient × its real
+        # datapoint count n_i ≤ B, so its share of a capacity-
+        # normalised fold is bounded by n_i/(W·B) ≤ 1/W — the
+        # sqrt(r)·C/W sensitivity the accountant charges
+        # (privacy/mechanism.py) — on EVERY round. A data-dependent
+        # denominator breaks that bound two ways: a mostly-dead round
+        # shrinks it below W·n_i (the survivor's share exceeds 1/W
+        # against noise calibrated for W), and the weighted async
+        # fold's Σ cw·n denominator cancels uniform staleness weights
+        # out of the release entirely (no sensitivity shrink to
+        # credit). With the fixed denominator the weights genuinely
+        # scale the release, so the accountant's w·Δ staleness
+        # discount is sound. Trace-time constant: dp-off builds are
+        # bit-identical to before.
         if weighted:
             cw = staleness_weights(staleness, alpha)
             n_per = jnp.sum(batch["mask"],
@@ -838,6 +854,8 @@ def build_client_round(cfg: Config, loss_fn: Optional[Callable],
             cw = None
             total = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
             t_fold = transmit
+        if dp_on:
+            total = jnp.float32(float(batch["mask"].size))
         fold_pr = None
         if robust:
             from commefficient_tpu.core.robust import robust_fold

@@ -6,10 +6,10 @@ telemetry/report contexts without touching a device.
 
 Per round the mechanism (privacy/mechanism.py) releases the
 aggregated sketch table + N(0, (σ·Δ)²) where Δ bounds one client's
-contribution and σ = ``--dp_noise_mult``. With the round's cohort
-Poisson-sampled at rate q = num_workers/num_clients, the round is the
-sampled Gaussian mechanism; its RDP at integer order α is the exact
-Mironov–Talwar–Zhang closed form
+contribution and σ = ``--dp_noise_mult``. When a round's cohort is
+genuinely Poisson-sampled at rate q (every client tossed
+independently), the round is the sampled Gaussian mechanism; its RDP
+at integer order α is the exact Mironov–Talwar–Zhang closed form
 
     ε_α = log( Σ_{k=0}^{α} C(α,k) (1-q)^{α-k} q^k
                · exp(k(k-1)/(2σ²)) ) / (α-1)
@@ -19,14 +19,27 @@ addition over rounds; ε(δ) is the order-minimised conversion
 
     ε = min_α  ε_α_total + log((α-1)/α) − (log δ + log α)/(α-1)
 
-(the tightened Canonne–Kamath–Steinke bound). Two round features
-adjust the per-round curve:
+(the tightened Canonne–Kamath–Steinke bound). The repo's own runs
+charge q = 1 — NO subsampling amplification: the FedSampler cohort
+is ``num_workers`` non-exhausted clients drawn without replacement,
+and every client participates in ~data_i/batch rounds per epoch
+until its data is spent, so participation is neither Poisson nor
+independent across rounds and the amplified curve would under-report
+ε (``sample_rate_of``). The subsampled closed form stays available
+for callers that do Poisson-sample. Two round features and what they
+are charged:
 
-- **staleness weights** (asyncfed): a fold weight w ≤ 1 scales every
-  client contribution, so the round's sensitivity shrinks to w·Δ and
-  its effective noise multiplier grows to σ/w — ``step(weight_scale=
-  w)`` charges the cheaper curve. w is the round's max fold weight
-  (the sensitivity bound is per-client).
+- **staleness weights** (asyncfed) earn a sensitivity discount
+  because DP folds normalise by the STATIC padded capacity W·B
+  (core/rounds.py), never by the weighted datapoint total: a
+  client's released contribution is cw_i·t_i/(W·B), genuinely
+  scaled by its fold weight, so a round whose largest alive weight
+  is w has sensitivity w·Δ and is charged ``step(weight_scale=w)``
+  — the effective noise multiplier σ/w (runtime/fed_model.py).
+  The discount is sound ONLY against a weight-independent
+  normaliser; against the weight-preserving Σ cw_i·n_i denominator
+  uniform weights would cancel out of the release and the
+  discounted curve would under-report ε.
 - **quantization**: the int8/fp8 wire qdq runs *after* the noise
   (core/rounds.py ordering) — post-processing, charged nothing.
 
@@ -119,11 +132,16 @@ class PrivacyAccountant:
 
     def round_rdp(self, weight_scale: float = 1.0,
                   sigma: Optional[float] = None) -> list:
-        """One round's RDP curve at fold-weight scale w ≤ 1 (the
-        effective noise multiplier is σ/w). ``sigma`` overrides the
-        base noise multiplier for the round — the autopilot's active
-        variant may run a different ``dp_noise_mult`` than the launch
-        config (geometry moves rescale it; autopilot/lattice.py)."""
+        """One round's RDP curve. ``weight_scale=w`` charges the
+        effective noise multiplier σ/w — sound ONLY for a mechanism
+        that scales every client's contribution by ≤ w against a
+        weight-independent normaliser. The shipped DP folds qualify:
+        they divide by the static W·B capacity, so the runtime
+        charges the round's largest alive staleness weight (module
+        docstring). ``sigma`` overrides the base noise
+        multiplier for the round — the autopilot's active variant may
+        run a different ``dp_noise_mult`` than the launch config
+        (geometry moves rescale it; autopilot/lattice.py)."""
         assert 0.0 < weight_scale <= 1.0, weight_scale
         base = self.noise_multiplier if sigma is None else float(sigma)
         eff = base / weight_scale if base > 0 else 0.0
@@ -235,13 +253,20 @@ def steps_to_budget(noise_multiplier: float, sample_rate: float,
 
 
 def sample_rate_of(cfg) -> float:
-    """The config's Poisson sampling rate: the cohort fraction
-    num_workers/num_clients, capped at 1 (full participation composes
-    as the plain Gaussian). Shared by the accountant, the autopilot's
-    budget pre-filter and the selftest's closed-form check."""
-    denom = max(int(getattr(cfg, "num_clients", 0) or 0),
-                int(cfg.num_workers))
-    return min(1.0, float(cfg.num_workers) / float(denom))
+    """The accountant's per-round sampling rate for this config:
+    1.0 — NO subsampling amplification. Poisson amplification needs
+    every client tossed independently at rate q each round; the
+    FedSampler cohort is ``num_workers`` non-exhausted clients drawn
+    WITHOUT replacement, with every client participating until its
+    epoch data is spent, so charging q = num_workers/num_clients
+    would under-report ε (module docstring). The subsampled curve
+    stays available to callers that genuinely Poisson-sample
+    (``rdp_subsampled_gaussian`` / ``PrivacyAccountant(sample_rate=
+    q)``). Shared by the accountant, the autopilot's budget
+    pre-filter and the selftest's closed-form check so all three
+    price the same mechanism."""
+    del cfg
+    return 1.0
 
 
 def build_accountant(cfg) -> Optional[PrivacyAccountant]:

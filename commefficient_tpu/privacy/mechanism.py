@@ -2,26 +2,37 @@
 
 The ``--dp sketch`` mechanism (FedSKETCH, PAPERS.md):
 
-1. each participating client's per-datapoint-mean dense gradient is
-   L2-clipped to ``--dp_clip`` (``dp_clip`` below — the shared clip
-   algebra from core/robust.py, so the robust ``clip`` fold and the
-   DP clip cannot drift);
+1. each participating client's SUMMED dense gradient — the
+   microbatch-accumulated total, never divided by the batch size
+   (core/grad.py), so ``--dp_clip`` is calibrated at summed-gradient
+   scale and grows with the local batch — is L2-clipped to
+   ``--dp_clip`` (``dp_clip`` below — the shared clip algebra from
+   core/robust.py, so the robust ``clip`` fold and the DP clip
+   cannot drift);
 2. the round's *aggregated* sketch table — after the fold and its
-   datapoint normalisation, BEFORE any wire quantization — receives
+   capacity normalisation, BEFORE any wire quantization — receives
    one Gaussian noise draw with std ``table_noise_std(cfg)``. The
    released value is therefore exactly what the accountant charges
    for; the int8/fp8 wire qdq that follows is post-processing (free).
 
-Sensitivity: every count-sketch row receives the full clipped vector,
-so a client's table has L2 norm ≤ sqrt(num_rows)·dp_clip; the fold is
-a datapoint-weighted mean over ``num_workers`` clients, so one
-client's contribution to the released aggregate is bounded by
-sqrt(num_rows)·dp_clip/num_workers (exact at equal batch sizes, an
-upper bound when padding/dropout shrinks a client's share). Noise std
-is ``dp_noise_mult`` times that bound, so the accountant's per-round
-noise multiplier is exactly ``cfg.dp_noise_mult``. Asyncfed staleness
-weights w ≤ 1 only shrink a client's contribution — the accountant
-credits the observed weight scale (accountant.py).
+Sensitivity: the transmitted quantity is the CLIPPED gradient times
+the client's real datapoint count — core/client.py scales the
+clipped unit by ``n_i ≤ B`` after the clip — and every count-sketch
+row receives the full vector, so a client's table has L2 norm
+≤ sqrt(num_rows)·dp_clip·n_i. DP folds divide by the STATIC padded
+capacity ``W·B`` (core/rounds.py / core/robust.py), never by the
+data-dependent alive total, so one client's share of the released
+aggregate is ≤ sqrt(r)·C·n_i/(W·B) ≤ sqrt(r)·C/W on EVERY round —
+tight at ``n_i = B``, conservative for smaller batches, and immune
+to mostly-dead rounds (a shrinking alive total would otherwise hand
+a survivor a share above sqrt(r)·C/W against noise calibrated for
+W). Noise std is ``dp_noise_mult`` times that bound, so the
+accountant's per-round noise multiplier is exactly
+``cfg.dp_noise_mult``. Because the denominator is weight- and
+data-independent, asyncfed staleness weights genuinely scale each
+client's release (cw_i·t_i/(W·B)) and earn the accountant's
+``weight_scale`` sensitivity discount (runtime/fed_model.py,
+accountant.py).
 
 Replayability: the one noise key per round is a distinguished
 ``fold_in`` of the round key already threaded through
@@ -73,9 +84,11 @@ def gaussian_noise(rng, shape, dtype=jnp.float32, std=1.0):
 
 
 def dp_clip(g, cap):
-    """L2-clip one dense gradient to ``cap`` — the same
-    min(1, cap/max(norm, tiny)) factor as the robust clip fold
-    (core/robust.clip_factors), exact identity inside the cap."""
+    """L2-clip one client's dense gradient — the microbatch-
+    accumulated SUM, not a per-datapoint mean (core/grad.py) — to
+    ``cap``, with the same min(1, cap/max(norm, tiny)) factor as the
+    robust clip fold (core/robust.clip_factors), exact identity
+    inside the cap."""
     norm = jnp.sqrt(jnp.sum(jax.lax.square(g)))
     return g * clip_factors(norm, jnp.float32(cap))
 
@@ -83,8 +96,10 @@ def dp_clip(g, cap):
 def table_sensitivity(num_rows: int, clip: float,
                       num_workers: int) -> float:
     """One client's max L2 contribution to the aggregated table:
-    sqrt(r)·C/W (every sketch row carries the full clipped vector;
-    the fold is a W-client datapoint-weighted mean)."""
+    sqrt(r)·C/W (every sketch row carries the full clipped vector,
+    the transmit scales it by n_i ≤ B, and DP-mode folds divide by
+    the static W·B capacity — core/rounds.py — so the bound holds on
+    padded / mostly-dead rounds too, tight at n_i = B)."""
     return math.sqrt(num_rows) * float(clip) / float(num_workers)
 
 

@@ -818,41 +818,53 @@ class FedModel:
                 self._apply_note(op[1])
         return results
 
-    def _charge_privacy(self, ridx: int, cfg, staleness, mask):
+    def _charge_privacy(self, ridx: int, cfg, staleness=None,
+                        mask=None):
         """Charge round ``ridx``'s DP release to the accountant and
         stamp the schema-v5 ledger keys. σ is the DISPATCHED variant's
         ``dp_noise_mult`` (autopilot geometry moves recalibrate it so
-        the absolute table noise holds — autopilot/lattice.py); the
-        weight scale is the round's max staleness fold weight over
-        alive slots: every client contribution is scaled by at most w,
-        so the round's sensitivity shrinks to w·Δ and the effective
-        noise multiplier grows to σ/w. A fully-dead round (every slot
-        dropped or padding) charges w = 1 — conservative: its release
-        reveals nothing, but the accountant never under-counts. With a
-        hard budget (``--dp_epsilon`` > 0) the post-charge ε routes
-        through the alarm engine, so ``--on_divergence abort`` stops
-        the run AT the exhausting round."""
+        the absolute table noise holds — autopilot/lattice.py).
+
+        Async staleness-weighted rounds charge the REDUCED
+        sensitivity ``weight_scale = (1 + s_min)^{-alpha}`` — the
+        largest fold weight among the round's ALIVE slots: DP folds
+        normalise by the static W·B capacity (core/rounds.py), so a
+        client's released contribution is cw_i·t_i/(W·B), genuinely
+        scaled by its weight, and the round's worst-case release is
+        the largest alive weight times the full sensitivity. (Against
+        the data-dependent Σ cw_i·n_i denominator this discount would
+        be unsound — uniform weights cancel out of that release.)
+        Fully-dead rounds conservatively charge 1. With a hard budget
+        (``--dp_epsilon`` > 0) the post-charge ε routes through the
+        alarm engine, so ``--on_divergence abort`` stops the run AT
+        the exhausting round."""
         acc = self._accountant
         sigma = float(cfg.dp_noise_mult)
         w = 1.0
         alpha = float(getattr(cfg, "async_staleness_weight", 0.0)
                       or 0.0)
-        if staleness is not None and alpha != 0.0:
-            alive = mask.reshape(len(staleness), -1).sum(axis=1) > 0
+        if staleness is not None and alpha > 0.0:
+            s = np.asarray(staleness, np.float64)
+            alive = np.asarray(mask).reshape(s.shape[0], -1) \
+                .sum(axis=1) > 0
             if alive.any():
-                s_min = float(np.asarray(staleness)[alive].min())
-                w = min(float((1.0 + s_min) ** (-alpha)), 1.0)
+                w = float(min(
+                    (1.0 + float(s[alive].min())) ** (-alpha), 1.0))
         acc.step(weight_scale=w, sigma=sigma)
         eps = acc.epsilon()
-        sigma_eff = sigma / w if sigma > 0 else 0.0
+        # ledger σ is the round's effective noise-to-sensitivity
+        # ratio σ/w — what the composed curve actually charged
         self.telemetry.set_round_privacy(ridx, eps, acc.delta,
-                                         sigma_eff)
+                                         sigma / w)
         budget = float(getattr(cfg, "dp_epsilon", 0.0) or 0.0)
         if self.alarm_engine is not None and budget > 0:
             self.alarm_engine.check(ridx, {
                 "dp_epsilon": eps,
                 "dp_delta": acc.delta,
-                "dp_sigma": sigma_eff,
+                "dp_sigma": sigma / w,
+                # projection at full sensitivity: future rounds'
+                # staleness weights are unknown, so predict
+                # exhaustion at the conservative weight_scale=1
                 "dp_rounds_left": acc.rounds_left(budget,
                                                   sigma=sigma)})
 

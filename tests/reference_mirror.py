@@ -65,15 +65,23 @@ def np_masked_trimmed_mean(vals, alive, trim_frac):
     return kept / denom
 
 
-def np_robust_fold(cfg, transmits, counts):
+def np_robust_fold(cfg, transmits, counts, capacity=None):
     """Mirror of core/robust.robust_fold over a list of per-client
     transmit arrays (already scaled by batch size) and their
-    datapoint counts. Returns (aggregated, fold_rejection_rate)."""
+    datapoint counts. ``capacity`` is the engine round's padded
+    per-client batch size (needed under --dp sketch, where the fold
+    normalises by the static W·capacity). Returns (aggregated,
+    fold_rejection_rate)."""
     T = np.stack([np.asarray(t, np.float64).ravel() for t in transmits])
     W = T.shape[0]
     n = np.asarray(counts, np.float64)
     alive = n > 0
-    total = max(float(n.sum()), 1.0)
+    if getattr(cfg, "dp", "off") == "sketch":
+        # static capacity denominator (core/robust.py): W·B
+        cap = capacity if capacity is not None else max(n.max(), 1.0)
+        total = float(W) * float(cap)
+    else:
+        total = max(float(n.sum()), 1.0)
     plain = T.sum(axis=0) / total
     g = T / np.maximum(n, 1.0)[:, None]
 
@@ -262,8 +270,9 @@ class MirrorFed:
                 g = g * (cfg.l2_norm_clip / norm)
         if getattr(cfg, "dp", "off") == "sketch":
             # --dp sketch per-client clip (privacy/mechanism.dp_clip):
-            # the shared clip algebra on the per-datapoint-mean dense
-            # gradient, before sketching
+            # the shared clip algebra on the microbatch-accumulated
+            # dense gradient (never divided by batch size), before
+            # sketching — the transmit then scales it by len(y)
             g = g * np_clip_factors(np.linalg.norm(g), cfg.dp_clip)
         if cfg.mode == "sketch":
             # dense pre-sketch transmit: ground truth for the
@@ -381,6 +390,12 @@ class MirrorFed:
         dp_qdq = quantized and dp_on
         if dp_on:
             quantized = False
+            # static W·B capacity denominator (core/rounds.py): each
+            # transmit is bounded by dp_clip·n_i, so only a
+            # data-independent denominator keeps every client's share
+            # within the charged sqrt(r)·C/W sensitivity
+            cap = B if B is not None else max(len(y) for _, _, y in clients)
+            total = float(len(clients)) * float(cap)
         # where the table crosses the wire (mirrors the engine's path
         # split in core/rounds.py): clip / robust paths upload
         # per-client tables, so each transmit is quantized BEFORE the
@@ -396,7 +411,8 @@ class MirrorFed:
         rej = None
         if robust:
             agg, rej = np_robust_fold(
-                self.cfg, transmits, [len(y) for _, _, y in clients])
+                self.cfg, transmits, [len(y) for _, _, y in clients],
+                capacity=B)
         elif quantized:
             agg = np_qdq_table(
                 np.sum(transmits, axis=0), wire).astype(np.float64) \

@@ -355,6 +355,16 @@ def _run_dp_cell(attack, rounds=40):
                    local_batch_size=B, grad_size=d, probe_every=1,
                    on_divergence="log", alarm_byzantine_ratio=2.5,
                    alarm_fold_rejection=0.8, robust_agg="clip",
+                   # DP demands a FIXED clip cap (config.py): the
+                   # auto median-of-norms tau would couple every
+                   # client's scale to the whole cohort. The fold
+                   # norms its per-datapoint table means — here
+                   # sqrt(5)·‖clip(g, 20)‖: the transmit's ×B and
+                   # the mean's /n cancel — which start ≈ 30 and
+                   # decay as the regression converges; 35 sits just
+                   # above, so honest clients never clip, the role
+                   # the adaptive median tau played pre-DP.
+                   robust_clip_norm=35.0,
                    dp="sketch", dp_clip=20.0, dp_noise_mult=0.05)
     assert table_noise_std(cfg) > 0  # the noise leg is really armed
     inj = ChaosInjector(_matrix_chaos(attack), W)

@@ -351,6 +351,110 @@ class TestDPRound:
         assert text(base) != text(dp)
 
 
+class TestCapacityDenominator:
+    """--dp sketch normalises every fold by the STATIC padded
+    capacity W·B: the transmit is the clipped gradient × the real
+    datapoint count n_i, so only a data-independent denominator
+    keeps one client's share of the released mean within the charged
+    sqrt(r)·C/W sensitivity — on padded / mostly-dead rounds AND
+    under staleness weights (which would cancel out of a
+    weighted-total denominator)."""
+
+    def test_mostly_dead_round_uses_capacity_denominator(self):
+        d, B, W = 8, 3, 2
+        base = dataclasses.replace(
+            make_cfg(mode="sketch", error_type="virtual", k=4,
+                     num_rows=5, num_cols=64), grad_size=d)
+        # huge clip (exact no-op) + zero noise isolates the fold
+        # algebra: the DP round differs from dp-off ONLY by the
+        # capacity denominator
+        dp = dataclasses.replace(base, dp="sketch", dp_clip=1e6,
+                                 dp_noise_mult=0.0)
+        rng = np.random.RandomState(5)
+        batch = {"x": jnp.asarray(rng.randn(W, B, d), jnp.float32),
+                 "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+                 "mask": jnp.asarray([[1, 0, 0], [0, 0, 0]],
+                                     jnp.float32)}
+
+        def agg(cfg):
+            fn = jax.jit(build_client_round(cfg, linear_loss, B))
+            res = fn(jnp.zeros(d),
+                     ClientStates.init(cfg, W, jnp.zeros(d)), batch,
+                     jnp.arange(W, dtype=jnp.int32),
+                     jax.random.PRNGKey(0), jnp.float32(0.01))
+            return np.asarray(res.aggregated)
+
+        off, got = agg(base), agg(dp)
+        assert np.linalg.norm(off) > 0
+        # one alive datapoint: dp-off divides by 1, DP divides by
+        # the static W·B capacity
+        np.testing.assert_allclose(got, off / (W * B), rtol=1e-6,
+                                   atol=1e-8)
+
+    def test_full_round_capacity_denominator_is_inert(self):
+        """With every slot full the alive total IS W·B, so the DP
+        round at huge clip / zero noise equals the dp-off round
+        exactly."""
+        d, B, W = 8, 3, 2
+        base = dataclasses.replace(
+            make_cfg(mode="sketch", error_type="virtual", k=4,
+                     num_rows=5, num_cols=64), grad_size=d)
+        dp = dataclasses.replace(base, dp="sketch", dp_clip=1e6,
+                                 dp_noise_mult=0.0)
+        rng = np.random.RandomState(6)
+        batch = {"x": jnp.asarray(rng.randn(W, B, d), jnp.float32),
+                 "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+                 "mask": jnp.ones((W, B), jnp.float32)}
+
+        def agg(cfg):
+            fn = jax.jit(build_client_round(cfg, linear_loss, B))
+            res = fn(jnp.zeros(d),
+                     ClientStates.init(cfg, W, jnp.zeros(d)), batch,
+                     jnp.arange(W, dtype=jnp.int32),
+                     jax.random.PRNGKey(0), jnp.float32(0.01))
+            return np.asarray(res.aggregated)
+
+        np.testing.assert_allclose(agg(dp), agg(base), rtol=1e-6,
+                                   atol=1e-8)
+
+    def test_robust_clip_fold_capacity_and_mirror_matches(self):
+        from reference_mirror import np_robust_fold
+
+        W, B, d = 4, 2, 6
+        base = make_cfg(robust_agg="clip", robust_clip_norm=0.5)
+        dp = dp_cfg(robust_agg="clip", robust_clip_norm=0.5)
+        rng = np.random.RandomState(7)
+        transmit = jnp.asarray(rng.randn(W, d).astype(np.float32))
+        mask = np.zeros((W, B), np.float32)
+        mask[0, 0] = 1.0  # one alive datapoint in a W=4 cohort
+        batch = {"mask": jnp.asarray(mask)}
+        got_base, _ = robust_fold(base, transmit, batch)
+        got_dp, _ = robust_fold(dp, transmit, batch)
+        np.testing.assert_allclose(np.asarray(got_dp),
+                                   np.asarray(got_base) / (W * B),
+                                   rtol=1e-6, atol=1e-8)
+        want, _ = np_robust_fold(dp, [np.asarray(t) for t in
+                                      transmit],
+                                 mask.sum(axis=1), capacity=B)
+        np.testing.assert_allclose(np.asarray(got_dp), want,
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_dp_robust_composition_guards(self):
+        """The accountant's bound only covers folds where a client's
+        influence is its own clipped share: median/trimmed releases
+        and cohort-derived clip caps are refused at config time."""
+        with pytest.raises(AssertionError):
+            dp_cfg(robust_agg="median").validate_runtime()
+        with pytest.raises(AssertionError):
+            dp_cfg(robust_agg="trimmed",
+                   robust_trim_frac=0.2).validate_runtime()
+        with pytest.raises(AssertionError):
+            # auto median-of-norms cap
+            dp_cfg(robust_agg="clip").validate_runtime()
+        ok = dp_cfg(robust_agg="clip", robust_clip_norm=1.0)
+        assert ok.validate_runtime().robust_agg == "clip"
+
+
 def _lin_model(args):
     import flax.linen as nn
 
@@ -469,37 +573,75 @@ class TestRuntimeCharge:
         assert rounds[0]["dp_delta"] == 1e-5
         assert rounds[0]["dp_sigma"] == 0.8
 
-    def test_staleness_weight_derivation(self):
-        """_charge_privacy: w = max fold weight over ALIVE slots =
-        (1+s_min)^(-alpha); a fully-dead round charges w = 1."""
+    def test_async_round_charges_largest_alive_weight(self):
+        """A staleness-weighted round charges weight_scale =
+        (1 + s_min)^{-alpha} over the ALIVE slots only: DP folds
+        normalise by the static W·B capacity (core/rounds.py), so a
+        client's released contribution is cw_i·t_i/(W·B) — genuinely
+        scaled by its fold weight — and the round's worst case is the
+        largest alive weight. Dead slots (including one with the
+        globally smallest staleness) must not set the charge, and the
+        ledger σ is the effective σ/w."""
+        from commefficient_tpu.runtime.fed_model import FedModel
+
+        sigmas = []
+
+        class _Tel:
+            def set_round_privacy(self, ridx, eps, delta, sigma):
+                sigmas.append(sigma)
+
+        fake = SimpleNamespace(
+            _accountant=PrivacyAccountant(1.0, 1.0, 1e-5),
+            telemetry=_Tel(), alarm_engine=None)
+        cfg = SimpleNamespace(dp_noise_mult=1.0,
+                              async_staleness_weight=0.5,
+                              dp_epsilon=0.0)
+        staleness = np.array([3.0, 1.0, 7.0])
+        mask = np.array([[1, 1], [0, 0], [1, 0]], np.float32)
+        FedModel._charge_privacy(fake, 0, cfg, staleness, mask)
+        w = (1.0 + 3.0) ** -0.5  # slot 1 (s=1) is dead: alive min is 3
+        ref = PrivacyAccountant(1.0, 1.0, 1e-5)
+        ref.step(weight_scale=w)
+        assert fake._accountant.epsilon() == ref.epsilon()
+        assert sigmas == [1.0 / w]
+
+    def test_sync_and_dead_rounds_charge_full_sensitivity(self):
+        """No discount without the async driver (staleness is None)
+        and none on a fully-dead fold (pure-noise release; charging 1
+        is conservative)."""
         from commefficient_tpu.runtime.fed_model import FedModel
 
         class _Tel:
             def set_round_privacy(self, *a):
                 pass
 
-        def charge(staleness, mask, alpha=0.5):
-            fake = SimpleNamespace(
-                _accountant=PrivacyAccountant(1.0, 0.5, 1e-5),
-                telemetry=_Tel(), alarm_engine=None)
-            cfg = SimpleNamespace(dp_noise_mult=1.0,
-                                  async_staleness_weight=alpha,
-                                  dp_epsilon=0.0)
-            FedModel._charge_privacy(fake, 0, cfg, staleness, mask)
-            return fake._accountant
+        fake = SimpleNamespace(
+            _accountant=PrivacyAccountant(1.0, 1.0, 1e-5),
+            telemetry=_Tel(), alarm_engine=None)
+        cfg = SimpleNamespace(dp_noise_mult=1.0,
+                              async_staleness_weight=0.5,
+                              dp_epsilon=0.0)
+        FedModel._charge_privacy(fake, 0, cfg)
+        FedModel._charge_privacy(fake, 1, cfg, np.array([2.0, 5.0]),
+                                 np.zeros((2, 3), np.float32))
+        ref = PrivacyAccountant(1.0, 1.0, 1e-5)
+        ref.step()
+        ref.step()
+        assert fake._accountant.epsilon() == ref.epsilon()
 
-        mask = np.ones((2, 4), np.float32)
-        mask[1] = 0.0  # slot 1 dead: its staleness must not count
-        got = charge(np.array([2.0, 5.0]), mask)
-        ref = PrivacyAccountant(1.0, 0.5, 1e-5)
-        ref.step(weight_scale=min((1.0 + 2.0) ** -0.5, 1.0))
-        assert got.epsilon() == ref.epsilon()
-
-        dead = charge(np.array([2.0, 5.0]),
-                      np.zeros((2, 4), np.float32))
-        conservative = PrivacyAccountant(1.0, 0.5, 1e-5)
-        conservative.step(weight_scale=1.0)
-        assert dead.epsilon() == conservative.epsilon()
+    def test_no_subsampling_amplification_credit(self):
+        """FedSampler draws cohorts without replacement until clients
+        exhaust their epoch data — not Poisson — so sample_rate_of
+        claims q = 1 even for a small cohort of a big federation."""
+        assert sample_rate_of(_dp_args(num_clients=1000)) == 1.0
+        assert sample_rate_of(_dp_args()) == 1.0
+        # the accountant built for such a config prices the plain
+        # (unamplified) Gaussian round
+        acc = build_accountant(_dp_args(num_clients=1000))
+        acc.step()
+        ref = PrivacyAccountant(1.0, 1.0, 1e-5)
+        ref.step()
+        assert acc.epsilon() == ref.epsilon()
 
 
 class TestCheckpointContinuity:
