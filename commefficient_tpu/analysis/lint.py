@@ -371,6 +371,50 @@ def _check_chaos_confinement(rel, lines, tree):
     return hits
 
 
+# --- rule: fedservice-confinement --------------------------------------
+
+
+def _is_fedservice_module(modname) -> bool:
+    return bool(modname) and "fedservice" in modname.split(".")
+
+
+def _check_fedservice_confinement(rel, lines, tree):
+    """The multi-tenant daemon (``fedservice/``) sits ON TOP of the
+    runtime — it orchestrates FedModels, it is never a dependency of
+    one. A runtime module importing the service would invert the
+    layering (and let control-plane state leak into the bit-identical
+    single-job data plane), so outside ``fedservice/`` itself no
+    production module may import it or name its entry points.
+    Tests, benches and scripts live outside the scanned package root
+    and drive the daemon freely."""
+    if _top(rel) == "fedservice":
+        return []
+    hits = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if _is_fedservice_module(a.name):
+                    hits.append((node.lineno,
+                                 f"import {a.name} outside "
+                                 "fedservice/ — the daemon is a "
+                                 "top-layer orchestrator"))
+        elif isinstance(node, ast.ImportFrom):
+            if _is_fedservice_module(node.module) or any(
+                    a.name == "fedservice" for a in node.names):
+                src = ("." * node.level) + (node.module or "")
+                hits.append((node.lineno,
+                             f"from {src} import ... pulls in "
+                             "fedservice/ — the daemon is a "
+                             "top-layer orchestrator"))
+        elif isinstance(node, ast.Name) and \
+                node.id in ("FedService", "JobSpec"):
+            hits.append((node.lineno,
+                         f"{node.id} referenced outside fedservice/ "
+                         "— production modules must not depend on "
+                         "the daemon"))
+    return hits
+
+
 # --- rule: arrival-confinement -----------------------------------------
 
 
@@ -694,6 +738,9 @@ ALL_RULES = [
     Rule("arrival-confinement",
          "arrival-process injection outside tests/benches/scripts",
          _check_arrival_confinement),
+    Rule("fedservice-confinement",
+         "fedservice/ daemon imported by a production module",
+         _check_fedservice_confinement),
     Rule("inline-partition-spec",
          "PartitionSpec/NamedSharding built outside parallel/",
          _check_inline_partition_spec),

@@ -420,6 +420,11 @@ class Config:
     # round's max folded staleness (rounds) exceeds this. 0 = off;
     # shares the --on_divergence action.
     alarm_async_staleness: float = 0.0
+    # job_starvation rule (telemetry/alarms.py), evaluated by the
+    # fedservice daemon's own engine: fire when a runnable job has
+    # waited more than this many scheduler ticks since it last ran.
+    # 0 = off; shares the --on_divergence action.
+    alarm_job_starvation: float = 0.0
     # adaptive compression autopilot (commefficient_tpu/autopilot):
     # "on" runs the seeded between-rounds controller that walks the
     # discrete knob lattice (sketch_dtype x k x rows x cols x recall)
@@ -535,6 +540,8 @@ class Config:
             "--async_staleness_weight must be >= 0"
         assert self.alarm_async_staleness >= 0, \
             "--alarm_async_staleness must be >= 0 (0 = rule off)"
+        assert self.alarm_job_starvation >= 0, \
+            "--alarm_job_starvation must be >= 0 (0 = rule off)"
         assert self.autopilot in ("off", "on"), \
             "--autopilot must be off|on"
         assert self.autopilot_cooldown >= 0, \
@@ -1093,6 +1100,13 @@ def build_parser(default_lr: Optional[float] = None,
                         help="async_staleness rule: fire when the "
                         "round's max folded staleness exceeds this "
                         "many rounds (0 = off; action from "
+                        "--on_divergence)")
+    parser.add_argument("--alarm_job_starvation", type=float,
+                        default=0.0,
+                        help="job_starvation rule (fedservice "
+                        "daemon): fire when a runnable job waited "
+                        "more than this many scheduler ticks since "
+                        "it last ran (0 = off; action from "
                         "--on_divergence)")
     parser.add_argument("--autopilot", type=str, default="off",
                         choices=["off", "on"],

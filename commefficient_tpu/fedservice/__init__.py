@@ -1,0 +1,27 @@
+"""Multi-tenant federation service: one long-lived daemon owning one
+elastic pod, multiplexing many independent federated jobs over it.
+
+Everything below the daemon is the ordinary single-job stack — each
+admitted job gets its own :class:`~commefficient_tpu.runtime.fed_model.
+FedModel` (own telemetry ledger shard, own alarm engine, own DP
+accountant, own RNG stream), so a single job driven through the daemon
+is bit-identical to driving the model directly. The daemon adds only
+the control plane on top:
+
+- :class:`JobSpec` manifests + admission control (``FedService.admit``)
+- the scheduler (spatial sub-meshes carved by ``parallel/mesh.py``
+  and/or round-robin time-slicing over the shared pod)
+- per-job isolation (ledger shards, checkpoints, disjoint seeds)
+- fairness observability (occupancy / backlog / starvation probes in
+  the service's own ledger; ``job_starvation`` and
+  ``admission_rejected`` alarm rules)
+
+Importing ``fedservice`` from other ``commefficient_tpu`` modules is a
+lint violation (``fedservice-confinement`` in ``analysis/lint.py``) —
+the service sits ON TOP of the runtime, never underneath it.
+"""
+
+from commefficient_tpu.fedservice.job import AdmissionError, JobSpec
+from commefficient_tpu.fedservice.service import FedService
+
+__all__ = ["AdmissionError", "FedService", "JobSpec"]

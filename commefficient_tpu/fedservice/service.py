@@ -1,0 +1,373 @@
+"""FedService: the long-lived multi-tenant federation daemon.
+
+One service instance owns one pod and runs J admitted jobs over it.
+Each job is the ordinary single-job stack — its own FedModel (own
+ledger shard, alarm engine, DP accountant, RNG stream keyed by its
+own seed) — so the daemon's value-add is purely control-plane:
+admission, scheduling, fairness observability, and elastic migration.
+A single job driven through the daemon is bit-identical (ledger
+records and final server state) to driving the model directly;
+``tests/test_fedservice.py`` and ``scripts/tpu_selftest.py
+service_smoke`` pin that.
+
+Scheduling
+----------
+``policy="fair"`` round-robins: every runnable job steps one round
+per tick. ``policy="backlog"`` greedily steps only the job with the
+largest remaining backlog each tick — deliberately starvable, which
+is what the ``job_starvation`` alarm drill exercises.
+
+Telemetry
+---------
+The service writes its OWN ledger at the base ``cfg.ledger`` path —
+one record per scheduler tick carrying the fairness probes
+(occupancy, backlog, starvation, admission rejections). Job records
+go to ``<ledger>.job<j>.jsonl`` shards (``telemetry.job_ledger_path``)
+that stay byte-equivalent to solo-run ledgers; ``scripts/
+ledger_merge.py`` joins both shard families.
+"""
+
+import dataclasses
+import os
+import tempfile
+
+import numpy as np
+
+from commefficient_tpu.fedservice.job import AdmissionError, JobSpec
+from commefficient_tpu.parallel.mesh import (carve_submeshes,
+                                             mesh_shape_dict)
+from commefficient_tpu.runtime.checkpoint import (RoundAutosaver,
+                                                  load_checkpoint,
+                                                  save_checkpoint)
+from commefficient_tpu.telemetry import (build_telemetry,
+                                         job_ledger_path)
+from commefficient_tpu.telemetry import registry
+from commefficient_tpu.telemetry.alarms import (AlarmEngine,
+                                                DivergenceAbort)
+
+
+class _Job:
+    """Internal per-tenant record: spec + live runtime objects +
+    scheduler bookkeeping. ``mesh`` is the carved sub-mesh (None for
+    time-sliced jobs — their FedModel spans the whole pod and shares
+    it through the jitted-variant cache)."""
+
+    def __init__(self, spec, index, cfg, mesh, devices):
+        self.spec = spec
+        self.index = int(index)
+        self.cfg = cfg          # ledger rewritten to the job shard
+        self.mesh = mesh
+        self.devices = devices  # reserved pod devices (spatial only)
+        self.model = None
+        self.opt = None
+        self.autosaver = None
+        self.rounds_done = 0
+        self.ran_ticks = 0
+        self.starved_ticks = 0
+        self.done = False
+        self.final_state = None
+
+    def backlog(self) -> int:
+        return max(0, int(self.spec.rounds) - self.rounds_done)
+
+
+class FedService:
+    """The daemon. ``cfg`` is the SERVICE's Config — its ``ledger``
+    is the base path the job shards hang off, and its alarm knobs
+    (``--alarm_job_starvation``, ``--on_divergence``) arm the
+    service's own AlarmEngine. Jobs bring their own Configs inside
+    their :class:`JobSpec`.
+
+    ``runs_dir`` (optional) stamps one registry manifest per admitted
+    job (``job_id`` + ``service_run`` lineage keys). ``ckpt_dir``
+    holds migration checkpoints (a tempdir by default).
+    """
+
+    POLICIES = ("fair", "backlog")
+
+    def __init__(self, cfg, *, policy: str = "fair", runs_dir: str = "",
+                 ckpt_dir: str = "", devices=None):
+        assert policy in self.POLICIES, policy
+        import jax
+        self.cfg = cfg
+        self.policy = policy
+        self.runs_dir = runs_dir
+        self._ckpt_dir = ckpt_dir
+        self._devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        self._free = list(self._devices)
+        self._jobs = []
+        self._by_id = {}
+        self._ticks = 0
+        self._admitted = 0
+        self._rejected = 0
+        self.telemetry = build_telemetry(cfg)
+        # constructed directly (not build_alarm_engine) so the
+        # always-armed admission_rejected rule fires even when no
+        # threshold knob is set on the service cfg
+        self.engine = AlarmEngine(cfg, self.telemetry)
+
+    # ------------------------------------------------------------ admission
+
+    def admit(self, spec: JobSpec) -> int:
+        """Validate ``spec`` against the pod and bring the job up.
+
+        Returns the job index ``j`` (its ledger shard is
+        ``<ledger>.job<j>.jsonl``). Raises :class:`AdmissionError`
+        after counting the rejection in the service ledger, so the
+        ``admission_rejected`` alarm fires even when the caller
+        swallows the exception."""
+        try:
+            spec.validate()
+            if str(spec.job_id) in self._by_id:
+                raise AdmissionError(
+                    f"job id {spec.job_id!r} already admitted")
+            for other in self._jobs:
+                if int(other.cfg.seed) == int(spec.cfg.seed):
+                    raise AdmissionError(
+                        f"job {spec.job_id}: seed {spec.cfg.seed} "
+                        f"collides with job {other.spec.job_id!r} — "
+                        "per-job RNG streams must be disjoint")
+            need = spec.demand_devices()
+            if need > len(self._free):
+                raise AdmissionError(
+                    f"job {spec.job_id}: mesh demand "
+                    f"{spec.mesh_demand[0]}x{spec.mesh_demand[1]} "
+                    f"needs {need} devices, pod has "
+                    f"{len(self._free)} free of {len(self._devices)}")
+            if str(getattr(spec.cfg, "dp", "off")) != "off" and \
+                    float(getattr(spec.cfg, "dp_epsilon", 0.0)
+                          or 0.0) <= 0:
+                raise AdmissionError(
+                    f"job {spec.job_id}: DP mode needs a positive "
+                    "epsilon budget for the per-job accountant")
+        except AdmissionError:
+            self._count_rejection()
+            raise
+
+        index = self._admitted
+        self._admitted += 1
+        mesh, devices = None, None
+        if need:
+            devices = self._free[:need]
+            self._free = self._free[need:]
+            mesh = carve_submeshes([spec.mesh_demand],
+                                   devices=devices)[0]
+        base = getattr(self.cfg, "ledger", "") or ""
+        shard = job_ledger_path(base, index) if base else ""
+        cfg = dataclasses.replace(spec.cfg, ledger=shard)
+        job = _Job(spec, index, cfg, mesh, devices)
+        job.model, job.opt = spec.builder(cfg, mesh)
+        if int(getattr(cfg, "checkpoint_every_rounds", 0) or 0) > 0:
+            os.makedirs(cfg.checkpoint_path, exist_ok=True)
+            job.autosaver = RoundAutosaver(
+                cfg, job.model, job.opt, None, None, None,
+                tag=f"job{index}")
+        self._jobs.append(job)
+        self._by_id[str(spec.job_id)] = job
+        if self.runs_dir:
+            registry.write_manifest(
+                self.runs_dir, args=cfg, ledger=shard,
+                mesh_shape=mesh_shape_dict(mesh if mesh is not None
+                                           else job.model.mesh),
+                extra={"job_id": str(spec.job_id),
+                       "service_run": True,
+                       "config_hash": registry.config_hash(cfg)})
+        return index
+
+    def _count_rejection(self):
+        """One service-ledger tick per rejection: the record carries
+        the ``admission_rejected`` probe and the (always-armed) alarm
+        rule flags it. An ``abort`` divergence action is swallowed —
+        the AdmissionError the caller gets IS the abort."""
+        self._rejected += 1
+        t = self._ticks
+        self._ticks += 1
+        probes = {"admission_rejected": 1.0,
+                  "job_active": float(self.active_jobs())}
+        self.telemetry.begin_round(t)
+        self.telemetry.merge_round_probes(t, probes)
+        self.telemetry.set_round_bytes(t, 0, 0)
+        try:
+            self.engine.check(t, probes)
+        except DivergenceAbort:
+            pass
+
+    # ------------------------------------------------------------ plumbing
+
+    def _job(self, job_id) -> _Job:
+        try:
+            return self._by_id[str(job_id)]
+        except KeyError:
+            raise KeyError(f"no admitted job {job_id!r}; have "
+                           f"{sorted(self._by_id)}") from None
+
+    def attach_arrival_process(self, job_id, fn):
+        """Per-job arrival relay: forwards ``fn`` to the job's async
+        driver. (Named ``attach_arrival_process`` on purpose — this
+        is a sanctioned arrival-confinement relay range.)"""
+        self._job(job_id).model.attach_arrival_process(fn)
+
+    def active_jobs(self) -> int:
+        return sum(1 for job in self._jobs if not job.done)
+
+    def job_state(self, job_id):
+        """The job's current (or final) replicated server weights."""
+        job = self._job(job_id)
+        if job.final_state is not None:
+            return job.final_state
+        return np.asarray(job.model.ps_weights)
+
+    def job_rounds(self, job_id) -> int:
+        return self._job(job_id).rounds_done
+
+    # ------------------------------------------------------------ scheduler
+
+    def tick(self):
+        """One scheduler quantum: pick jobs per the policy, step each
+        chosen job one round, then write the fairness record to the
+        service ledger and evaluate the alarm rules on it. Returns
+        the fired alarms (``abort`` raises DivergenceAbort instead)."""
+        runnable = [job for job in self._jobs if not job.done]
+        if not runnable:
+            return []
+        if self.policy == "fair":
+            chosen = list(runnable)
+        else:  # backlog: greedy, deliberately starvable
+            chosen = [max(runnable,
+                          key=lambda j: (j.backlog(), -j.index))]
+        for job in chosen:
+            self._run_round(job)
+        for job in runnable:
+            if job in chosen:
+                job.ran_ticks += 1
+                job.starved_ticks = 0
+            else:
+                job.starved_ticks += 1
+        t = self._ticks
+        self._ticks += 1
+        probes = self._fairness_probes(runnable, chosen)
+        self.telemetry.begin_round(t)
+        self.telemetry.merge_round_probes(t, probes)
+        self.telemetry.set_round_bytes(t, 0, 0)
+        return self.engine.check(t, probes)
+
+    def run(self, max_ticks=None):
+        """Drive ticks until every job drains (or the budget runs
+        out). Returns the number of ticks executed."""
+        n = 0
+        while self.active_jobs() and (max_ticks is None
+                                      or n < max_ticks):
+            self.tick()
+            n += 1
+        return n
+
+    def _run_round(self, job: _Job):
+        batch = job.spec.batch_fn(job.rounds_done)
+        if batch is None:
+            self._finish(job)
+            return
+        job.model(batch)
+        job.opt.step()
+        job.rounds_done += 1
+        if job.autosaver is not None:
+            job.autosaver(0)
+        if job.rounds_done >= int(job.spec.rounds):
+            self._finish(job)
+
+    def _finish(self, job: _Job):
+        if job.done:
+            return
+        job.final_state = np.array(job.model.ps_weights)
+        job.model.finalize()
+        job.done = True
+        if job.devices:
+            self._free.extend(job.devices)
+            job.devices = None
+
+    def _fairness_probes(self, runnable, chosen) -> dict:
+        still = [job for job in runnable if not job.done]
+        probes = {
+            "job_active": float(len(still)),
+            "job_ran": float(len(chosen)),
+            "job_backlog_total": float(sum(j.backlog()
+                                           for j in runnable)),
+            "job_backlog_max": float(max(j.backlog()
+                                         for j in runnable)),
+        }
+        if still:
+            starved = max(still, key=lambda j: j.starved_ticks)
+            probes["job_starved_rounds"] = float(starved.starved_ticks)
+            probes["job_starved_index"] = float(starved.index)
+            occ = [j.ran_ticks / max(1, j.ran_ticks + j.starved_ticks)
+                   for j in still]
+            probes["job_occupancy_min"] = float(min(occ))
+        return probes
+
+    # ------------------------------------------------------------ elasticity
+
+    def migrate(self, job_id, mesh_demand=None):
+        """Elastic migration: checkpoint the job, rebuild its model
+        under a freshly carved mesh (``mesh_demand=(C, M)`` for a new
+        spatial footprint, ``None`` to fall back to time-slicing the
+        whole pod), and restore — the PR 12 topology-free checkpoint
+        format makes the restore bit-exact across mesh shapes. The
+        job's ledger shard survives: the old sink closes before the
+        rebuilt model reopens it, and round ids continue where they
+        left off."""
+        job = self._job(job_id)
+        if job.done:
+            raise ValueError(f"job {job_id!r} already finished")
+        ckpt_dir = self._ckpt_dir or tempfile.mkdtemp(
+            prefix="fedservice_migrate_")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        path = os.path.join(ckpt_dir, f"migrate_job{job.index}.npz")
+        save_checkpoint(path, job.model, job.opt)
+        job.model.finalize()
+        if job.devices:
+            self._free.extend(job.devices)
+            job.devices = None
+        mesh, devices = None, None
+        if mesh_demand is not None:
+            c, m = mesh_demand
+            need = int(c) * int(m)
+            if need > len(self._free):
+                raise AdmissionError(
+                    f"job {job_id}: migration demand {c}x{m} needs "
+                    f"{need} devices, {len(self._free)} free")
+            devices = self._free[:need]
+            self._free = self._free[need:]
+            mesh = carve_submeshes([mesh_demand],
+                                   devices=devices)[0]
+        job.mesh, job.devices = mesh, devices
+        job.model, job.opt = job.spec.builder(job.cfg, mesh)
+        load_checkpoint(path, job.model, job.opt)
+        if job.autosaver is not None:
+            job.autosaver = RoundAutosaver(
+                job.cfg, job.model, job.opt, None, None, None,
+                tag=f"job{job.index}")
+        return job.index
+
+    # ------------------------------------------------------------ teardown
+
+    def close(self):
+        """Drain-free shutdown: finalize still-live jobs, stamp the
+        service meta record, close the service ledger."""
+        for job in self._jobs:
+            if not job.done:
+                job.final_state = np.array(job.model.ps_weights)
+                job.model.finalize()
+                job.done = True
+        self.telemetry.emit_meta(
+            service_jobs=self._admitted,
+            service_policy=self.policy,
+            service_ticks=self._ticks,
+            service_rejected=self._rejected,
+            pod_devices=len(self._devices))
+        self.telemetry.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

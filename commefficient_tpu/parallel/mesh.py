@@ -70,6 +70,34 @@ def make_mesh2d(n_clients: int, n_model: int,
     return Mesh(arr, (CLIENT_AXIS, MODEL_AXIS))
 
 
+def carve_submeshes(demands, devices=None):
+    """Disjoint per-job sub-meshes for the fedservice daemon: carve
+    the pod's device list into consecutive blocks, one ``CxM`` mesh
+    per ``(n_clients, n_model)`` demand, in demand order. The single
+    sanctioned spatial-partitioning constructor — fedservice/ never
+    builds a Mesh itself, so sharding layout (and the
+    inline-partition-spec lint) keeps one owner. Each carved mesh is
+    exactly what ``make_mesh2d(C, M, block)`` builds (``Mx1`` demands
+    therefore behave like the 1-D mesh — see make_mesh2d), so a job
+    admitted to a carved block compiles the same program it would
+    compile on a standalone pod of that shape. Raises ValueError when
+    the demands oversubscribe the pod — admission control surfaces
+    this as a capacity rejection, never a partial carve."""
+    devices = list(devices) if devices is not None else jax.devices()
+    need = sum(int(c) * int(m) for c, m in demands)
+    if need > len(devices):
+        raise ValueError(
+            f"sub-mesh demands need {need} devices "
+            f"({[f'{c}x{m}' for c, m in demands]}), "
+            f"have {len(devices)}")
+    out, off = [], 0
+    for c, m in demands:
+        c, m = int(c), int(m)
+        out.append(make_mesh2d(c, m, devices[off:off + c * m]))
+        off += c * m
+    return out
+
+
 def client_axis_size(mesh: Mesh) -> int:
     """Devices along ``clients`` — the divisor for batch sharding and
     client-state padding (NOT ``mesh.devices.size``, which overcounts

@@ -24,7 +24,8 @@ from commefficient_tpu.telemetry.record import (LEDGER_SCHEMA_VERSION,
                                                 validate_record)
 from commefficient_tpu.telemetry.sinks import (ConsoleSink, JSONLSink,
                                                TensorBoardSink,
-                                               append_bench_record)
+                                               append_bench_record,
+                                               job_ledger_path)
 
 __all__ = [
     "clock",
@@ -43,4 +44,5 @@ __all__ = [
     "JSONLSink",
     "TensorBoardSink",
     "append_bench_record",
+    "job_ledger_path",
 ]

@@ -64,6 +64,21 @@ round instead of silently training on garbage. Three rules:
                        pre-charge projection, 0 when already over) so
                        the ledger names the predicted exhaustion
                        round.
+``job_starvation``   — fedservice daemon health (fedservice/): a
+                       runnable job waited more than
+                       ``--alarm_job_starvation`` scheduler ticks
+                       since it last ran. Fired by the daemon's OWN
+                       alarm engine against its fairness probes (the
+                       per-job engines never see other jobs), so a
+                       greedy scheduling policy that starves a tenant
+                       fails loudly instead of silently serving one
+                       job's traffic.
+``admission_rejected`` — a JobSpec was refused at admission (capacity,
+                       duplicate id/seed — the ``admission_rejected``
+                       probe counts this tick's refusals). Always
+                       armed on the daemon's engine, like ``nan_inf``:
+                       a rejected manifest is an operator-visible
+                       event whatever the thresholds say.
 ``collective_skew``  — trace-derived (schema-v4 ``device_time``): a
                        profiled round's straggler wait dominates its
                        collective bucket — max cross-device
@@ -139,6 +154,8 @@ class AlarmEngine:
             getattr(cfg, "alarm_fold_rejection", 0.0) or 0.0)
         self.async_staleness = float(
             getattr(cfg, "alarm_async_staleness", 0.0) or 0.0)
+        self.job_starvation = float(
+            getattr(cfg, "alarm_job_starvation", 0.0) or 0.0)
         self.privacy_budget = (
             float(getattr(cfg, "dp_epsilon", 0.0) or 0.0)
             if str(getattr(cfg, "dp", "off")) != "off" else 0.0)
@@ -215,6 +232,23 @@ class AlarmEngine:
                     "buffer_occupancy": probes.get(
                         "async_buffer_occupancy"),
                     "backlog": probes.get("async_backlog")})
+
+        if self.job_starvation > 0:
+            waited = probes.get("job_starved_rounds")
+            if waited is not None and (not _finite(waited)
+                                       or waited > self.job_starvation):
+                fired.append({
+                    "rule": "job_starvation",
+                    "value": float(waited),
+                    "threshold": self.job_starvation,
+                    "job": probes.get("job_starved_index"),
+                    "occupancy": probes.get("job_occupancy_min")})
+
+        rejected = probes.get("admission_rejected")
+        if rejected is not None and float(rejected) > 0:
+            fired.append({"rule": "admission_rejected",
+                          "value": float(rejected),
+                          "threshold": 0.0})
 
         if self.privacy_budget > 0:
             eps = probes.get("dp_epsilon")
@@ -311,6 +345,8 @@ def build_alarm_engine(cfg, telemetry=None):
             or float(getattr(cfg, "alarm_fold_rejection", 0.0)
                      or 0.0) > 0
             or float(getattr(cfg, "alarm_async_staleness", 0.0)
+                     or 0.0) > 0
+            or float(getattr(cfg, "alarm_job_starvation", 0.0)
                      or 0.0) > 0
             or (str(getattr(cfg, "dp", "off")) != "off"
                 and float(getattr(cfg, "dp_epsilon", 0.0) or 0.0)

@@ -239,10 +239,26 @@ def privacy_suffix(dp_epsilon) -> str:
     return f"p{float(dp_epsilon):g}"
 
 
+def service_suffix(service_jobs) -> str:
+    """Canonical key fragment for a multi-tenant fedservice run:
+    ``j<J>`` when the daemon multiplexed J >= 2 jobs over the pod,
+    ``""`` for solo runs — a single job through the daemon is
+    bit-identical to driving the model directly (the fedservice
+    parity contract), so it honestly keeps the bare key. A J-job
+    run's wall profile interleaves J independent round programs (plus
+    the scheduler's switching cost), which no single-job pin
+    measured — and a 2-job and a 3-job pod are different experiments
+    too. Like the wire/async/overlap/band/privacy fragments there is
+    NO fallback in either direction: a j3 ledger must never resolve
+    (or overwrite) a solo pin, nor a j2 one."""
+    j = int(service_jobs or 0)
+    return f"j{j}" if j > 1 else ""
+
+
 def topology_key(device_count=None, process_count=None,
                  mesh_shape=None, wire_dtype=None,
                  async_k=None, overlap_depth=None, band=None,
-                 dp_epsilon=None) -> str:
+                 dp_epsilon=None, service_jobs=None) -> str:
     """Baseline entry key for one topology point. ``d<D>p<P>`` when
     both counts are known — suffixed ``m<C>x<M>`` for 2D-mesh runs
     (a 4x2 and an 8x1 run on the same 8 chips are different programs,
@@ -257,18 +273,21 @@ def topology_key(device_count=None, process_count=None,
     noise is a different experiment from the noiseless program) —
     :data:`ANY_TOPOLOGY` otherwise: unknown
     topologies form their own bucket rather than silently matching a
-    counted one. Quantized/async/overlapped/banded/private runs with
-    unknown counts still split off (``any-q<dtype>``, ``any-a<K>``,
-    ``any-o<N>``, ``any-b<lo-hi>``, ``any-p<eps>``)."""
+    counted one. Quantized/async/overlapped/banded/private/
+    multi-tenant runs with unknown counts still split off
+    (``any-q<dtype>``, ``any-a<K>``, ``any-o<N>``, ``any-b<lo-hi>``,
+    ``any-p<eps>``, ``any-j<J>``)."""
     if device_count is None or process_count is None:
         w = (wire_suffix(wire_dtype) + async_suffix(async_k)
              + overlap_suffix(overlap_depth) + band_suffix(band)
-             + privacy_suffix(dp_epsilon))
+             + privacy_suffix(dp_epsilon)
+             + service_suffix(service_jobs))
         return f"{ANY_TOPOLOGY}-{w}" if w else ANY_TOPOLOGY
     return (f"d{int(device_count)}p{int(process_count)}"
             f"{mesh_suffix(mesh_shape)}{wire_suffix(wire_dtype)}"
             f"{async_suffix(async_k)}{overlap_suffix(overlap_depth)}"
-            f"{band_suffix(band)}{privacy_suffix(dp_epsilon)}")
+            f"{band_suffix(band)}{privacy_suffix(dp_epsilon)}"
+            f"{service_suffix(service_jobs)}")
 
 
 def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
@@ -276,7 +295,7 @@ def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                         config_hash: str = "", mesh_shape=None,
                         wire_dtype=None, async_k=None,
                         overlap_depth=None, band=None,
-                        dp_epsilon=None) -> Dict:
+                        dp_epsilon=None, service_jobs=None) -> Dict:
     entry = {"ts": clock.wall(), "source": source, "metrics": metrics}
     if device_count is not None:
         entry["device_count"] = int(device_count)
@@ -299,6 +318,8 @@ def make_topology_entry(metrics: Dict[str, Dict], *, source: str = "",
                                    else list(band))
     if privacy_suffix(dp_epsilon):
         entry["dp_epsilon"] = float(dp_epsilon)
+    if service_suffix(service_jobs):
+        entry["service_jobs"] = int(service_jobs)
     return entry
 
 
@@ -307,18 +328,20 @@ def make_baseline(metrics: Dict[str, Dict], *, source: str = "",
                   process_count=None, config_hash: str = "",
                   mesh_shape=None, wire_dtype=None,
                   async_k=None, overlap_depth=None,
-                  band=None, dp_epsilon=None) -> Dict:
+                  band=None, dp_epsilon=None,
+                  service_jobs=None) -> Dict:
     """A fresh schema-2 baseline holding one topology entry."""
     key = topology_key(device_count, process_count, mesh_shape,
                        wire_dtype, async_k, overlap_depth, band,
-                       dp_epsilon)
+                       dp_epsilon, service_jobs)
     base = {"schema": BASELINE_SCHEMA, "ts": clock.wall(),
             "topologies": {key: make_topology_entry(
                 metrics, source=source, device_count=device_count,
                 process_count=process_count, config_hash=config_hash,
                 mesh_shape=mesh_shape, wire_dtype=wire_dtype,
                 async_k=async_k, overlap_depth=overlap_depth,
-                band=band, dp_epsilon=dp_epsilon)}}
+                band=band, dp_epsilon=dp_epsilon,
+                service_jobs=service_jobs)}}
     if extra:
         base.update(extra)
     return base
@@ -343,7 +366,8 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
                     process_count=None, config_hash: str = "",
                     mesh_shape=None, wire_dtype=None,
                     async_k=None, overlap_depth=None,
-                    band=None, dp_epsilon=None) -> Dict:
+                    band=None, dp_epsilon=None,
+                    service_jobs=None) -> Dict:
     """Insert/replace ONE topology's entry, leaving every other
     topology point untouched — how the gate CLI re-captures the
     8-device headline without disturbing the single-chip one.
@@ -354,13 +378,13 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
     base["topologies"] = dict(base.get("topologies", {}))
     key = topology_key(device_count, process_count, mesh_shape,
                        wire_dtype, async_k, overlap_depth, band,
-                       dp_epsilon)
+                       dp_epsilon, service_jobs)
     base["topologies"][key] = make_topology_entry(
         metrics, source=source, device_count=device_count,
         process_count=process_count, config_hash=config_hash,
         mesh_shape=mesh_shape, wire_dtype=wire_dtype,
         async_k=async_k, overlap_depth=overlap_depth, band=band,
-        dp_epsilon=dp_epsilon)
+        dp_epsilon=dp_epsilon, service_jobs=service_jobs)
     base["ts"] = clock.wall()
     return base
 
@@ -368,7 +392,8 @@ def update_baseline(baseline: Dict, metrics: Dict[str, Dict], *,
 def baseline_entry(baseline: Dict, device_count=None,
                    process_count=None, mesh_shape=None,
                    wire_dtype=None, async_k=None,
-                   overlap_depth=None, band=None, dp_epsilon=None):
+                   overlap_depth=None, band=None, dp_epsilon=None,
+                   service_jobs=None):
     """The topology entry ``compare`` gates against, or None when the
     baseline has no entry for this topology. A 2D-mesh run resolves
     its exact ``d<D>p<P>m<C>x<M>`` entry first and falls back to the
@@ -395,20 +420,23 @@ def baseline_entry(baseline: Dict, device_count=None,
     entry = topologies.get(
         topology_key(device_count, process_count, mesh_shape,
                      wire_dtype, async_k, overlap_depth, band,
-                     dp_epsilon))
+                     dp_epsilon, service_jobs))
     if entry is None and mesh_suffix(mesh_shape):
-        # drop only the mesh fragment; the wire, async, overlap, band
-        # AND privacy fragments stay — there is no cross-dtype,
-        # cross-mode, cross-depth, cross-band or cross-budget fallback
-        # (an o2 pipelined round has a different collective schedule
-        # than the serial o1 program; a b0.2-0.6 autopilot walk mixes
-        # programs no static pin measured; a p3.5 run's probes carry
-        # calibrated noise no noiseless pin ever saw)
+        # drop only the mesh fragment; the wire, async, overlap, band,
+        # privacy AND service fragments stay — there is no
+        # cross-dtype, cross-mode, cross-depth, cross-band,
+        # cross-budget or cross-J fallback (an o2 pipelined round has
+        # a different collective schedule than the serial o1 program;
+        # a b0.2-0.6 autopilot walk mixes programs no static pin
+        # measured; a p3.5 run's probes carry calibrated noise no
+        # noiseless pin ever saw; a j3 pod interleaves three round
+        # programs no solo pin ever dispatched)
         entry = topologies.get(
             topology_key(device_count, process_count,
                          wire_dtype=wire_dtype, async_k=async_k,
                          overlap_depth=overlap_depth, band=band,
-                         dp_epsilon=dp_epsilon))
+                         dp_epsilon=dp_epsilon,
+                         service_jobs=service_jobs))
     return entry
 
 
@@ -422,7 +450,8 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
             mad_k: float = MAD_K, device_count=None,
             process_count=None, mesh_shape=None,
             wire_dtype=None, async_k=None,
-            overlap_depth=None, band=None, dp_epsilon=None) -> Dict:
+            overlap_depth=None, band=None, dp_epsilon=None,
+            service_jobs=None) -> Dict:
     """Gate ``metrics`` against ``baseline``'s entry for this
     topology. Returns::
 
@@ -437,10 +466,11 @@ def compare(baseline: Dict, metrics: Dict[str, Dict],
     topology point must fail loudly, not pass silently."""
     key = topology_key(device_count, process_count, mesh_shape,
                        wire_dtype, async_k, overlap_depth, band,
-                       dp_epsilon)
+                       dp_epsilon, service_jobs)
     entry = baseline_entry(baseline, device_count, process_count,
                            mesh_shape, wire_dtype, async_k,
-                           overlap_depth, band, dp_epsilon)
+                           overlap_depth, band, dp_epsilon,
+                           service_jobs)
     if entry is None:
         have = ", ".join(sorted(baseline.get("topologies", {}))) \
             or "none"

@@ -176,6 +176,27 @@ def run_dp_epsilon(manifest: dict):
     return float(cfg.get("dp_epsilon") or 0.0)
 
 
+def run_service_jobs(manifest: dict):
+    """The number of jobs a fedservice daemon multiplexed for this
+    run (``service_jobs``, stamped by the service/bench manifest
+    writer), or None for solo / pre-service manifests — and for
+    single-job daemon runs, which are bit-identical to the direct
+    path and honestly share its key (telemetry/gate.py
+    service_suffix)."""
+    j = int(manifest.get("service_jobs") or 0)
+    return j if j > 1 else None
+
+
+def run_job_id(manifest: dict):
+    """The job this manifest describes inside a fedservice daemon
+    (``job_id``, stamped at admission), or None for non-service
+    manifests. The job lineage key: ``latest_ledgers(job=...)``
+    filters on it, so each tenant's run chain is navigable without
+    grepping the shared runs/ directory."""
+    job = manifest.get("job_id")
+    return str(job) if job is not None else None
+
+
 def run_segments(manifest: dict) -> list:
     """The run's per-topology segments (``topology_segments``, stamped
     by the trainers from checkpoint lineage for resumed runs). Empty
@@ -215,14 +236,18 @@ def run_key(manifest: dict) -> tuple:
     an 8x1 program on the same chips — or an int8 and an f32 wire, or
     a buffered and a barrier round, or a depth-2 pipelined and a
     serial round, or a knob walk and a static program, or a noised
-    table and a noiseless one — are different experiments); 1-D f32
-    synchronous serial static noiseless runs keep the historical
+    table and a noiseless one — are different experiments) and
+    multi-tenant fedservice runs their ``j<J>`` fragment (a pod
+    interleaving J round programs is a different experiment from
+    any solo run); 1-D f32
+    synchronous serial static noiseless solo runs keep the historical
     3-tuple, so old manifests stay comparable to each other."""
     from commefficient_tpu.telemetry.gate import (async_suffix,
                                                   band_suffix,
                                                   mesh_suffix,
                                                   overlap_suffix,
                                                   privacy_suffix,
+                                                  service_suffix,
                                                   wire_suffix)
     key = (manifest.get("config_hash") or "",) + run_topology(manifest)
     suffix = (mesh_suffix(run_mesh_shape(manifest))
@@ -230,7 +255,8 @@ def run_key(manifest: dict) -> tuple:
               + async_suffix(run_async_k(manifest))
               + overlap_suffix(run_overlap_depth(manifest))
               + band_suffix(run_band(manifest))
-              + privacy_suffix(run_dp_epsilon(manifest)))
+              + privacy_suffix(run_dp_epsilon(manifest))
+              + service_suffix(run_service_jobs(manifest)))
     return key + (suffix,) if suffix else key
 
 
@@ -332,19 +358,24 @@ def list_manifests(runs_dir: str = "runs") -> list:
 
 
 def latest_ledgers(runs_dir: str = "runs", n: int = 2,
-                   key: tuple = None) -> list:
+                   key: tuple = None, job: str = None) -> list:
     """The newest ``n`` manifests whose ledger file still exists,
     newest FIRST: [(manifest_path, manifest, ledger_path), ...].
 
     ``key`` (a ``run_key`` tuple) restricts hits to comparable runs —
     the report/gate pass the newest run's key so "latest vs previous"
-    never pairs different configs or topologies."""
+    never pairs different configs or topologies. ``job`` restricts
+    hits to one fedservice tenant's lineage (manifests whose
+    ``job_id`` matches), so a shared runs/ directory answers "this
+    job's latest ledger" without pairing two tenants' runs."""
     hits = []
     for path, rec in reversed(list_manifests(runs_dir)):
         ledger = rec.get("ledger") or ""
         if not (ledger and os.path.exists(ledger)):
             continue
         if key is not None and run_key(rec) != tuple(key):
+            continue
+        if job is not None and run_job_id(rec) != str(job):
             continue
         hits.append((path, rec, ledger))
         if len(hits) >= n:

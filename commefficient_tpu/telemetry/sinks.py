@@ -27,6 +27,18 @@ def shard_ledger_path(path: str, process_index: int) -> str:
     return path if k == 0 else f"{path}.p{k}.jsonl"
 
 
+def job_ledger_path(path: str, job_index: int) -> str:
+    """Per-job ledger path under a fedservice daemon: job ``j``'s
+    records go to the ``<path>.job<j>.jsonl`` shard that
+    ``scripts/ledger_merge.py`` joins next to the ``.p<k>`` process
+    shards. Namespacing by job index (like ``shard_ledger_path`` does
+    by process index) keeps J concurrent jobs pointed at one
+    ``--ledger`` from ever interleaving writes into one file — the
+    shard file IS the job identity, so the records themselves stay
+    byte-identical to a solo run's."""
+    return f"{path}.job{int(job_index)}.jsonl"
+
+
 def recover_torn_tail(path: str) -> int:
     """Truncate a JSONL file's torn last line in place, if any.
 
@@ -98,15 +110,37 @@ class JSONLSink:
     ``last_round_index(path)`` to keep ledger round ids monotone and
     deduplicated across a crash/resume cycle)."""
 
+    #: absolute path -> the sink currently holding it in this process —
+    #: a second writer on the same file would interleave its records
+    #: between the first writer's write() calls, producing a ledger
+    #: no reader can attribute (and, under two flush cadences, torn
+    #: half-lines). Refusing at open time turns the silent corruption
+    #: into an immediate error; close() releases the claim. A
+    #: registered sink whose underlying file handle is already closed
+    #: is a *dead* writer (crash/resume path) — it can never write
+    #: again, so its claim is evicted rather than honoured.
+    _live = {}
+
     def __init__(self, path: str, process=None, resume_after=None):
         self.path = path
         self.process = None if process is None else int(process)
         self.resume_after = (None if resume_after is None
                              else int(resume_after))
-        parent = os.path.dirname(os.path.abspath(path))
+        abspath = os.path.abspath(path)
+        prior = JSONLSink._live.get(abspath)
+        if prior is not None and prior._f is not None \
+                and not prior._f.closed:
+            raise RuntimeError(
+                f"ledger {path} already has a live JSONLSink in this "
+                "process — two writers on one path would interleave "
+                "torn records. Close the first sink, or shard the "
+                "path (shard_ledger_path / job_ledger_path)")
+        parent = os.path.dirname(abspath)
         os.makedirs(parent, exist_ok=True)
         recover_torn_tail(path)
         self._f = open(path, "a")
+        self._abspath = abspath
+        JSONLSink._live[abspath] = self
 
     def write(self, rec):
         if self.resume_after is not None \
@@ -125,6 +159,8 @@ class JSONLSink:
         if self._f is not None:
             self._f.close()
             self._f = None
+            if JSONLSink._live.get(self._abspath) is self:
+                del JSONLSink._live[self._abspath]
 
 
 def _json_default(obj):

@@ -22,6 +22,15 @@ it traced) its own device_time. The merge joins shards on round id:
 * shard meta/bench/epoch records are dropped (the canonical copies
   are authoritative); the count is reported.
 
+Per-JOB shards from a fedservice daemon run —
+``runs/a.jsonl.job<j>.jsonl``, one solo-equivalent ledger per tenant
+(telemetry/sinks.py ``job_ledger_path``) — are discovered alongside
+the ``.p<k>`` process shards. Unlike process shards, job rounds are
+INDEPENDENT round streams (round 3 of job 0 and round 3 of job 1 are
+different rounds), so they cannot join on round id: every job record
+is instead appended after the canonical stream stamped with
+``"job": j``, rounds in order within each job.
+
 ``scripts/telemetry_report.py`` renders merged ledgers with a
 per-shard summary block. Pure host-side JSON work: no jax import.
 """
@@ -58,6 +67,35 @@ def discover_shards(path: str) -> list:
         if m:
             hits.append((int(m.group(1)), shard))
     return sorted(hits)
+
+
+def discover_job_shards(path: str) -> list:
+    """[(job_index, shard_path), ...] for a fedservice base ledger
+    path, sorted by job index (telemetry/sinks.py job_ledger_path
+    layout)."""
+    hits = []
+    for shard in glob.glob(glob.escape(path) + ".job*.jsonl"):
+        m = re.match(re.escape(path) + r"\.job(\d+)\.jsonl$", shard)
+        if m:
+            hits.append((int(m.group(1)), shard))
+    return sorted(hits)
+
+
+def merge_job_shards(merged, job_records: dict) -> tuple:
+    """Append per-job shard records to a merged stream, each stamped
+    ``"job": j``. ``job_records``: {job_index: [records, ...]}.
+    Returns (records, stats)."""
+    out = list(merged)
+    appended = 0
+    for j, records in sorted(job_records.items()):
+        for rec in records:
+            rec = dict(rec)
+            rec["job"] = int(j)
+            out.append(rec)
+            appended += 1
+    stats = {"job_records": appended,
+             "jobs": sorted(int(j) for j in job_records)}
+    return out, stats
 
 
 def load_records(path: str) -> tuple:
@@ -177,22 +215,32 @@ def main(argv=None) -> int:
         recs, probs = load_records(spath)
         shard_records[k] = recs
         problems.extend(probs)
+    job_shards = discover_job_shards(args.ledger)
+    job_records = {}
+    for j, jpath in job_shards:
+        recs, probs = load_records(jpath)
+        job_records[j] = recs
+        problems.extend(probs)
     for p in problems:
         print(f"WARNING {p}", file=sys.stderr)
-    if not shards:
-        print(f"{args.ledger}: no shards found "
-              f"(expected {args.ledger}.p<k>.jsonl) — nothing to merge")
+    if not shards and not job_shards:
+        print(f"{args.ledger}: no shards found (expected "
+              f"{args.ledger}.p<k>.jsonl or .job<j>.jsonl) — "
+              "nothing to merge")
         return 1
 
     merged, stats = merge_ledgers(canonical, shard_records)
+    merged, job_stats = merge_job_shards(merged, job_records)
     out = args.out or (args.ledger + MERGED_SUFFIX)
     with open(out, "w") as f:
         for rec in merged:
             json.dump(rec, f, separators=(",", ":"))
             f.write("\n")
-    print(f"{args.ledger} + shards p{stats['shards']}: "
+    print(f"{args.ledger} + shards p{stats['shards']} "
+          f"+ jobs {job_stats['jobs']}: "
           f"{stats['joined_rounds']} round(s) joined, "
           f"{stats['shard_only_rounds']} shard-only, "
+          f"{job_stats['job_records']} job record(s) appended, "
           f"{stats['dropped_shard_records']} non-round shard "
           f"record(s) dropped -> {out}")
     return 0

@@ -67,7 +67,8 @@ def load_ledger_records(path):
 def resolve_topology(manifest=None, records=(), device_count=None,
                      process_count=None, mesh_shape=None,
                      wire_dtype=None, async_k=None,
-                     overlap_depth=None, band=None, dp_epsilon=None):
+                     overlap_depth=None, band=None, dp_epsilon=None,
+                     service_jobs=None):
     """The run's (device_count, process_count, mesh_shape,
     wire_dtype, async_k, overlap_depth) for baseline keying: CLI
     overrides win, then the run manifest, then the ledger's meta
@@ -97,7 +98,13 @@ def resolve_topology(manifest=None, records=(), device_count=None,
     ``p<eps>`` fragment) and a DP run with an unlimited budget keys
     ``p0``. A budget never falls back across budgets or to the
     noiseless pin: the calibrated table noise changes what the
-    recovery probes measure."""
+    recovery probes measure. ``service_jobs`` likewise: a CLI int,
+    the manifest's ``service_jobs`` stamp, the ledger meta record's
+    ``service_jobs``; solo runs AND single-job daemon runs resolve to
+    None (the daemon is bit-identical to the direct path at J=1, so
+    the bare key is honest). A ``j<J>`` entry never falls back across
+    J: a 3-tenant pod's aggregate throughput says nothing about a
+    5-tenant one."""
     dc, pc = device_count, process_count
     ms = parse_mesh_shape(mesh_shape)
     wd = wire_dtype
@@ -105,6 +112,7 @@ def resolve_topology(manifest=None, records=(), device_count=None,
     od = overlap_depth
     bd = band
     de = dp_epsilon
+    sj = service_jobs
     if manifest is not None:
         mdc, mpc = registry.run_topology(manifest)
         dc = mdc if dc is None else dc
@@ -121,6 +129,8 @@ def resolve_topology(manifest=None, records=(), device_count=None,
             bd = registry.run_band(manifest)
         if de is None:
             de = registry.run_dp_epsilon(manifest)
+        if sj is None:
+            sj = registry.run_service_jobs(manifest)
     if dc is None or pc is None or ms is None or wd is None \
             or ak is None or od is None or bd is None \
             or de is None:
@@ -154,6 +164,8 @@ def resolve_topology(manifest=None, records=(), device_count=None,
                 # the noiseless pin
                 eps = plan["dp"].get("epsilon_budget")
                 de = float(eps) if eps is not None else 0.0
+            if sj is None and rec.get("service_jobs") is not None:
+                sj = int(rec["service_jobs"])
             if (dc is not None and pc is not None
                     and ms is not None and wd is not None
                     and ak is not None and od is not None
@@ -167,7 +179,9 @@ def resolve_topology(manifest=None, records=(), device_count=None,
         od = None  # serial rounds keep the historical key
     if not bd:
         bd = None  # static-knob runs keep the unbanded key
-    return dc, pc, ms, wd, ak, od, bd, de
+    if not sj or int(sj) <= 1:
+        sj = None  # solo / single-job-daemon runs keep the bare key
+    return dc, pc, ms, wd, ak, od, bd, de, sj
 
 
 def parse_mesh_shape(mesh_shape):
@@ -254,6 +268,12 @@ def main(argv=None):
                          "key, a DP run with no budget cap keys p0). "
                          "Private entries NEVER gate against another "
                          "budget or a noiseless pin.")
+    ap.add_argument("--service_jobs", type=int, default=None,
+                    help="override the run's fedservice tenant count "
+                         "for baseline keying (normally read from "
+                         "the manifest / ledger meta; solo and "
+                         "single-job daemon runs keep the bare key). "
+                         "j<J> entries NEVER gate across J.")
     args = ap.parse_args(argv)
 
     ledger = args.ledger
@@ -269,7 +289,7 @@ def main(argv=None):
         print(f"run: {mpath} (config {manifest.get('config_hash', '')[:8]}, "
               f"git {manifest.get('git_sha', '')[:8]}, "
               f"topology "
-              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest), registry.run_overlap_depth(manifest), registry.run_band(manifest), registry.run_dp_epsilon(manifest))}"
+              f"{gate.topology_key(dc, pc, registry.run_mesh_shape(manifest), registry.run_wire_dtype(manifest), registry.run_async_k(manifest), registry.run_overlap_depth(manifest), registry.run_band(manifest), registry.run_dp_epsilon(manifest), registry.run_service_jobs(manifest))}"
               f") -> {ledger}")
     if ledger is None:
         ap.error("one of --ledger / --runs_dir is required")
@@ -279,11 +299,12 @@ def main(argv=None):
     if not metrics:
         print(f"{ledger}: no gateable metrics (empty ledger?)")
         return 1
-    dc, pc, ms, wd, ak, od, bd, de = resolve_topology(
+    dc, pc, ms, wd, ak, od, bd, de, sj = resolve_topology(
         manifest, records, args.device_count, args.process_count,
         args.mesh_shape, args.wire_dtype, args.async_k,
-        args.overlap_depth, args.band, args.dp_epsilon)
-    topo = gate.topology_key(dc, pc, ms, wd, ak, od, bd, de)
+        args.overlap_depth, args.band, args.dp_epsilon,
+        args.service_jobs)
+    topo = gate.topology_key(dc, pc, ms, wd, ak, od, bd, de, sj)
     print(f"{ledger}: {len(metrics)} metric(s) extracted "
           f"(topology {topo})")
     chash = (manifest or {}).get("config_hash", "")
@@ -298,7 +319,7 @@ def main(argv=None):
             gate.topology_key(s.get("device_count"),
                               s.get("process_count"),
                               s.get("mesh_shape"), wd, ak, od, bd,
-                              de)
+                              de, sj)
             for s in segs)
         print(f"perf gate: REFUSED — run resumed across a mid-run "
               f"topology change ({len(segs)} segments: {chain}); its "
@@ -324,7 +345,7 @@ def main(argv=None):
             return 1
         existing = gate.load_baseline(gate_path)
         entry = gate.baseline_entry(existing, dc, pc, ms, wd, ak, od,
-                                    bd, de)
+                                    bd, de, sj)
         if entry is None and args.write_baseline and not args.check:
             # first capture of a NEW topology point: nothing to gate
             # this run against, other points stay untouched
@@ -348,7 +369,8 @@ def main(argv=None):
                                    device_count=dc, process_count=pc,
                                    mesh_shape=ms, wire_dtype=wd,
                                    async_k=ak, overlap_depth=od,
-                                   band=bd, dp_epsilon=de)
+                                   band=bd, dp_epsilon=de,
+                                   service_jobs=sj)
             print(gate.render_verdict(verdict))
 
     if args.write_baseline:
@@ -367,7 +389,7 @@ def main(argv=None):
                                  config_hash=chash, mesh_shape=ms,
                                  wire_dtype=wd, async_k=ak,
                                  overlap_depth=od, band=bd,
-                                 dp_epsilon=de),
+                                 dp_epsilon=de, service_jobs=sj),
             args.write_baseline)
         print(f"baseline[{topo}] -> {args.write_baseline}")
 

@@ -64,6 +64,33 @@ def load_ledger(path):
     return records, problems
 
 
+def job_summaries(records, ledger_path=None) -> dict:
+    """Per-job summaries for a fedservice daemon run:
+    ``{job_index: summary}``. Job records come from ``"job": j``
+    stamps in a merged ledger (scripts/ledger_merge.py), else from
+    the ``<ledger>.job<j>.jsonl`` shards living next to
+    ``ledger_path`` (telemetry/sinks.py job_ledger_path layout)."""
+    import glob
+    import re
+
+    by_job = {}
+    for r in records:
+        j = r.get("job")
+        if isinstance(j, int):
+            by_job.setdefault(j, []).append(r)
+    if not by_job and ledger_path:
+        pat = re.compile(re.escape(ledger_path)
+                         + r"\.job(\d+)\.jsonl$")
+        for shard in glob.glob(glob.escape(ledger_path)
+                               + ".job*.jsonl"):
+            m = pat.match(shard)
+            if m:
+                recs, _ = load_ledger(shard)
+                by_job[int(m.group(1))] = recs
+    return {j: summarize(recs)
+            for j, recs in sorted(by_job.items())}
+
+
 def _pct(sorted_vals, q):
     """Nearest-rank percentile of an already-sorted list."""
     if not sorted_vals:
@@ -393,6 +420,14 @@ def render_summary(s, label="") -> str:
                if sh.get("host_rss_peak_bytes") is not None else "")
         lines.append(f"  shard {pk}: {sh['rounds']} rounds, spans "
                      f"total {sh['span_total_s']} s{gap}{rss}")
+    # fedservice daemon runs: one solo-equivalent block per tenant
+    for jk, js in (s.get("jobs") or {}).items():
+        alarms = sum(len(a.get("alarms") or ())
+                     for a in js.get("alarm_rounds") or ())
+        lines.append(
+            f"  job {jk}: {js['rounds']} rounds, uplink "
+            f"{_mib(js['uplink_bytes'])}, downlink "
+            f"{_mib(js['downlink_bytes'])}, {alarms} alarm(s)")
     cm = s.get("cost_model")
     if cm:
         lines.append(
@@ -825,9 +860,16 @@ def main(argv=None):
     records, problems = load_ledger(args.ledger)
     for p in problems:
         print(f"WARNING {args.ledger}: {p}", file=sys.stderr)
+    # fedservice runs: job records summarize per-tenant, not into the
+    # service's own (fairness) stream
+    jobs = job_summaries(records, args.ledger)
+    records = [r for r in records
+               if not isinstance(r.get("job"), int)]
     summ = summarize(records)
 
     if args.other is None:
+        if jobs:
+            summ["jobs"] = {str(j): s for j, s in jobs.items()}
         if args.json:
             print(json.dumps(summ))
         else:

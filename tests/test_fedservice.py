@@ -1,0 +1,331 @@
+"""Multi-tenant federation service (commefficient_tpu/fedservice).
+
+The daemon's one hard promise: it is CONTROL PLANE ONLY. A job driven
+through the scheduler must be bit-identical — per-round ledger records
+and final server state — to driving its FedModel directly, with J > 1
+tenants interleaved or not. On top of that: admission control rejects
+what the pod cannot run (and the ``admission_rejected`` alarm fires),
+the deliberately starvable backlog policy trips ``job_starvation``,
+per-job ledger shards stay isolated and solo-equivalent, migration is
+checkpoint-exact across mesh shapes, and the JSONLSink two-writer
+guard refuses a second live writer on one path.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.fedservice import (AdmissionError, FedService,
+                                          JobSpec)
+from commefficient_tpu.runtime.fed_model import FedModel, FedOptimizer
+from commefficient_tpu.telemetry.sinks import JSONLSink
+
+W, B, DIM = 8, 2, 256
+
+#: wall-clock / host-load fields that legitimately differ between a
+#: solo run and a daemon-interleaved one; everything else must match
+NONDET_KEYS = ("ts", "spans", "counters", "device_time",
+               "host_rss_peak_bytes", "hbm_peak_bytes")
+
+
+def _loss(params, batch, cfg):
+    pred = batch["x"] @ params["w"]
+    n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+    l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+    return l, (l * 0.0 + 1.0,)
+
+
+def _job_cfg(seed, ledger="", **kw):
+    base = dict(mode="local_topk", error_type="local",
+                local_momentum=0.9, virtual_momentum=0.0, k=8,
+                num_workers=W, local_batch_size=B, num_clients=64,
+                seed=seed, ledger=ledger)
+    base.update(kw)
+    return Config(**base)
+
+
+def _builder(cfg, mesh):
+    model = FedModel(None, {"w": jnp.zeros((DIM,), jnp.float32)},
+                     _loss, cfg, padded_batch_size=B, mesh=mesh)
+    opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+    return model, opt
+
+
+def _batches(seed, n, workers=W):
+    rng = np.random.RandomState(seed)
+    return [
+        {"client_ids": rng.choice(64, workers, replace=False)
+         .astype(np.int32),
+         "x": jnp.asarray(rng.randn(workers, B, DIM), jnp.float32),
+         "y": jnp.asarray(rng.randn(workers, B), jnp.float32),
+         "mask": jnp.ones((workers, B), jnp.float32)}
+        for _ in range(n)]
+
+
+def _solo_run(seed, batches, ledger=""):
+    model, opt = _builder(_job_cfg(seed, ledger), None)
+    for batch in batches:
+        model(batch)
+        opt.step()
+    final = np.array(model.ps_weights)
+    model.finalize()
+    return final
+
+
+def _canon(path):
+    """Ledger round records minus the wall-clock fields — the part of
+    a job ledger that must be bit-identical daemon vs solo."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") != "round":
+                continue
+            kept = {k: v for k, v in rec.items()
+                    if k not in NONDET_KEYS}
+            out.append(kept)
+    return out
+
+
+def _svc_cfg(ledger="", **kw):
+    base = dict(num_workers=W, local_batch_size=B, num_clients=64,
+                ledger=ledger)
+    base.update(kw)
+    return Config(**base)
+
+
+class TestDeterminism:
+    def test_two_job_daemon_bit_identical_to_solo(self, tmp_path):
+        """Two interleaved tenants: each job's per-round ledger
+        records AND final server state are bit-identical to its own
+        solo run."""
+        R = 4
+        solo_leds = [str(tmp_path / "solo_a.jsonl"),
+                     str(tmp_path / "solo_b.jsonl")]
+        solo = [
+            _solo_run(3, _batches(7, R), solo_leds[0]),
+            _solo_run(4, _batches(9, R), solo_leds[1]),
+        ]
+
+        led = str(tmp_path / "svc.jsonl")
+        svc = FedService(_svc_cfg(led))
+        bs = [_batches(7, R), _batches(9, R)]
+        svc.admit(JobSpec("a", _job_cfg(3), _builder,
+                          lambda r: bs[0][r], rounds=R))
+        svc.admit(JobSpec("b", _job_cfg(4), _builder,
+                          lambda r: bs[1][r], rounds=R))
+        svc.run()
+        daemon = [svc.job_state("a"), svc.job_state("b")]
+        svc.close()
+
+        for j in range(2):
+            assert np.array_equal(solo[j], daemon[j]), f"job {j}"
+            shard = _canon(f"{led}.job{j}.jsonl")
+            ref = _canon(solo_leds[j])
+            assert len(shard) == R
+            assert shard == ref, f"job {j} ledger diverged"
+
+    def test_single_job_daemon_parity(self, tmp_path):
+        """The J=1 daemon adds zero noise — the reason j1 keeps the
+        bare perf-gate key."""
+        R = 3
+        solo = _solo_run(5, _batches(11, R))
+        svc = FedService(_svc_cfg())
+        bs = _batches(11, R)
+        svc.admit(JobSpec("only", _job_cfg(5), _builder,
+                          lambda r: bs[r], rounds=R))
+        svc.run()
+        daemon = svc.job_state("only")
+        svc.close()
+        assert np.array_equal(solo, daemon)
+
+
+class TestAdmission:
+    def test_capacity_exceeding_spec_rejected(self, tmp_path):
+        """A spatial demand beyond the pod's free devices is refused
+        at admission and the always-armed admission_rejected alarm
+        lands on the service ledger."""
+        led = str(tmp_path / "svc.jsonl")
+        svc = FedService(_svc_cfg(led))
+        bs = _batches(7, 2)
+        with pytest.raises(AdmissionError, match="devices"):
+            svc.admit(JobSpec("big", _job_cfg(3), _builder,
+                              lambda r: bs[r], rounds=2,
+                              mesh_demand=(16, 1)))
+        svc.close()
+        alarms = [a for rec in map(json.loads, open(led))
+                  for a in rec.get("alarms") or ()]
+        assert any(a["rule"] == "admission_rejected"
+                   for a in alarms), alarms
+
+    def test_duplicate_job_id_and_seed_rejected(self):
+        svc = FedService(_svc_cfg())
+        bs = _batches(7, 2)
+        svc.admit(JobSpec("a", _job_cfg(3), _builder,
+                          lambda r: bs[r], rounds=2))
+        with pytest.raises(AdmissionError, match="already admitted"):
+            svc.admit(JobSpec("a", _job_cfg(8), _builder,
+                              lambda r: bs[r], rounds=2))
+        with pytest.raises(AdmissionError, match="seed"):
+            svc.admit(JobSpec("b", _job_cfg(3), _builder,
+                              lambda r: bs[r], rounds=2))
+        assert svc._rejected == 2
+        svc.close()
+
+    def test_spec_validation(self):
+        svc = FedService(_svc_cfg())
+        with pytest.raises(AdmissionError, match="rounds"):
+            svc.admit(JobSpec("z", _job_cfg(3), _builder,
+                              lambda r: None, rounds=0))
+        svc.close()
+
+
+class TestFairness:
+    def test_starvation_drill_fires_alarm(self, tmp_path):
+        """Backlog policy + one huge tenant: the small tenant starves
+        past --alarm_job_starvation and the rule fires with its job
+        index attached."""
+        led = str(tmp_path / "svc.jsonl")
+        svc = FedService(_svc_cfg(led, alarm_job_starvation=3),
+                         policy="backlog")
+        big, small = _batches(7, 30), _batches(9, 30)
+        svc.admit(JobSpec("big", _job_cfg(3), _builder,
+                          lambda r: big[r], rounds=30))
+        svc.admit(JobSpec("small", _job_cfg(4), _builder,
+                          lambda r: small[r], rounds=3))
+        fired = []
+        for _ in range(8):
+            fired.extend(svc.tick())
+        svc.close()
+        starve = [a for a in fired if a["rule"] == "job_starvation"]
+        assert starve, fired
+        assert starve[0]["job"] == 1.0  # the small tenant
+        alarms = [a for rec in map(json.loads, open(led))
+                  for a in rec.get("alarms") or ()]
+        assert any(a["rule"] == "job_starvation" for a in alarms)
+
+    def test_fair_policy_no_starvation(self):
+        svc = FedService(_svc_cfg(alarm_job_starvation=2))
+        bs = [_batches(7, 5), _batches(9, 5)]
+        svc.admit(JobSpec("a", _job_cfg(3), _builder,
+                          lambda r: bs[0][r], rounds=5))
+        svc.admit(JobSpec("b", _job_cfg(4), _builder,
+                          lambda r: bs[1][r], rounds=5))
+        fired = []
+        while svc.active_jobs():
+            fired.extend(svc.tick())
+        svc.close()
+        assert not [a for a in fired
+                    if a["rule"] == "job_starvation"], fired
+
+
+class TestSpatialAndMigration:
+    def test_spatial_partition_and_release(self):
+        """Two 4x1 tenants fill the 8-device pod; their devices come
+        back when they drain."""
+        svc = FedService(_svc_cfg(num_workers=4))
+        bs = [_batches(7, 2, workers=4), _batches(9, 2, workers=4)]
+
+        def mk(i):
+            return lambda r: bs[i][r]
+
+        for i, seed in enumerate((3, 4)):
+            svc.admit(JobSpec(f"j{i}",
+                              _job_cfg(seed, num_workers=4), _builder,
+                              mk(i), rounds=2, mesh_demand=(4, 1)))
+        assert len(svc._free) == 0
+        svc.run()
+        assert len(svc._free) == 8
+        svc.close()
+
+    def test_migration_is_checkpoint_exact(self, tmp_path):
+        """4x1 sub-mesh -> 2x1 mid-run: the migrated job finishes
+        with exactly the state a never-migrated run reaches (PR 12
+        topology-free restore)."""
+        R = 4
+        cfg = _job_cfg(3, num_workers=4)
+        batches = _batches(7, R, workers=4)
+        solo = _solo_run_cfg(cfg, batches)
+
+        svc = FedService(_svc_cfg(num_workers=4),
+                         ckpt_dir=str(tmp_path / "ckpt"))
+        svc.admit(JobSpec("m", cfg, _builder,
+                          lambda r: batches[r], rounds=R,
+                          mesh_demand=(4, 1)))
+        svc.tick()
+        svc.tick()
+        before = svc.job_state("m")
+        svc.migrate("m", mesh_demand=(2, 1))
+        # the restore itself is bit-exact across the mesh change
+        assert np.array_equal(before, svc.job_state("m"))
+        svc.run()
+        migrated = svc.job_state("m")
+        svc.close()
+        # post-migration rounds: cross-placement XLA reduction order
+        # injects ~1e-6 noise (same bound as tests/test_elastic.py)
+        np.testing.assert_allclose(migrated, solo, rtol=0, atol=1e-4)
+
+
+def _solo_run_cfg(cfg, batches):
+    model, opt = _builder(dataclasses.replace(cfg), None)
+    for batch in batches:
+        model(batch)
+        opt.step()
+    final = np.array(model.ps_weights)
+    model.finalize()
+    return final
+
+
+class TestRegistryStamping:
+    def test_per_job_manifests_and_job_filter(self, tmp_path):
+        """Admission stamps one manifest per tenant (job_id +
+        service_run lineage) and latest_ledgers(job=...) narrows to
+        that tenant's ledger shard."""
+        from commefficient_tpu.telemetry import registry
+
+        led = str(tmp_path / "svc.jsonl")
+        runs = str(tmp_path / "runs")
+        svc = FedService(_svc_cfg(led), runs_dir=runs)
+        bs = [_batches(7, 2), _batches(9, 2)]
+        svc.admit(JobSpec("a", _job_cfg(3), _builder,
+                          lambda r: bs[0][r], rounds=2))
+        svc.admit(JobSpec("b", _job_cfg(4), _builder,
+                          lambda r: bs[1][r], rounds=2))
+        svc.run()
+        svc.close()
+
+        hits = registry.latest_ledgers(runs, n=5, job="a")
+        assert len(hits) == 1
+        _, manifest, ledger = hits[0]
+        assert manifest["job_id"] == "a"
+        assert manifest["service_run"] is True
+        assert ledger.endswith(".job0.jsonl")
+        assert len(registry.latest_ledgers(runs, n=5)) == 2
+
+
+class TestSinkGuard:
+    def test_second_writer_on_same_path_refused(self, tmp_path):
+        """Regression: two live JSONLSinks on one path would
+        interleave torn records — the second open must raise, and
+        close() must release the path for a legitimate reopen."""
+        path = str(tmp_path / "led.jsonl")
+        sink = JSONLSink(path)
+        with pytest.raises(RuntimeError, match="already has a live"):
+            JSONLSink(path)
+        sink.close()
+        again = JSONLSink(path)  # reopen after close is fine
+        again.close()
+
+    def test_job_shards_are_distinct_paths(self, tmp_path):
+        from commefficient_tpu.telemetry import job_ledger_path
+        base = str(tmp_path / "led.jsonl")
+        a = JSONLSink(job_ledger_path(base, 0))
+        b = JSONLSink(job_ledger_path(base, 1))
+        c = JSONLSink(base)
+        for s in (a, b, c):
+            s.close()

@@ -1241,15 +1241,15 @@ class TestTopologyGate:
         records = pg.load_ledger_records(ledger)
         # pre-fleet metas never recorded process_count: defaults to 1
         assert pg.resolve_topology(None, records) == \
-            (8, 1, None, None, None, None, None, None)
+            (8, 1, None, None, None, None, None, None, None)
         # CLI overrides win
         assert pg.resolve_topology(None, records,
                                    device_count=2,
                                    process_count=2) == \
-            (2, 2, None, None, None, None, None, None)
+            (2, 2, None, None, None, None, None, None, None)
         manifest = {"device_count": 16, "process_count": 4}
         assert pg.resolve_topology(manifest, records) == \
-            (16, 4, None, None, None, None, None, None)
+            (16, 4, None, None, None, None, None, None, None)
 
     def test_resolve_mesh_shape_chain(self, tmp_path):
         """Mesh layout resolution: CLI "CxM" wins, then the manifest
@@ -1264,7 +1264,7 @@ class TestTopologyGate:
         records = pg.load_ledger_records(ledger)
         assert pg.resolve_topology(None, records) == \
             (8, 1, {"clients": 4, "model": 2}, None, None, None,
-             None, None)
+             None, None, None)
         manifest = {"device_count": 8, "process_count": 1,
                     "mesh_shape": {"clients": 2, "model": 4}}
         assert pg.resolve_topology(manifest, records)[2] == \
