@@ -710,6 +710,80 @@ def _check_mutable_default(rel, lines, tree):
     return hits
 
 
+# --- rule: live-confinement --------------------------------------------
+
+#: top-level modules that own a socket when imported
+_SOCKET_MODULES = {"socket", "socketserver", "http"}
+#: the package's only sanctioned socket owner
+_LIVE_HOME = "telemetry/live.py"
+#: the only module that may construct an SLO engine directly (every
+#: other caller routes through build_slo_engine)
+_SLO_HOME = "telemetry/slo.py"
+_SERVER_CTORS = {"LiveServer", "ThreadingHTTPServer", "HTTPServer"}
+
+
+def _check_live_confinement(rel, lines, tree):
+    """The live operations plane (telemetry/live.py) is the package's
+    ONLY sanctioned socket owner and exporter-thread spawner: no
+    other production module may import ``socket``/``socketserver``/
+    ``http.server`` or construct an HTTP server, and the compiled
+    round path (``core/``, ``runtime/``) may not spawn threads at all
+    — an exporter accidentally living next to the round loop is
+    exactly the state-mutation hazard the read-only-snapshot design
+    exists to prevent. SLO engines are constructed only inside
+    ``telemetry/slo.py`` (``build_slo_engine`` is the sanctioned
+    entry). Scripts and tests live outside the scanned package root
+    and may do any of this freely."""
+    posix = rel.as_posix()
+    hits = []
+    for node in ast.walk(tree):
+        if posix != _LIVE_HOME:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] in _SOCKET_MODULES:
+                        hits.append((node.lineno,
+                                     f"import {a.name} outside "
+                                     "telemetry/live.py — the live "
+                                     "plane is the only sanctioned "
+                                     "socket owner"))
+            elif isinstance(node, ast.ImportFrom) and node.level == 0 \
+                    and node.module \
+                    and node.module.split(".")[0] in _SOCKET_MODULES:
+                hits.append((node.lineno,
+                             f"from {node.module} import ... outside "
+                             "telemetry/live.py — the live plane is "
+                             "the only sanctioned socket owner"))
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None)
+            if name in _SERVER_CTORS and posix != _LIVE_HOME:
+                hits.append((node.lineno,
+                             f"{name}(...) constructed outside "
+                             "telemetry/live.py — attach via "
+                             "attach_live_plane"))
+            elif name == "SLOEngine" and posix != _SLO_HOME:
+                hits.append((node.lineno,
+                             "SLOEngine(...) constructed outside "
+                             "telemetry/slo.py — use "
+                             "build_slo_engine"))
+            elif name == "Thread" and _top(rel) in ("core", "runtime") \
+                    and isinstance(fn, ast.Attribute) \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "threading":
+                hits.append((node.lineno,
+                             "threading.Thread spawned in the "
+                             "compiled round path — host threads "
+                             "must not live next to the round loop"))
+            elif name == "start_new_thread":
+                hits.append((node.lineno,
+                             "start_new_thread in a production "
+                             "module — spawn threads only through "
+                             "sanctioned facilities"))
+    return hits
+
+
 ALL_RULES = [
     Rule("raw-clock",
          "time.time()/perf_counter() outside telemetry/",
@@ -741,6 +815,9 @@ ALL_RULES = [
     Rule("fedservice-confinement",
          "fedservice/ daemon imported by a production module",
          _check_fedservice_confinement),
+    Rule("live-confinement",
+         "socket/HTTP-server/thread use outside telemetry/live.py",
+         _check_live_confinement),
     Rule("inline-partition-spec",
          "PartitionSpec/NamedSharding built outside parallel/",
          _check_inline_partition_spec),

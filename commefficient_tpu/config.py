@@ -425,6 +425,50 @@ class Config:
     # waited more than this many scheduler ticks since it last ran.
     # 0 = off; shares the --on_divergence action.
     alarm_job_starvation: float = 0.0
+    # live operations plane (telemetry/live.py): serve the process's
+    # in-memory metric registry in Prometheus text exposition format
+    # from a localhost-only exporter thread at this port (/metrics +
+    # /healthz). 0 = off: nothing is constructed and the build stays
+    # bit-identical. Entirely host-side; excluded from the registry
+    # run key like the other observability taps.
+    live_port: int = 0
+    # flight recorder (telemetry/flightrec.py): keep the last N round
+    # records in an in-memory ring and dump an atomic postmortem
+    # bundle on any alarm fire / graceful shutdown / crash. 0 = off.
+    flightrec_rounds: int = 0
+    # where postmortem bundles land (stamped into the run registry
+    # when --runs_dir is known)
+    postmortem_dir: str = "runs/postmortems"
+    # per-job SLO targets (telemetry/slo.py) — each 0 leaves that
+    # objective un-armed; any nonzero target arms the SLO engine,
+    # which merges slo_burn_* probes into the round record and stamps
+    # the v6 "slo" key:
+    # round-latency objective: a round slower than this p95 target
+    # (seconds) is an SLO violation
+    slo_round_p95: float = 0.0
+    # staleness objective: a round whose max folded staleness exceeds
+    # this ceiling (rounds) is a violation
+    slo_staleness_max: float = 0.0
+    # privacy-burn objective: ε must stay under the linear spend
+    # schedule dp_epsilon * (round+1) / slo_eps_rounds over this
+    # horizon (rounds); needs --dp sketch with a hard --dp_epsilon
+    slo_eps_rounds: int = 0
+    # starvation objective (fedservice daemon): a tick whose max
+    # job wait exceeds this many ticks is a violation
+    slo_starvation: float = 0.0
+    # fraction of windowed rounds allowed to violate before the burn
+    # rate reads 1.0 (the error budget)
+    slo_error_budget: float = 0.05
+    # slow / fast rolling windows (rounds) for the multi-window burn
+    # rate: burn = min(fast_rate, slow_rate) / error_budget — the
+    # fast window gives detection latency, the slow window keeps a
+    # transient spike from paging
+    slo_window: int = 32
+    slo_fast_window: int = 8
+    # slo_burn rule (telemetry/alarms.py): fire when slo_burn_max
+    # reaches this burn rate. 0 = off; shares the --on_divergence
+    # action.
+    alarm_slo_burn: float = 0.0
     # adaptive compression autopilot (commefficient_tpu/autopilot):
     # "on" runs the seeded between-rounds controller that walks the
     # discrete knob lattice (sketch_dtype x k x rows x cols x recall)
@@ -542,6 +586,30 @@ class Config:
             "--alarm_async_staleness must be >= 0 (0 = rule off)"
         assert self.alarm_job_starvation >= 0, \
             "--alarm_job_starvation must be >= 0 (0 = rule off)"
+        assert 0 <= self.live_port <= 65535, \
+            "--live_port must be in [0, 65535] (0 = off)"
+        assert self.flightrec_rounds >= 0, \
+            "--flightrec_rounds must be >= 0 (0 = off)"
+        assert self.slo_round_p95 >= 0, \
+            "--slo_round_p95 must be >= 0 (0 = objective off)"
+        assert self.slo_staleness_max >= 0, \
+            "--slo_staleness_max must be >= 0 (0 = objective off)"
+        assert self.slo_eps_rounds >= 0, \
+            "--slo_eps_rounds must be >= 0 (0 = objective off)"
+        if self.slo_eps_rounds > 0:
+            assert self.dp != "off" and self.dp_epsilon > 0, \
+                "--slo_eps_rounds needs --dp sketch with a hard " \
+                "--dp_epsilon budget (nothing spends ε otherwise)"
+        assert self.slo_starvation >= 0, \
+            "--slo_starvation must be >= 0 (0 = objective off)"
+        assert 0.0 < self.slo_error_budget <= 1.0, \
+            "--slo_error_budget must be in (0, 1]"
+        assert self.slo_window >= 1, \
+            "--slo_window must be >= 1"
+        assert 1 <= self.slo_fast_window <= self.slo_window, \
+            "--slo_fast_window must be in [1, --slo_window]"
+        assert self.alarm_slo_burn >= 0, \
+            "--alarm_slo_burn must be >= 0 (0 = rule off)"
         assert self.autopilot in ("off", "on"), \
             "--autopilot must be off|on"
         assert self.autopilot_cooldown >= 0, \
@@ -1107,6 +1175,54 @@ def build_parser(default_lr: Optional[float] = None,
                         "daemon): fire when a runnable job waited "
                         "more than this many scheduler ticks since "
                         "it last ran (0 = off; action from "
+                        "--on_divergence)")
+    parser.add_argument("--live_port", type=int, default=0,
+                        help="serve live metrics (Prometheus text "
+                        "exposition) from a localhost-only exporter "
+                        "thread at this port: /metrics + /healthz "
+                        "(0 = off, nothing constructed)")
+    parser.add_argument("--flightrec_rounds", type=int, default=0,
+                        help="flight recorder: keep the last N round "
+                        "records in memory and dump an atomic "
+                        "postmortem bundle on alarm fire / graceful "
+                        "shutdown / crash (0 = off)")
+    parser.add_argument("--postmortem_dir", type=str,
+                        default="runs/postmortems",
+                        help="directory postmortem bundles land in")
+    parser.add_argument("--slo_round_p95", type=float, default=0.0,
+                        help="SLO round-latency objective: a round "
+                        "slower than this many seconds is a "
+                        "violation (0 = objective off)")
+    parser.add_argument("--slo_staleness_max", type=float,
+                        default=0.0,
+                        help="SLO staleness objective: a round whose "
+                        "max folded staleness exceeds this many "
+                        "rounds is a violation (0 = off)")
+    parser.add_argument("--slo_eps_rounds", type=int, default=0,
+                        help="SLO privacy-burn objective: ε must "
+                        "stay under the linear spend schedule "
+                        "--dp_epsilon * (round+1) / horizon over "
+                        "this many rounds (0 = off; needs --dp "
+                        "sketch with a hard --dp_epsilon)")
+    parser.add_argument("--slo_starvation", type=float, default=0.0,
+                        help="SLO starvation objective (fedservice "
+                        "daemon): a tick whose max job wait exceeds "
+                        "this many ticks is a violation (0 = off)")
+    parser.add_argument("--slo_error_budget", type=float,
+                        default=0.05,
+                        help="fraction of windowed rounds allowed to "
+                        "violate an SLO before its burn rate reads "
+                        "1.0")
+    parser.add_argument("--slo_window", type=int, default=32,
+                        help="slow rolling window (rounds) for the "
+                        "multi-window burn rate")
+    parser.add_argument("--slo_fast_window", type=int, default=8,
+                        help="fast rolling window (rounds); burn = "
+                        "min(fast, slow rate) / error budget")
+    parser.add_argument("--alarm_slo_burn", type=float, default=0.0,
+                        help="slo_burn rule: fire when the worst "
+                        "per-objective burn rate (slo_burn_max) "
+                        "reaches this (0 = off; action from "
                         "--on_divergence)")
     parser.add_argument("--autopilot", type=str, default="off",
                         choices=["off", "on"],

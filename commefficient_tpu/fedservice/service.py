@@ -40,10 +40,13 @@ from commefficient_tpu.runtime.checkpoint import (RoundAutosaver,
                                                   load_checkpoint,
                                                   save_checkpoint)
 from commefficient_tpu.telemetry import (build_telemetry,
-                                         job_ledger_path)
+                                         job_ledger_path,
+                                         recover_ledger_shards)
 from commefficient_tpu.telemetry import registry
 from commefficient_tpu.telemetry.alarms import (AlarmEngine,
                                                 DivergenceAbort)
+from commefficient_tpu.telemetry.live import attach_live_plane
+from commefficient_tpu.telemetry.slo import build_slo_engine
 
 
 class _Job:
@@ -101,11 +104,32 @@ class FedService:
         self._ticks = 0
         self._admitted = 0
         self._rejected = 0
+        # restart hygiene: a daemon SIGKILLed mid-write leaves a torn
+        # tail on whichever shard was flushing — and a tenant that is
+        # never re-admitted would leave it there forever, poisoning
+        # ledger_merge. Sweep the base path and EVERY sibling shard
+        # (.p<k>, .job<j>, and job shards' process shards) before any
+        # sink reopens them.
+        base = getattr(cfg, "ledger", "") or ""
+        if base:
+            for shard, n in recover_ledger_shards(base).items():
+                print(f"WARNING: recovered torn ledger tail "
+                      f"({n} bytes) at {shard}")
         self.telemetry = build_telemetry(cfg)
         # constructed directly (not build_alarm_engine) so the
         # always-armed admission_rejected rule fires even when no
         # threshold knob is set on the service cfg
         self.engine = AlarmEngine(cfg, self.telemetry)
+        # live operations plane: the daemon's own fairness/SLO series
+        # export under job="service"; each admitted job's FedModel
+        # attaches its own sink (job=<j> labels) to the same process
+        # registry, so one scrape endpoint carries the whole pod
+        self.live_sink, self.flightrec = attach_live_plane(
+            self.telemetry, cfg, labels={"job": "service"},
+            runs_dir=runs_dir)
+        # service-level SLO engine (starvation objective, typically):
+        # observed once per scheduler tick; None with no target set
+        self._slo = build_slo_engine(cfg)
 
     # ------------------------------------------------------------ admission
 
@@ -145,6 +169,19 @@ class FedService:
             self._count_rejection()
             raise
 
+        burning = self.slo_burning_jobs()
+        if burning:
+            # admission flag, not refusal: a tenant burning its error
+            # budget means the pod is already failing someone — the
+            # operator should know BEFORE a new job compounds the
+            # load. The meta record and per-job manifest carry the
+            # flag; the admission itself proceeds.
+            print(f"WARNING: admitting {spec.job_id!r} while job(s) "
+                  f"{burning} are burning their SLO error budget")
+            self.telemetry.emit_meta(
+                slo_burning_at_admission=burning,
+                admitted_job=str(spec.job_id))
+
         index = self._admitted
         self._admitted += 1
         mesh, devices = None, None
@@ -155,7 +192,20 @@ class FedService:
                                    devices=devices)[0]
         base = getattr(self.cfg, "ledger", "") or ""
         shard = job_ledger_path(base, index) if base else ""
-        cfg = dataclasses.replace(spec.cfg, ledger=shard)
+        # the operations plane is pod-scoped: a daemon with
+        # --live_port / --flightrec_rounds arms every tenant's sink
+        # on the shared process registry too (a job cfg's own setting
+        # wins). Both knobs are config-hash-excluded, so the shard
+        # stays bit-identical to a solo run's ledger.
+        plane = {}
+        if getattr(self.cfg, "live_port", 0) \
+                and not getattr(spec.cfg, "live_port", 0):
+            plane["live_port"] = self.cfg.live_port
+        if getattr(self.cfg, "flightrec_rounds", 0) \
+                and not getattr(spec.cfg, "flightrec_rounds", 0):
+            plane["flightrec_rounds"] = self.cfg.flightrec_rounds
+            plane["postmortem_dir"] = self.cfg.postmortem_dir
+        cfg = dataclasses.replace(spec.cfg, ledger=shard, **plane)
         job = _Job(spec, index, cfg, mesh, devices)
         job.model, job.opt = spec.builder(cfg, mesh)
         if int(getattr(cfg, "checkpoint_every_rounds", 0) or 0) > 0:
@@ -172,7 +222,9 @@ class FedService:
                                            else job.model.mesh),
                 extra={"job_id": str(spec.job_id),
                        "service_run": True,
-                       "config_hash": registry.config_hash(cfg)})
+                       "config_hash": registry.config_hash(cfg),
+                       **({"slo_burning_at_admission": burning}
+                          if burning else {})})
         return index
 
     def _count_rejection(self):
@@ -221,6 +273,21 @@ class FedService:
     def job_rounds(self, job_id) -> int:
         return self._job(job_id).rounds_done
 
+    def slo_burning_jobs(self) -> list:
+        """Job ids currently burning their SLO error budget (their
+        own FedModel SLO engine reads burn >= 1), plus "service" when
+        the daemon's own engine is. Admission consults this."""
+        burning = []
+        for job in self._jobs:
+            if job.done or job.model is None:
+                continue
+            slo = getattr(job.model, "_slo", None)
+            if slo is not None and slo.burning:
+                burning.append(str(job.spec.job_id))
+        if self._slo is not None and self._slo.burning:
+            burning.append("service")
+        return burning
+
     # ------------------------------------------------------------ scheduler
 
     def tick(self):
@@ -248,6 +315,15 @@ class FedService:
         self._ticks += 1
         probes = self._fairness_probes(runnable, chosen)
         self.telemetry.begin_round(t)
+        if self._slo is not None:
+            # the service's SLO objectives read the fairness probes
+            # (starvation ticks); the burn probes merge INTO the tick
+            # record's probe dict so the slo_burn rule fires through
+            # the single engine.check below — the daemon path never
+            # needs check_slo
+            probes.update(self._slo.observe(
+                t, starved_ticks=probes.get("job_starved_rounds")))
+            self.telemetry.set_round_slo(t, self._slo.stamp())
         self.telemetry.merge_round_probes(t, probes)
         self.telemetry.set_round_bytes(t, 0, 0)
         return self.engine.check(t, probes)

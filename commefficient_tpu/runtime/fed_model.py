@@ -381,6 +381,32 @@ class FedModel:
         self._cost_model = None
         from commefficient_tpu.parallel import mesh as mesh_lib
         topo = mesh_lib.topology_summary()
+        # live operations plane (telemetry/live.py + flightrec.py):
+        # exporter sink + flight recorder must attach BEFORE the meta
+        # record below is emitted — the live sink derives clients/s
+        # from the plan, the recorder stamps the bundle's meta. Both
+        # stay None with the knobs unset (disabled fast path
+        # untouched). Labels: the job index is parsed off the ledger
+        # shard path (the shard IS the job identity under a
+        # fedservice daemon); registry lineage arms only when the run
+        # writes a ledger, matching maybe_write_manifest.
+        from commefficient_tpu.telemetry.live import attach_live_plane
+        from commefficient_tpu.telemetry.registry import config_hash
+        from commefficient_tpu.telemetry.sinks import \
+            job_index_of_ledger
+        ledger = str(getattr(args, "ledger", "") or "")
+        job = job_index_of_ledger(ledger)
+        labels = {"process": topo["process_index"],
+                  "run": config_hash(args)[:8]}
+        if job is not None:
+            labels["job"] = job
+        self.live_sink, self.flightrec = attach_live_plane(
+            self.telemetry, args, labels=labels,
+            runs_dir="runs" if ledger else "")
+        # per-run SLO engine (telemetry/slo.py): None unless a target
+        # is set; observed once per synchronous round in step()
+        from commefficient_tpu.telemetry.slo import build_slo_engine
+        self._slo = build_slo_engine(args)
         self.telemetry.emit_meta(
             num_clients=num_clients,
             num_devices=int(np.prod(self.mesh.devices.shape)),
@@ -609,6 +635,12 @@ class FedModel:
         step_t0 = (clock.tick()
                    if eng is not None and eng.step_time_ratio > 0
                    and self.pipeline_depth <= 1 else None)
+        # SLO latency samples need a wall clock on every synchronous
+        # round (pipelined dispatch times measure the host, not the
+        # round — same exclusion as step_time_regression)
+        slo_t0 = (clock.tick()
+                  if self._slo is not None and self.pipeline_depth <= 1
+                  else None)
         staleness = None
         if self._async_driver is not None:
             # issue the sampled cohort into the arrival queue, then
@@ -744,6 +776,7 @@ class FedModel:
             # alarms via _finish_probes
             tel.merge_round_probes(ridx, probe_vals)
             self._probe_host[ridx] = probe_vals
+        astats = None
         if self._async_driver is not None:
             # buffered-arrival probes (staleness histogram, buffer
             # occupancy, backlog) are host-side driver state: merged
@@ -762,6 +795,8 @@ class FedModel:
             # before set_round_bytes so an aborting alarm still lands
             # on the record telemetry.close() will flush
             eng.check_step_time(ridx, clock.tick() - step_t0)
+        if slo_t0 is not None:
+            self._observe_slo(ridx, clock.tick() - slo_t0, astats)
         acct_ids, acct_mask = ids_np, batch["mask"]
         if self._async_driver is not None:
             # dead pad slots (id 0, mask 0) are queue padding, not
@@ -867,6 +902,26 @@ class FedModel:
                 # exhaustion at the conservative weight_scale=1
                 "dp_rounds_left": acc.rounds_left(budget,
                                                   sigma=sigma)})
+
+    def _observe_slo(self, ridx: int, round_s: float, astats=None):
+        """One SLO observation per synchronous round: latency is the
+        dispatch-through-metrics wall time, staleness comes from the
+        async driver's round stats, ε from the accountant's
+        post-charge curve. The returned burn probes ride the ledger
+        record (where the live plane's ``slo_burn`` gauges read
+        them), the per-objective stamp lands on the v6 ``slo`` key,
+        and the slo_burn rule evaluates through ``check_slo`` — never
+        ``check``, which is stateful and already ran this round."""
+        slo = self._slo
+        eps = (self._accountant.epsilon()
+               if self._accountant is not None else None)
+        smax = (astats or {}).get("async_staleness_max")
+        probes = slo.observe(ridx, round_s=round_s,
+                             staleness_max=smax, dp_epsilon=eps)
+        self.telemetry.merge_round_probes(ridx, probes)
+        self.telemetry.set_round_slo(ridx, slo.stamp())
+        if self.alarm_engine is not None:
+            self.alarm_engine.check_slo(ridx, probes)
 
     def _finish_probes(self, ridx: int, vals: dict):
         """Complete round ``ridx``'s probe dict host-side: fold in any

@@ -22,10 +22,22 @@ from commefficient_tpu.telemetry.record import (LEDGER_SCHEMA_VERSION,
                                                 make_meta_record,
                                                 make_round_record,
                                                 validate_record)
+from commefficient_tpu.telemetry.flightrec import (FlightRecorder,
+                                                   install_crash_hook,
+                                                   load_postmortem)
+from commefficient_tpu.telemetry.live import (LiveMetricsSink,
+                                              LiveRegistry,
+                                              attach_live_plane,
+                                              live_registry,
+                                              shutdown_plane)
 from commefficient_tpu.telemetry.sinks import (ConsoleSink, JSONLSink,
                                                TensorBoardSink,
                                                append_bench_record,
-                                               job_ledger_path)
+                                               job_index_of_ledger,
+                                               job_ledger_path,
+                                               recover_ledger_shards)
+from commefficient_tpu.telemetry.slo import (SLOEngine, SLOSpec,
+                                             build_slo_engine)
 
 __all__ = [
     "clock",
@@ -45,4 +57,17 @@ __all__ = [
     "TensorBoardSink",
     "append_bench_record",
     "job_ledger_path",
+    "job_index_of_ledger",
+    "recover_ledger_shards",
+    "FlightRecorder",
+    "install_crash_hook",
+    "load_postmortem",
+    "LiveMetricsSink",
+    "LiveRegistry",
+    "attach_live_plane",
+    "live_registry",
+    "shutdown_plane",
+    "SLOEngine",
+    "SLOSpec",
+    "build_slo_engine",
 ]

@@ -145,6 +145,7 @@ class Telemetry:
         self._sinks = list(sinks or ())
         self._records = OrderedDict()   # round index -> record
         self._closed_rounds = set()     # indices no longer current
+        self._alarm_counts = {}         # rule -> fires this run
         self._current = None            # the open round record
         self._compile_mark = (0, 0.0)
         self._shut = False
@@ -249,6 +250,16 @@ class Telemetry:
         rec["dp_delta"] = float(delta)
         rec["dp_sigma"] = float(sigma)
 
+    def set_round_slo(self, index: int, stamp: dict):
+        """Attach the SLO engine's per-objective snapshot (schema v6
+        ``slo`` key) to round ``index``'s record. Arrives from the
+        round-finish hook (runtime/fed_model.py or the fedservice
+        tick), always before emission."""
+        rec = self._records.get(index)
+        if rec is None or not stamp:
+            return
+        rec["slo"] = dict(stamp)
+
     def merge_round_probes(self, index: int, probes: dict):
         """Merge algorithm-probe values onto round ``index``'s record
         (schema v2). Client-pass probes land inside ``metrics_host``;
@@ -294,7 +305,11 @@ class Telemetry:
 
     def flag_alarm(self, index: int, alarm: dict):
         """Append an alarm dict to round ``index``'s record (schema
-        v2 ``alarms`` list). Safe any time before emission."""
+        v2 ``alarms`` list) and bump the run's per-rule fire count
+        (the ``alarm_fired`` totals ``close()`` emits on the summary
+        record). Safe any time before emission."""
+        rule = str(alarm.get("rule"))
+        self._alarm_counts[rule] = self._alarm_counts.get(rule, 0) + 1
         rec = self._records.get(index)
         if rec is None:
             return
@@ -329,12 +344,21 @@ class Telemetry:
     # --- shutdown ---------------------------------------------------------
 
     def close(self):
-        """Flush every pending record and close sinks. Idempotent."""
+        """Flush every pending record and close sinks. Idempotent.
+        A run in which any alarm fired additionally emits one summary
+        record carrying the per-rule ``alarm_fired`` totals, so
+        report tooling can show alarm counts without scanning every
+        round record; clean runs' ledgers are unchanged."""
         if self._shut:
             return
         self._shut = True
         self._close_current()
         self._drain(force=True)
+        if self._alarm_counts and self._sinks:
+            from commefficient_tpu.telemetry.record import \
+                make_summary_record
+            self.emit(make_summary_record(
+                alarm_fired=dict(sorted(self._alarm_counts.items()))))
         for sink in self._sinks:
             try:
                 sink.close()

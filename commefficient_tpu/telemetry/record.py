@@ -86,18 +86,40 @@ privacy/):
              charged at (the dispatched variant's ``dp_noise_mult``
              over the round's staleness weight scale); None outside
              DP runs.
+
+Schema v6 adds one key to round records (the live operations plane,
+telemetry/slo.py + telemetry/flightrec.py) and two summary-record
+conventions:
+
+``slo``    — None unless an SLO engine evaluated the round, else the
+             per-objective {target, seen, fast_rate, slow_rate,
+             burn} snapshot (``SLOEngine.stamp``) taken after the
+             round's observation — the ledger twin of the
+             ``slo_burn_*`` probe keys the same engine merges into
+             ``probes``.
+``alarm_fired`` — summary records (and only summary records) may
+             carry the run's alarm totals by rule
+             ({rule: count}); ``Telemetry.close`` emits one when any
+             alarm fired, so ``telemetry_report.py`` shows alarm
+             totals without scanning every round record.
+``postmortem`` — not a ledger record: flight-recorder bundles
+             (kind ``postmortem``, telemetry/flightrec.py) are
+             standalone JSON files under ``runs/postmortems/`` whose
+             ``rounds`` list holds schema-validated round records;
+             the run registry's ``postmortem``/``postmortem_reason``
+             manifest keys are the lineage stamp.
 """
 
 from __future__ import annotations
 
 from commefficient_tpu.telemetry import clock
 
-LEDGER_SCHEMA_VERSION = 5
+LEDGER_SCHEMA_VERSION = 6
 
 # versions validate_record accepts: v1 (pre-probe), v2 (pre-trace),
-# v3 (pre-fleet) and v4 (pre-DP) ledgers stay readable by the report
-# tooling
-READABLE_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
+# v3 (pre-fleet), v4 (pre-DP) and v5 (pre-SLO) ledgers stay readable
+# by the report tooling
+READABLE_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 # device_time keys whose values are nested dicts (v4); every other
 # bucket value must be numeric
@@ -131,6 +153,11 @@ ROUND_V5_KEYS = (
     "dp_sigma",                            # None outside --dp runs
 )
 
+# v6 additions (not required of v1-v5 records)
+ROUND_V6_KEYS = (
+    "slo",                                 # None without an SLO engine
+)
+
 
 def _base(kind: str) -> dict:
     return {"schema": LEDGER_SCHEMA_VERSION, "kind": kind,
@@ -159,6 +186,7 @@ def make_round_record(round_index: int) -> dict:
         "dp_epsilon": None,
         "dp_delta": None,
         "dp_sigma": None,
+        "slo": None,
     })
     return rec
 
@@ -206,6 +234,8 @@ def validate_record(rec) -> list:
             required = required + ROUND_V3_KEYS
         if isinstance(schema, int) and schema >= 5:
             required = required + ROUND_V5_KEYS
+        if isinstance(schema, int) and schema >= 6:
+            required = required + ROUND_V6_KEYS
         for key in required:
             if key not in rec:
                 problems.append(f"round record missing {key!r}")
@@ -220,6 +250,9 @@ def validate_record(rec) -> list:
             v = rec.get(key)
             if v is not None and not isinstance(v, (int, float)):
                 problems.append(f"{key} is non-numeric")
+        slo = rec.get("slo")
+        if slo is not None and not isinstance(slo, dict):
+            problems.append("slo is not a dict")
         dt = rec.get("device_time")
         if dt is not None:
             if not isinstance(dt, dict):
@@ -241,4 +274,11 @@ def validate_record(rec) -> list:
                 problems.append(f"bench record missing {key!r}")
     if kind == "epoch" and not isinstance(rec.get("row"), dict):
         problems.append("epoch record missing row dict")
+    if kind == "summary":
+        fired = rec.get("alarm_fired")
+        if fired is not None and (
+                not isinstance(fired, dict)
+                or any(not isinstance(v, (int, float))
+                       for v in fired.values())):
+            problems.append("alarm_fired is not a {rule: count} dict")
     return problems

@@ -33,7 +33,8 @@ MANIFEST_PREFIX = "run_"
 #: must not perturb the config hash (two reruns of one experiment
 #: with different ledger paths are the SAME configuration)
 _HASH_EXCLUDE = ("ledger", "telemetry_console", "use_tensorboard",
-                 "do_profile", "clientstore_dir")
+                 "do_profile", "clientstore_dir", "live_port",
+                 "flightrec_rounds", "postmortem_dir")
 
 
 def config_dict(args) -> dict:

@@ -8,8 +8,10 @@ combination.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 
 import numpy as np
 
@@ -37,6 +39,44 @@ def job_ledger_path(path: str, job_index: int) -> str:
     shard file IS the job identity, so the records themselves stay
     byte-identical to a solo run's."""
     return f"{path}.job{int(job_index)}.jsonl"
+
+
+def job_index_of_ledger(path: str):
+    """The job index a ledger shard path encodes (``<base>.job<j>
+    .jsonl`` → ``j``), or None for a canonical/process-shard path —
+    the live plane derives its ``job`` metric label from this, since
+    the shard file IS the job identity and records carry no job
+    stamp."""
+    m = re.search(r"\.job(\d+)\.jsonl(?:\.p\d+\.jsonl)?$",
+                  str(path or ""))
+    return int(m.group(1)) if m else None
+
+
+def recover_ledger_shards(path: str) -> dict:
+    """Sweep a canonical ledger path AND every sibling shard — the
+    ``.p<k>`` process shards, the ``.job<j>`` job shards, and the job
+    shards' own process shards — through :func:`recover_torn_tail`.
+
+    Returns ``{shard_path: bytes_dropped}`` for shards that lost a
+    torn tail (empty when everything was clean). ``JSONLSink``
+    recovers its own file at open, but a fedservice daemon restarted
+    after a SIGKILL may never re-admit the tenant that owned a torn
+    shard — this sweep runs at daemon start so no orphaned torn tail
+    survives to poison ``scripts/ledger_merge.py``."""
+    if not path:
+        return {}
+    candidates = [path]
+    candidates += sorted(
+        set(glob.glob(glob.escape(path) + ".job*.jsonl")
+            + glob.glob(glob.escape(path) + ".p*.jsonl")))
+    dropped = {}
+    for p in candidates:
+        if not os.path.isfile(p):
+            continue
+        n = recover_torn_tail(p)
+        if n:
+            dropped[p] = n
+    return dropped
 
 
 def recover_torn_tail(path: str) -> int:
@@ -242,6 +282,7 @@ class ConsoleSink:
         self.counters = {}
         self.uplink = 0.0
         self.downlink = 0.0
+        self.alarms = {}
 
     def write(self, rec):
         if rec.get("kind") != "round":
@@ -253,10 +294,13 @@ class ConsoleSink:
             self.counters[name] = self.counters.get(name, 0) + n
         self.uplink += rec.get("uplink_bytes") or 0.0
         self.downlink += rec.get("downlink_bytes") or 0.0
+        for alarm in rec.get("alarms") or []:
+            rule = str(alarm.get("rule"))
+            self.alarms[rule] = self.alarms.get(rule, 0) + 1
 
     def summary(self) -> dict:
         n = max(self.rounds, 1)
-        return make_summary_record(
+        rec = make_summary_record(
             rounds=self.rounds,
             uplink_mib=round(self.uplink / 2**20, 3),
             downlink_mib=round(self.downlink / 2**20, 3),
@@ -266,6 +310,9 @@ class ConsoleSink:
                           for k, v in sorted(self.spans.items())},
             counters=dict(sorted(self.counters.items())),
         )
+        if self.alarms:
+            rec["alarm_fired"] = dict(sorted(self.alarms.items()))
+        return rec
 
     def close(self):
         if not self.rounds:
@@ -282,3 +329,5 @@ class ConsoleSink:
                   f"mean {s['span_mean_ms'][name]} ms/round", file=out)
         if s["counters"]:
             print(f"  counters: {s['counters']}", file=out)
+        if s.get("alarm_fired"):
+            print(f"  alarms fired: {s['alarm_fired']}", file=out)

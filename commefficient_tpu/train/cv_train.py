@@ -594,6 +594,12 @@ def main(argv=None):
         print(f"interrupted ({e}); resume from the last autosave")
         interrupted = True
         results = []
+        if model.flightrec is not None:
+            # the postmortem preserves the rounds the ledger may not
+            # have flushed — dumped before interrupted() discards the
+            # in-flight host state it describes
+            model.flightrec.dump("graceful_shutdown",
+                                 context={"signal": str(e)})
         model.interrupted()
     model.finalize()
     from commefficient_tpu.runtime.checkpoint import \

@@ -481,6 +481,11 @@ def main(argv=None):
         print(f"interrupted ({e}); resume from the last autosave")
         interrupted = True
         results = []
+        if model.flightrec is not None:
+            # see cv_train.main — dump the postmortem before the
+            # in-flight state it describes is discarded
+            model.flightrec.dump("graceful_shutdown",
+                                 context={"signal": str(e)})
         model.interrupted()
     model.finalize()
     from commefficient_tpu.runtime.checkpoint import \

@@ -322,6 +322,25 @@ def summarize(records) -> dict:
                  "recovery_err_max": max(v)}
                 for s, v in sorted(dp_sigma_err.items())],
         }
+    # v6: run alarm totals — the close()-time summary record's
+    # alarm_fired backfill is authoritative (it counts fires even on
+    # rounds this reader never saw, e.g. a truncated ledger); fall
+    # back to counting the flagged rounds for older ledgers
+    alarm_totals = {}
+    for rec in records:
+        if rec.get("kind") == "summary" and \
+                isinstance(rec.get("alarm_fired"), dict):
+            for rule, cnt in rec["alarm_fired"].items():
+                alarm_totals[str(rule)] = \
+                    alarm_totals.get(str(rule), 0) + int(cnt)
+    if not alarm_totals:
+        for a in alarm_rounds:
+            for al in a["alarms"]:
+                rule = str(al.get("rule", "?"))
+                alarm_totals[rule] = alarm_totals.get(rule, 0) + 1
+    # v6: the last round's SLO stamp is the run's closing burn state
+    slo_stamp = next((r["slo"] for r in reversed(rounds)
+                      if isinstance(r.get("slo"), dict)), None)
     return {
         "meta": next((r for r in records if r["kind"] == "meta"),
                      None),
@@ -339,6 +358,8 @@ def summarize(records) -> dict:
              if r["kind"] == "meta" and r.get("cost_model")), None),
         "probes": probes,
         "alarm_rounds": alarm_rounds,
+        "alarm_totals": dict(sorted(alarm_totals.items())),
+        "slo": slo_stamp,
         "variant_compiles": dict(sorted(variant_compiles.items())),
         "frontier": frontier,
         "privacy": privacy,
@@ -444,6 +465,21 @@ def render_summary(s, label="") -> str:
     for a in s.get("alarm_rounds", []):
         names = ", ".join(al.get("rule", "?") for al in a["alarms"])
         lines.append(f"  ALARM round {a['round']}: {names}")
+    if s.get("alarm_totals"):
+        lines.append("  alarm totals: " + ", ".join(
+            f"{rule} x{n}"
+            for rule, n in s["alarm_totals"].items()))
+    slo = s.get("slo")
+    if slo:
+        for obj, st in sorted(slo.items()):
+            if not isinstance(st, dict):
+                continue
+            lines.append(
+                f"  slo {obj}: burn {st.get('burn', 0):.3g} "
+                f"(target {st.get('target')}, fast rate "
+                f"{st.get('fast_rate', 0):.3g}, slow rate "
+                f"{st.get('slow_rate', 0):.3g}, "
+                f"{st.get('seen', 0)} observed)")
     vc = s.get("variant_compiles") or {}
     if vc:
         # knob trajectory, ledger view: variants in first-dispatch
@@ -837,6 +873,57 @@ def runs_dir_report(runs_dir: str, as_json: bool) -> int:
     return 0
 
 
+def postmortem_report(path: str, as_json: bool) -> int:
+    """Render a flight-recorder bundle: the incident header (reason,
+    rule, labels, lineage), the recent compile/alarm event queue, and
+    the ring's rounds summarized exactly like a ledger."""
+    from commefficient_tpu.telemetry.flightrec import load_postmortem
+    bundle, problems = load_postmortem(path)
+    for p in problems:
+        print(f"WARNING {path}: {p}", file=sys.stderr)
+    rounds = [r for r in (bundle.get("rounds") or [])
+              if isinstance(r, dict)]
+    meta = bundle.get("meta")
+    summ = summarize(([meta] if meta else []) + rounds)
+    if as_json:
+        print(json.dumps({"bundle": {
+            k: bundle.get(k)
+            for k in ("reason", "rule", "ts", "labels", "context",
+                      "config_hash", "ring_rounds", "events",
+                      "manifest", "environment")},
+            "summary": summ, "problems": problems}))
+        return 0
+    lines = [f"== postmortem {path} =="]
+    rule = f" rule={bundle.get('rule')}" if bundle.get("rule") else ""
+    lines.append(f"  incident: {bundle.get('reason')}{rule} "
+                 f"at ts {bundle.get('ts')}")
+    if bundle.get("labels"):
+        lines.append("  labels: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(bundle["labels"].items())))
+    lines.append(f"  config: {bundle.get('config_hash', '')[:12]}"
+                 + (f", manifest {bundle['manifest']}"
+                    if bundle.get("manifest") else ""))
+    ctx = bundle.get("context") or {}
+    if ctx:
+        lines.append("  context: " + json.dumps(ctx, sort_keys=True))
+    lines.append(f"  ring: {len(rounds)} of last "
+                 f"{bundle.get('ring_rounds')} round(s) retained")
+    for ev in bundle.get("events") or []:
+        kind = ev.get("kind")
+        if kind == "alarm":
+            lines.append(
+                f"  event alarm {ev.get('rule')} round "
+                f"{ev.get('round')}: value {ev.get('value')} over "
+                f"threshold {ev.get('threshold')}")
+        elif kind == "compile":
+            lines.append(
+                f"  event compile round {ev.get('round')}: "
+                f"{ev.get('events')} event(s), {ev.get('secs')} s")
+    print("\n".join(lines))
+    print(render_summary(summ, label="(flight-recorder ring)"))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="render or diff telemetry run ledgers")
@@ -848,10 +935,15 @@ def main(argv=None):
                     help="registry mode: list recent runs (via their "
                          "manifests), summarize the latest ledger and "
                          "diff it against the previous run")
+    ap.add_argument("--postmortem", default=None,
+                    help="render a flight-recorder postmortem bundle "
+                         "(telemetry/flightrec.py JSON)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
 
+    if args.postmortem is not None:
+        return postmortem_report(args.postmortem, args.json)
     if args.runs_dir is not None:
         return runs_dir_report(args.runs_dir, args.json)
     if args.ledger is None:

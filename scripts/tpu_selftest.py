@@ -947,6 +947,113 @@ def service_smoke():
             f"{R} isolated rounds per shard")
 
 
+def live_smoke():
+    """Live operations plane on the REAL backend: start a fedservice
+    daemon with the exporter armed, scrape /metrics mid-run and see
+    per-job labeled series, trip the ``slo_burn`` rule on a
+    deliberately starved tenant (backlog policy), and confirm the
+    flight recorder dumped a postmortem bundle the report tool can
+    round-trip."""
+    import dataclasses
+    import json
+    import shutil
+    import socket
+    import tempfile
+    import urllib.request
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.fedservice import FedService, JobSpec
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+    from commefficient_tpu.telemetry.flightrec import load_postmortem
+    from commefficient_tpu.telemetry.live import shutdown_plane
+
+    W, B, d = 8, 2, 1 << 10
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    def builder(cfg, mesh):
+        model = FedModel(None, {"w": jnp.zeros((d,), jnp.float32)},
+                         loss, cfg, padded_batch_size=B, mesh=mesh)
+        return model, FedOptimizer([{"lr": 0.25}], cfg, model=model)
+
+    def batches(seed, n):
+        rng = np.random.RandomState(seed)
+        return [
+            {"client_ids": rng.choice(64, W, replace=False)
+             .astype(np.int32),
+             "x": jnp.asarray(rng.randn(W, B, d), jnp.float32),
+             "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+             "mask": jnp.ones((W, B), jnp.float32)}
+            for _ in range(n)]
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    tmp = tempfile.mkdtemp(prefix="live_smoke_")
+    try:
+        led = os.path.join(tmp, "svc.jsonl")
+        svc_cfg = Config(num_workers=W, local_batch_size=B,
+                         num_clients=64, ledger=led, live_port=port,
+                         flightrec_rounds=8,
+                         postmortem_dir=os.path.join(tmp, "pm"),
+                         slo_starvation=1.0, slo_window=4,
+                         slo_fast_window=2, alarm_slo_burn=1.0)
+        # NB: no live_port here — the daemon propagates its own
+        # plane knobs to every tenant at admission
+        job_cfg = Config(mode="local_topk", error_type="local",
+                         local_momentum=0.9, virtual_momentum=0.0,
+                         k=8, num_workers=W, local_batch_size=B,
+                         num_clients=64, seed=3)
+        svc = FedService(svc_cfg, policy="backlog")
+        bs_a, bs_b = batches(7, 6), batches(9, 2)
+        svc.admit(JobSpec("a", job_cfg, builder,
+                          lambda r: bs_a[r] if r < 6 else None,
+                          rounds=6))
+        svc.admit(JobSpec("b", dataclasses.replace(job_cfg, seed=4),
+                          builder,
+                          lambda r: bs_b[r] if r < 2 else None,
+                          rounds=2))
+        for _ in range(8):
+            svc.tick()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) \
+            .read().decode()
+        series = [l for l in body.splitlines()
+                  if l and not l.startswith("#")]
+        for want in ('commeff_rounds_total{job="0"',
+                     'commeff_rounds_total{job="1"',
+                     'commeff_rounds_total{job="service"}',
+                     "commeff_round_seconds",
+                     "commeff_job_backlog_total",
+                     "commeff_alarms_total"):
+            assert any(want in l for l in series), (want, series)
+        bundle_path = svc.flightrec.last_bundle
+        assert bundle_path and os.path.exists(bundle_path), \
+            "slo_burn fired but no postmortem bundle dumped"
+        svc.close()
+        bundle, problems = load_postmortem(bundle_path)
+        assert not problems, problems
+        assert bundle["rule"] == "slo_burn", bundle["rule"]
+        # close()-time alarm backfill: the service ledger's summary
+        # record must carry the run's slo_burn fire count
+        fired = next(
+            (rec.get("alarm_fired") for rec in
+             map(json.loads, open(led)) if rec.get("kind") == "summary"
+             and rec.get("alarm_fired")), None)
+        assert fired and fired.get("slo_burn", 0) >= 1, fired
+    finally:
+        shutdown_plane()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return (f"scraped {len(series)} live series; slo_burn tripped, "
+            f"postmortem bundle round-trips ({bundle['reason']})")
+
+
 def main():
     print(f"devices: {jax.devices()}")
     check("pallas_vs_xla_sketch_parity", pallas_parity)
@@ -965,6 +1072,7 @@ def main():
     check("flash_attention_parity", flash_attention_parity)
     check("chaos_smoke", chaos_smoke)
     check("dp_smoke", dp_smoke)
+    check("live_smoke", live_smoke)
     check("bench_vs_baseline", bench_throughput)
     if FAILED:
         print(f"\n{len(FAILED)} check(s) failed: {FAILED}")

@@ -305,7 +305,13 @@ def test_probes_off_program_identical(mode, error_type):
         # --overlap_depth 1 is the serial program by construction:
         # none of the chunked-emission branches trace (the HLO
         # fingerprint identity every audit baseline pins on)
-        overlap_depth=1)
+        overlap_depth=1,
+        # live-operations plane: exporter port, flight-recorder ring,
+        # SLO targets, and the burn-rate alarm are all host-side —
+        # they observe the round stream, never enter the program
+        live_port=1, flightrec_rounds=4, slo_round_p95=0.5,
+        slo_staleness_max=2.0, slo_starvation=1.0,
+        slo_window=16, slo_fast_window=4, alarm_slo_burn=2.0)
     assert _lower_text(
         build_client_round(inert_cfg, linear_loss, 3,
                            transmit_transform=None),
