@@ -1,0 +1,218 @@
+"""lock-confinement: declared shared state is touched only under its
+lock.
+
+The daemon era (PR 17/18) made the package genuinely multi-threaded —
+the live exporter scrapes from HTTP worker threads while the round
+loop publishes, the flight recorder dumps from crash hooks while the
+driver appends, the scheduler's queues are read by fairness probes.
+Lock discipline by convention doesn't survive refactors, so modules
+that own threaded state now *declare* it:
+
+    _LOCK_MAP = {"_counters": "_lock", "_PLANE": "_PLANE_LOCK"}
+
+maps attribute (or module-global) names to the lock that confines
+them. This checker flags, anywhere in the declaring module:
+
+* **writes** outside a lexical ``with <lock>:`` — attribute/global
+  assignment, augmented assignment, subscript stores, ``del``, and
+  mutating method calls (``append``/``update``/``pop``/…);
+* **iteration reads** outside the lock — ``for x in <attr>``,
+  comprehensions over it, and snapshot calls (``list()``, ``dict()``,
+  ``sorted()``, ``.items()``/``.values()``/``.keys()`` consumed by a
+  loop) — iterating a dict/deque while another thread mutates it
+  raises RuntimeError in CPython, which is precisely the crash the
+  checker exists to prevent.
+
+Point reads (``self._by_id[k]``, ``len(...)``, membership) stay
+unflagged: they are atomic under the GIL and locking them buys
+nothing. Plain ``self.<attr> = ...`` stores inside ``__init__`` are
+exempt (construction happens-before publication); stores through a
+*class* receiver (``JSONLSink._live[...]``) are never exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from commefficient_tpu.analysis.flow import FlowChecker, Program
+
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "add",
+             "update", "setdefault", "pop", "popitem", "popleft",
+             "remove", "discard", "clear", "insert", "sort",
+             "reverse"}
+_SNAPSHOTTERS = {"list", "dict", "set", "tuple", "sorted",
+                 "frozenset", "sum", "max", "min", "any", "all"}
+_VIEW_METHODS = {"items", "values", "keys"}
+
+
+def _lock_map_of(mod) -> Dict[str, str]:
+    """The module-level ``_LOCK_MAP`` literal, if declared."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "_LOCK_MAP"
+                        for t in node.targets) \
+                and isinstance(node.value, ast.Dict):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) \
+                        and isinstance(v, ast.Constant):
+                    out[str(k.value)] = str(v.value)
+            return out
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "_LOCK_MAP" \
+                and isinstance(node.value, ast.Dict):
+            return _lock_map_of_dict(node.value)
+    return {}
+
+
+def _lock_map_of_dict(d: ast.Dict) -> Dict[str, str]:
+    return {str(k.value): str(v.value)
+            for k, v in zip(d.keys, d.values)
+            if isinstance(k, ast.Constant)
+            and isinstance(v, ast.Constant)}
+
+
+def _guarded_attr(expr, lock_map) -> Optional[str]:
+    """The declared attr an expression refers to (``self._ring`` /
+    ``Cls._live`` / module-global ``_PLANE``), else None."""
+    if isinstance(expr, ast.Attribute) and expr.attr in lock_map:
+        return expr.attr
+    if isinstance(expr, ast.Name) and expr.id in lock_map:
+        return expr.id
+    return None
+
+
+def _lock_name(expr) -> Optional[str]:
+    """The lock a ``with`` item takes: ``self._lock`` → "_lock",
+    ``_PLANE_LOCK`` → "_PLANE_LOCK"."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_self_store_in_init(target, fn_name) -> bool:
+    return (fn_name == "__init__"
+            and isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self")
+
+
+def _check_module(rel: str, mod) -> List[Tuple[str, int, str]]:
+    lock_map = _lock_map_of(mod)
+    if not lock_map:
+        return []
+    hits: List[Tuple[str, int, str]] = []
+
+    def flag(line, attr, what):
+        hits.append((rel, line,
+                     f"{what} of '{attr}' outside 'with "
+                     f"{lock_map[attr]}:' — _LOCK_MAP confines it"))
+
+    def visit(node, held: Set[str], fn_name: Optional[str],
+              at_module_level: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a with-block does not extend into a nested def's body —
+            # that body runs later, on whatever thread calls it
+            for child in node.body:
+                visit(child, set(), node.name, False)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                ln = _lock_name(item.context_expr)
+                if ln is not None:
+                    inner.add(ln)
+            for child in node.body:
+                visit(child, inner, fn_name, at_module_level)
+            return
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                visit(child, held, fn_name, at_module_level)
+            return
+
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                attr = _guarded_attr(base, lock_map)
+                if attr is not None \
+                        and lock_map[attr] not in held \
+                        and not at_module_level \
+                        and not (isinstance(t, (ast.Attribute,
+                                                ast.Name))
+                                 and _is_self_store_in_init(t,
+                                                            fn_name)):
+                    flag(t.lineno, attr, "write")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                attr = _guarded_attr(base, lock_map)
+                if attr is not None and lock_map[attr] not in held:
+                    flag(t.lineno, attr, "del")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                attr = _guarded_attr(f.value, lock_map)
+                if attr is not None and lock_map[attr] not in held:
+                    flag(node.lineno, attr, f".{f.attr}() mutation")
+            name = f.id if isinstance(f, ast.Name) else None
+            if name in _SNAPSHOTTERS:
+                for a in node.args:
+                    tgt = a
+                    if isinstance(a, ast.Call) \
+                            and isinstance(a.func, ast.Attribute) \
+                            and a.func.attr in _VIEW_METHODS:
+                        tgt = a.func.value
+                    attr = _guarded_attr(tgt, lock_map)
+                    if attr is not None \
+                            and lock_map[attr] not in held:
+                        flag(node.lineno, attr,
+                             f"{name}(...) iteration")
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            it = node.iter
+            if isinstance(it, ast.Call) \
+                    and isinstance(it.func, ast.Attribute) \
+                    and it.func.attr in _VIEW_METHODS:
+                it = it.func.value
+            attr = _guarded_attr(it, lock_map)
+            if attr is not None and lock_map[attr] not in held:
+                flag(node.iter.lineno, attr, "loop iteration")
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                it = gen.iter
+                if isinstance(it, ast.Call) \
+                        and isinstance(it.func, ast.Attribute) \
+                        and it.func.attr in _VIEW_METHODS:
+                    it = it.func.value
+                attr = _guarded_attr(it, lock_map)
+                if attr is not None and lock_map[attr] not in held:
+                    flag(node.lineno, attr, "comprehension iteration")
+
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, fn_name, at_module_level)
+
+    for top in mod.tree.body:
+        visit(top, set(), None, True)
+    return hits
+
+
+def check(program: Program) -> List[Tuple[str, int, str]]:
+    out = []
+    for rel in sorted(program.modules):
+        mod = program.modules[rel]
+        if mod.tree is not None:
+            out.extend(_check_module(rel, mod))
+    return out
+
+
+CHECKER = FlowChecker(
+    "lock-confinement",
+    "declared shared state touched outside its _LOCK_MAP lock",
+    check)

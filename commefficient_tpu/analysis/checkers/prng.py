@@ -1,0 +1,229 @@
+"""prng-keys: PRNG-key discipline by intra-function def-use analysis.
+
+DP soundness (PR 16) rests on every key being split/folded into
+*disjoint* streams and each stream consumed exactly once — reusing a
+parent key after deriving a child re-releases the same randomness the
+accountant already charged, and an unconsumed ``split`` result means
+some stream the plan budgeted for was silently dropped. This checker
+runs a linear (source-order, branch-insensitive) def-use pass over
+every function in the key-handling zones — ``privacy/``,
+``data/chaos.py``, ``asyncfed/`` — tracking variables that hold keys
+(``PRNGKey``/``split``/``fold_in``/``noise_stream``/
+``round_noise_key`` results, plus ``rng``/``key``-named parameters)
+and flags:
+
+* any use of a key after it was passed to ``split`` (the parent is
+  dead once split — JAX's own key contract);
+* a *draw* from a key that earlier served as a ``fold_in`` parent
+  (deriving a child then drawing from the parent overlaps streams —
+  repeated ``fold_in`` of the same parent stays legal: that is the
+  disjoint-stream idiom);
+* two draws from the same key variable (double consumption);
+* a ``split`` result never consumed (``_``-prefixed names opt out).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from commefficient_tpu.analysis.flow import FlowChecker, Program
+
+_SCOPE_TOPS = ("privacy", "asyncfed")
+_SCOPE_FILES = ("data/chaos.py",)
+
+#: jax.random draws that consume a key (first positional arg)
+_DRAWS = {"normal", "uniform", "bernoulli", "randint",
+          "truncated_normal", "laplace", "gumbel", "cauchy",
+          "permutation", "choice", "categorical", "bits", "gamma",
+          "beta", "exponential", "poisson", "dirichlet"}
+#: in-package draw wrappers that consume the key they are handed
+_WRAPPER_DRAWS = {"gaussian_noise", "add_table_noise"}
+_MAKERS = {"PRNGKey", "key", "noise_stream", "round_noise_key"}
+_KEYISH_PARAM = ("rng", "key")
+
+
+def _in_scope(rel: str) -> bool:
+    top = rel.split("/")[0]
+    return top in _SCOPE_TOPS or rel in _SCOPE_FILES
+
+
+def _call_leaf(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _keyish_name(name: str) -> bool:
+    low = name.lower()
+    return any(low == k or low.endswith(k) or low.startswith(k + "_")
+               for k in _KEYISH_PARAM)
+
+
+def _analyze(fn) -> List[Tuple[int, str]]:
+    #: var -> "fresh" | "split" | "folded" | "drawn"
+    state: Dict[str, str] = {}
+    #: split-result var -> [def line, used?]
+    split_results: Dict[str, List] = {}
+    hits: List[Tuple[int, str]] = []
+    own_nested = {id(g.node) for g in fn.nested}
+
+    args = fn.node.args
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)):
+        if _keyish_name(a.arg):
+            state[a.arg] = "fresh"
+
+    def mark_use(name: str, line: int, draw: bool):
+        if name in split_results:
+            split_results[name][1] = True
+        s = state.get(name)
+        if s is None:
+            return
+        if s == "split":
+            hits.append((line, f"key '{name}' used after split() — "
+                         "the parent key is dead once split"))
+        elif s == "folded" and draw:
+            hits.append((line, f"draw from key '{name}' after it was "
+                         "a fold_in parent — parent and child "
+                         "streams overlap"))
+        elif s == "drawn" and draw:
+            hits.append((line, f"key '{name}' consumed by two draws "
+                         "— each stream is single-use"))
+        if draw:
+            state[name] = "drawn"
+
+    def handle_call(call: ast.Call):
+        leaf = _call_leaf(call)
+        tgt = (call.args[0].id if call.args
+               and isinstance(call.args[0], ast.Name) else None)
+        if leaf == "split" and tgt is not None:
+            mark_use(tgt, call.lineno, draw=False)
+            state[tgt] = "split"
+        elif leaf == "fold_in" and tgt is not None:
+            mark_use(tgt, call.lineno, draw=False)
+            if state.get(tgt) in ("fresh", "folded"):
+                state[tgt] = "folded"
+        elif leaf in _DRAWS | _WRAPPER_DRAWS and tgt is not None:
+            mark_use(tgt, call.lineno, draw=True)
+        else:
+            for a in call.args:
+                if isinstance(a, ast.Name) and a.id in state:
+                    mark_use(a.id, call.lineno, draw=False)
+
+    def process_expr(expr):
+        """Calls inside ``expr`` in walk order, then bare Name
+        references to split results (returns/tuples count as
+        consumption)."""
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                handle_call(sub)
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in split_results:
+                split_results[sub.id][1] = True
+
+    def key_kind(value) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            leaf = _call_leaf(value)
+            if leaf in _MAKERS or leaf == "fold_in":
+                return "fresh"
+            if leaf == "split":
+                return "split_result"
+        if isinstance(value, ast.Subscript) \
+                and isinstance(value.value, ast.Name) \
+                and value.value.id in split_results:
+            return "fresh"
+        return None
+
+    def handle_assign(node: ast.Assign):
+        process_expr(node.value)
+        kind = key_kind(node.value)
+        if kind is None:
+            return
+        names: List[str] = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                names.extend(e.id for e in t.elts
+                             if isinstance(e, ast.Name))
+        for n in names:
+            state[n] = "fresh"
+            if kind == "split_result" and not n.startswith("_"):
+                split_results[n] = [node.lineno, False]
+
+    def walk_stmts(body):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs analyze separately
+            if isinstance(stmt, ast.Assign):
+                handle_assign(stmt)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    process_expr(stmt.value)
+            elif isinstance(stmt, (ast.Return, ast.Expr)):
+                if stmt.value is not None:
+                    process_expr(stmt.value)
+                    if isinstance(stmt, ast.Return) \
+                            and isinstance(stmt.value, ast.Name):
+                        mark_use(stmt.value.id, stmt.lineno,
+                                 draw=False)
+            elif isinstance(stmt, ast.If):
+                process_expr(stmt.test)
+                walk_stmts(stmt.body)
+                walk_stmts(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                process_expr(stmt.iter)
+                walk_stmts(stmt.body)
+                walk_stmts(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                process_expr(stmt.test)
+                walk_stmts(stmt.body)
+                walk_stmts(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    process_expr(item.context_expr)
+                walk_stmts(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                walk_stmts(stmt.body)
+                for h in stmt.handlers:
+                    walk_stmts(h.body)
+                walk_stmts(stmt.orelse)
+                walk_stmts(stmt.finalbody)
+            else:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        handle_call(sub)
+
+    walk_stmts(fn.node.body)
+
+    for name in sorted(split_results):
+        dline, used = split_results[name]
+        if not used:
+            hits.append((dline, f"split result '{name}' never "
+                         "consumed — a budgeted stream was silently "
+                         "dropped"))
+    return hits
+
+
+def check(program: Program) -> List[Tuple[str, int, str]]:
+    out = []
+    for fq in sorted(program.functions):
+        fn = program.functions[fq]
+        rel = fn.module.rel.as_posix()
+        if not _in_scope(rel):
+            continue
+        for line, msg in _analyze(fn):
+            out.append((rel, line, msg))
+    return out
+
+
+CHECKER = FlowChecker(
+    "prng-keys",
+    "PRNG key reused after split/fold or split stream dropped",
+    check)

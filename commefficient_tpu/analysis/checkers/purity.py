@@ -1,0 +1,108 @@
+"""trace-purity: host impurities reachable from a jit root.
+
+The legacy ``raw-clock``/``np-on-tracer``/``host-sync`` rules guard by
+*module path* — blunt, because a helper in ``telemetry/`` or
+``runtime/`` can still be called from a traced body. This checker
+guards by *reachability*: walk the call graph from every jit/pallas
+root and flag any clock read, print/file I/O, Python/NumPy RNG draw,
+or host-sync (``.item()``, ``device_get``, ``block_until_ready``,
+``_host``) inside a reachable function. Any of these inside a traced
+body either crashes at trace time (ConcretizationTypeError), silently
+freezes trace-time state into the compiled program (clocks, RNG — the
+round replays round 0's draw forever), or forces a hidden
+device→host sync the ledger can't attribute — all three break the
+bit-exact probe-mirror and HLO-identity contracts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from commefficient_tpu.analysis.flow import FlowChecker, Program
+
+_CLOCK_ATTRS = {"time", "perf_counter", "perf_counter_ns",
+                "monotonic", "monotonic_ns"}
+_CLOCK_NAMES = {"perf_counter", "perf_counter_ns", "monotonic",
+                "monotonic_ns"}
+_IO_NAMES = {"print", "open", "input"}
+_SYNC_ATTRS = {"device_get", "block_until_ready"}
+_SYNC_NAMES = {"device_get", "block_until_ready", "_host"}
+
+
+def _impure_sites(fn) -> List[Tuple[int, str]]:
+    """(line, what) for every host impurity lexically inside ``fn``'s
+    own body (nested defs are their own functions — reachability
+    decides whether they count, not lexical nesting)."""
+    own_nested = {id(g.node) for g in fn.nested}
+    hits: List[Tuple[int, str]] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)) \
+                    and id(child) in own_nested:
+                continue
+            if isinstance(child, ast.Call):
+                f = child.func
+                if isinstance(f, ast.Attribute):
+                    v = f.value
+                    if f.attr in _CLOCK_ATTRS \
+                            and isinstance(v, ast.Name) \
+                            and v.id == "time":
+                        hits.append((child.lineno,
+                                     f"raw clock time.{f.attr}()"))
+                    elif f.attr in _SYNC_ATTRS:
+                        hits.append((child.lineno,
+                                     f"host sync .{f.attr}()"))
+                    elif f.attr == "item" and not child.args \
+                            and not child.keywords:
+                        hits.append((child.lineno,
+                                     "host sync .item()"))
+                    elif isinstance(v, ast.Name) and v.id == "random":
+                        hits.append((child.lineno,
+                                     f"stdlib random.{f.attr}()"))
+                    elif (isinstance(v, ast.Attribute)
+                          and v.attr == "random"
+                          and isinstance(v.value, ast.Name)
+                          and v.value.id in ("np", "numpy")):
+                        hits.append((child.lineno,
+                                     f"np.random.{f.attr}()"))
+                elif isinstance(f, ast.Name):
+                    if f.id in _CLOCK_NAMES:
+                        hits.append((child.lineno,
+                                     f"raw clock {f.id}()"))
+                    elif f.id in _IO_NAMES:
+                        hits.append((child.lineno,
+                                     f"host I/O {f.id}()"))
+                    elif f.id in _SYNC_NAMES:
+                        hits.append((child.lineno,
+                                     f"host sync {f.id}()"))
+            walk(child)
+
+    walk(fn.node)
+    return hits
+
+
+def check(program: Program) -> List[Tuple[str, int, str]]:
+    out = []
+    seen = set()
+    for fq in sorted(program.traced):
+        fn = program.functions[fq]
+        rel = fn.module.rel.as_posix()
+        for line, what in _impure_sites(fn):
+            key = (rel, line, what)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append((rel, line,
+                        f"{what} in jit-reachable {fn.qual} — traced "
+                        "bodies must be host-pure (frozen constant / "
+                        "hidden sync at best, trace error at worst)"))
+    return out
+
+
+CHECKER = FlowChecker(
+    "trace-purity",
+    "host I/O, clock, RNG or sync reachable from a jit root",
+    check)

@@ -1,0 +1,694 @@
+"""flowlint: call-graph + dataflow static analysis over the package.
+
+The grep-era linter (now the *legacy* per-file rules in
+``analysis/checkers/legacy.py``) sees one line at a time; the
+invariants the daemon era depends on are *reachability* properties —
+"no clock call is reachable from a jit root", "this attribute is only
+written under that lock" — that need a symbol table and a call graph.
+This module is that engine:
+
+1. **Module table** — every ``.py`` under the package root is parsed
+   once into a :class:`ModuleInfo` (source, AST, import aliases).
+2. **Function table** — every def (top-level, method, nested) becomes
+   a :class:`FunctionInfo` with a stable qualified name
+   (``core/rounds.py::build_client_round.<locals>.emit``).
+3. **Call graph** — conservative edges: direct calls resolved through
+   import aliases and from-imports, ``self.m()`` dispatch through the
+   enclosing class and its in-package bases, single-candidate method
+   dispatch by attribute name, and *reference* edges for functions
+   passed as values (the jax higher-order idiom: ``vmap(f)``,
+   ``lax.scan(step, ...)``, ``shard_map(body, ...)``).
+4. **Roots** — jit roots (functions passed to ``jax.jit``/``pjit``/
+   ``pl.pallas_call``, ``@jit``-decorated defs, and every function
+   *defined inside* a builder whose call result is jitted — the
+   ``jax.jit(build_client_round(cfg, ...))`` pattern) and thread
+   roots (``Thread(target=...)``, ``do_*`` handlers on
+   ``BaseHTTPRequestHandler`` subclasses, ``sys.excepthook``
+   assignments).
+5. **Checkers** — :data:`commefficient_tpu.analysis.checkers
+   .FLOW_CHECKERS` run over the program; findings use the same
+   :class:`Violation` shape, ``# audit: allow(<rule>)`` waivers and
+   baseline gating as the legacy rules, so ``scripts/audit.py`` and
+   the tier-1 gate treat both tiers uniformly.
+
+The engine is pure stdlib ``ast`` — no jax import, so
+``scripts/audit.py --lint-only`` stays instant — and budgeted: a full
+build + all checkers on the whole repo must stay under 10 s
+(tests/test_flowlint.py pins it).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+#: the package's import name — stripped from absolute imports so
+#: ``commefficient_tpu.core.rounds`` and a fixture tree's bare
+#: ``core.rounds`` resolve identically
+PKG_NAME = PKG_ROOT.name
+
+WAIVER_RE = re.compile(r"#\s*audit:\s*allow\(([a-zA-Z0-9_\-, ]+)\)")
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str          # relative to the scanned root
+    line: int
+    message: str
+    waived: bool = False
+
+    def __str__(self):
+        w = " [waived]" if self.waived else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{w}"
+
+
+@dataclass
+class Rule:
+    """A per-file rule (the legacy tier): no cross-module context.
+    ``check(rel_path, source lines, parsed tree) -> [(line, msg)]``."""
+    name: str
+    description: str
+    check: Callable[[pathlib.PurePath, List[str], ast.AST],
+                    List[Tuple[int, str]]]
+
+
+@dataclass
+class FlowChecker:
+    """A whole-program checker (the flow tier).
+    ``check(program) -> [(rel_path_str, line, msg)]``."""
+    name: str
+    description: str
+    check: Callable[["Program"], List[Tuple[str, int, str]]]
+
+
+def waived_rules_at(lines: List[str], line: int) -> Set[str]:
+    """Rules waived at 1-based ``line``: an ``# audit: allow(...)``
+    comment on the line itself or the line directly above."""
+    out: Set[str] = set()
+    for lno in (line, line - 1):
+        if 1 <= lno <= len(lines):
+            m = WAIVER_RE.search(lines[lno - 1])
+            if m:
+                out.update(x.strip() for x in m.group(1).split(","))
+    return out
+
+
+# --- module / function tables ------------------------------------------
+
+
+class ModuleInfo:
+    """One parsed source file: AST + import resolution context."""
+
+    def __init__(self, rel: pathlib.PurePath, path: pathlib.Path,
+                 text: str):
+        self.rel = rel
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        #: local alias -> dotted module path (``import a.b as c``)
+        self.imports: Dict[str, str] = {}
+        #: local name -> (dotted module, original name) from-imports
+        self.import_names: Dict[str, Tuple[str, str]] = {}
+        #: top-level function name -> FunctionInfo
+        self.functions: Dict[str, "FunctionInfo"] = {}
+        #: class name -> ClassInfo
+        self.classes: Dict[str, "ClassInfo"] = {}
+        try:
+            self.tree = ast.parse(text, filename=str(path))
+        except SyntaxError as e:
+            self.syntax_error = e
+
+    @property
+    def dotted(self) -> str:
+        parts = list(self.rel.parts)
+        if parts[-1].endswith(".py"):
+            parts[-1] = parts[-1][:-3]
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def _collect_imports(self):
+        pkg_parts = list(self.rel.parts[:-1])  # containing package
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    self.imports[local] = _strip_pkg(
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    mod = _strip_pkg(node.module or "")
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.import_names[local] = (mod, a.name)
+
+
+def _strip_pkg(dotted: str) -> str:
+    if dotted == PKG_NAME:
+        return ""
+    if dotted.startswith(PKG_NAME + "."):
+        return dotted[len(PKG_NAME) + 1:]
+    return dotted
+
+
+class ClassInfo:
+    def __init__(self, module: ModuleInfo, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        #: method name -> FunctionInfo
+        self.methods: Dict[str, "FunctionInfo"] = {}
+        #: base-class name expressions, as dotted strings
+        self.bases: List[str] = [b for b in
+                                 (_dotted_of(e) for e in node.bases)
+                                 if b]
+
+
+class FunctionInfo:
+    def __init__(self, module: ModuleInfo, node, qual: str,
+                 cls: Optional[ClassInfo], parent: Optional[
+                     "FunctionInfo"]):
+        self.module = module
+        self.node = node
+        self.qual = qual                    # dotted within the module
+        self.cls = cls
+        self.parent = parent
+        self.nested: List["FunctionInfo"] = []
+        #: resolved outgoing edges (call + reference), filled by
+        #: Program._link
+        self.edges: Set[str] = set()
+
+    @property
+    def fq(self) -> str:
+        return f"{self.module.rel.as_posix()}::{self.qual}"
+
+    def all_nested(self) -> List["FunctionInfo"]:
+        out = []
+        stack = list(self.nested)
+        while stack:
+            f = stack.pop()
+            out.append(f)
+            stack.extend(f.nested)
+        return out
+
+
+def _dotted_of(expr) -> Optional[str]:
+    """``a.b.c`` expression -> "a.b.c" (None for anything else)."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+#: jax transforms that wrap a function and pass tracing through —
+#: ``jit(value_and_grad(f))`` roots f, ``vmap(g)`` inside a traced
+#: body reaches g
+_PASSTHROUGH_WRAPPERS = {
+    "value_and_grad", "grad", "vmap", "pmap", "checkpoint", "remat",
+    "named_call", "custom_vjp", "custom_jvp", "partial", "shard_map",
+}
+
+#: higher-order jax calls whose function-valued args execute traced
+_HIGHER_ORDER = {
+    "scan", "while_loop", "cond", "fori_loop", "switch", "map",
+    "associative_scan", "custom_root", "custom_linear_solve",
+} | _PASSTHROUGH_WRAPPERS
+
+_JIT_NAMES = {"jit", "pjit"}
+_PALLAS_NAMES = {"pallas_call"}
+_THREAD_CTORS = {"Thread"}
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
+
+
+class Program:
+    """The whole-package analysis context handed to flow checkers."""
+
+    def __init__(self, root: pathlib.Path):
+        self.root = root
+        self.modules: Dict[str, ModuleInfo] = {}          # rel posix
+        self.functions: Dict[str, FunctionInfo] = {}      # fq
+        self._by_dotted: Dict[str, ModuleInfo] = {}
+        self._methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        self.jit_roots: Set[str] = set()
+        self.thread_roots: Set[str] = set()
+        self._traced: Optional[Set[str]] = None
+        self._threaded: Optional[Set[str]] = None
+        self._ctor_maps: Dict[int, Dict[str, Optional[str]]] = {}
+        for path in sorted(root.rglob("*.py")):
+            rel = path.relative_to(root)
+            mod = ModuleInfo(rel, path, path.read_text())
+            self.modules[rel.as_posix()] = mod
+            if mod.tree is None:
+                continue
+            mod._collect_imports()
+            self._by_dotted[mod.dotted] = mod
+            self._collect_defs(mod)
+        for mod in self.modules.values():
+            if mod.tree is not None:
+                self._link(mod)
+                self._find_roots(mod)
+
+    # ----------------------------------------------------- table build
+
+    def _collect_defs(self, mod: ModuleInfo):
+        def visit(node, qual, cls, parent):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = (f"{qual}.<locals>.{child.name}" if parent
+                         else f"{qual}.{child.name}" if qual
+                         else child.name)
+                    fn = FunctionInfo(mod, child, q, cls, parent)
+                    self.functions[fn.fq] = fn
+                    if parent is not None:
+                        parent.nested.append(fn)
+                    elif cls is not None:
+                        cls.methods[child.name] = fn
+                        self._methods_by_name.setdefault(
+                            child.name, []).append(fn)
+                    else:
+                        mod.functions[child.name] = fn
+                    visit(child, q, cls, fn)
+                elif isinstance(child, ast.ClassDef):
+                    if parent is None and cls is None:
+                        ci = ClassInfo(mod, child)
+                        mod.classes[child.name] = ci
+                        visit(child, child.name, ci, None)
+                    else:  # nested class: index methods, no dispatch
+                        visit(child, f"{qual}.{child.name}", cls,
+                              parent)
+
+        visit(mod.tree, "", None, None)
+
+    # ----------------------------------------------------- resolution
+
+    def module_of(self, dotted: str) -> Optional[ModuleInfo]:
+        dotted = _strip_pkg(dotted)
+        return self._by_dotted.get(dotted)
+
+    def _class_of(self, mod: ModuleInfo, name: str) \
+            -> Optional[ClassInfo]:
+        if name in mod.classes:
+            return mod.classes[name]
+        if name in mod.import_names:
+            src, orig = mod.import_names[name]
+            target = self.module_of(src)
+            if target is not None:
+                return target.classes.get(orig)
+        return None
+
+    def _method_on(self, ci: ClassInfo, name: str, _depth=0) \
+            -> Optional[FunctionInfo]:
+        if name in ci.methods:
+            return ci.methods[name]
+        if _depth > 4:
+            return None
+        for base in ci.bases:
+            bci = self._class_of(ci.module, base.split(".")[-1])
+            if bci is not None:
+                hit = self._method_on(bci, name, _depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _ctor_map(self, owner) -> Dict[str, Optional[str]]:
+        """name -> constructor leaf name for every ``name = Ctor(...)``
+        assignment in ``owner``'s scope (FunctionInfo or ModuleInfo);
+        None marks names assigned ambiguously / from non-calls. One
+        walk per scope, memoized — lookups must stay O(1)."""
+        key = id(owner)
+        cached = self._ctor_maps.get(key)
+        if cached is not None:
+            return cached
+        tree = owner.node if isinstance(owner, FunctionInfo) \
+            else owner.tree
+        m: Dict[str, Optional[str]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            leaf = None
+            if isinstance(node.value, ast.Call):
+                f = node.value.func
+                leaf = (f.id if isinstance(f, ast.Name)
+                        else f.attr if isinstance(f, ast.Attribute)
+                        else None)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if t.id in m and m[t.id] != leaf:
+                        m[t.id] = None
+                    else:
+                        m[t.id] = leaf
+        self._ctor_maps[key] = m
+        return m
+
+    def _local_ctor_class(self, name: str,
+                          fn: Optional[FunctionInfo],
+                          mod: ModuleInfo) -> Optional[ClassInfo]:
+        """The class a local variable was constructed from, when every
+        visible ``name = Ctor(...)`` assignment agrees: one-hop local
+        type inference for method dispatch."""
+        scope = fn
+        while scope is not None:
+            m = self._ctor_map(scope)
+            if name in m:
+                leaf = m[name]
+                return None if leaf is None \
+                    else self._class_of(mod, leaf)
+            scope = scope.parent
+        m = self._ctor_map(mod)
+        if name in m and m[name] is not None:
+            return self._class_of(mod, m[name])
+        return None
+
+    def resolve(self, expr, fn: Optional[FunctionInfo],
+                mod: ModuleInfo) -> Optional[FunctionInfo]:
+        """Resolve a callee/reference expression to a FunctionInfo, or
+        None (external / ambiguous — conservatively no edge)."""
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            # nested function visible in the enclosing scope chain
+            scope = fn
+            while scope is not None:
+                for g in scope.nested:
+                    if g.node.name == name:
+                        return g
+                scope = scope.parent
+            if name in mod.functions:
+                return mod.functions[name]
+            if name in mod.classes:
+                return mod.classes[name].methods.get("__init__")
+            if name in mod.import_names:
+                src, orig = mod.import_names[name]
+                target = self.module_of(src)
+                if target is not None:
+                    if orig in target.functions:
+                        return target.functions[orig]
+                    if orig in target.classes:
+                        return target.classes[orig].methods.get(
+                            "__init__")
+            return None
+        if isinstance(expr, ast.Attribute):
+            base, attr = expr.value, expr.attr
+            # self.m() through the enclosing class (+ bases)
+            if isinstance(base, ast.Name) and base.id in ("self",
+                                                          "cls") \
+                    and fn is not None and fn.cls is not None:
+                return self._method_on(fn.cls, attr)
+            # module alias: rounds.build_x() / pkg.core.rounds.f()
+            dotted = _dotted_of(base)
+            if dotted is not None:
+                target = None
+                head = dotted.split(".")[0]
+                if head in mod.imports:
+                    target = self.module_of(
+                        ".".join([mod.imports[head]]
+                                 + dotted.split(".")[1:]))
+                    if target is None:
+                        # alias of an EXTERNAL module (jnp, np, …):
+                        # its attributes are never package functions —
+                        # no dispatch (jnp.take must not resolve to
+                        # some class's .take method)
+                        return None
+                if target is None:
+                    target = self.module_of(dotted)
+                if target is not None:
+                    if attr in target.functions:
+                        return target.functions[attr]
+                    if attr in target.classes:
+                        return target.classes[attr].methods.get(
+                            "__init__")
+                    return None
+                # ClassName.method on an in-scope class
+                ci = self._class_of(mod, dotted.split(".")[-1])
+                if ci is not None:
+                    return self._method_on(ci, attr)
+            # local constructor-type inference: `x = ClassName(...)`
+            # in the enclosing function (or at module level), then
+            # `x.m()` dispatches to ClassName.m — no global
+            # single-candidate dispatch (an array's `.take()` must
+            # not resolve to an unrelated class's method)
+            if isinstance(base, ast.Name):
+                ci = self._local_ctor_class(base.id, fn, mod)
+                if ci is not None:
+                    return self._method_on(ci, attr)
+        return None
+
+    # ----------------------------------------------------- call graph
+
+    def _link(self, mod: ModuleInfo):
+        """Fill ``FunctionInfo.edges`` for every function in ``mod``:
+        direct calls plus reference edges for function-valued names
+        (passed to vmap/scan/… or stored — address-taken is an edge)."""
+        def link_body(fn: FunctionInfo):
+            own_nested = {id(g.node) for g in fn.nested}
+
+            def walk(node):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                            and id(child) in own_nested:
+                        continue  # nested defs link themselves
+                    if isinstance(child, ast.Call):
+                        callee = self.resolve(child.func, fn, mod)
+                        if callee is not None:
+                            fn.edges.add(callee.fq)
+                    elif isinstance(child, (ast.Name, ast.Attribute)):
+                        ref = self.resolve(child, fn, mod)
+                        if ref is not None:
+                            fn.edges.add(ref.fq)
+                    walk(child)
+
+            walk(fn.node)
+
+        for f in self.functions.values():
+            if f.module is mod:
+                link_body(f)
+
+    # ----------------------------------------------------- roots
+
+    def _jit_arg_roots(self, arg, fn, mod, depth=0) \
+            -> List[FunctionInfo]:
+        """Functions rooted by ``jit(<arg>)``: the function itself, or
+        — for the builder idiom ``jit(build_round(cfg, ...))`` — every
+        function defined inside the builder (its returned closure and
+        that closure's helpers all live there)."""
+        if depth > 4 or arg is None:
+            return []
+        direct = self.resolve(arg, fn, mod)
+        if direct is not None:
+            return [direct]
+        if isinstance(arg, ast.Call):
+            callee_name = (arg.func.attr
+                           if isinstance(arg.func, ast.Attribute)
+                           else arg.func.id
+                           if isinstance(arg.func, ast.Name) else None)
+            if callee_name in _PASSTHROUGH_WRAPPERS and arg.args:
+                return self._jit_arg_roots(arg.args[0], fn, mod,
+                                           depth + 1)
+            builder = self.resolve(arg.func, fn, mod)
+            if builder is not None:
+                roots = builder.all_nested()
+                # builders that `return sibling_builder(...)` — the
+                # 2D-mesh variants — root the sibling's closures too
+                for n in ast.walk(builder.node):
+                    if isinstance(n, ast.Return) \
+                            and isinstance(n.value, ast.Call):
+                        sib = self.resolve(n.value.func, builder,
+                                           builder.module)
+                        if sib is not None and sib is not builder:
+                            roots.extend(sib.all_nested())
+                return roots
+        if isinstance(arg, ast.Name) and fn is not None:
+            # one-hop local: fn body has `f = <expr>` then `jit(f)`
+            assigned = None
+            for n in ast.walk(fn.node):
+                if isinstance(n, ast.Assign) \
+                        and any(isinstance(t, ast.Name)
+                                and t.id == arg.id
+                                for t in n.targets):
+                    assigned = n.value
+            if assigned is not None:
+                return self._jit_arg_roots(assigned, fn, mod,
+                                           depth + 1)
+        return []
+
+    def _enclosing(self, mod: ModuleInfo) -> Dict[int, FunctionInfo]:
+        """id(AST node) -> innermost enclosing FunctionInfo."""
+        owner: Dict[int, FunctionInfo] = {}
+        for f in self.functions.values():
+            if f.module is not mod:
+                continue
+            own_nested = {id(g.node) for g in f.nested}
+
+            def mark(node, f=f, own_nested=own_nested):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                            and id(child) in own_nested:
+                        continue
+                    owner[id(child)] = f
+                    mark(child)
+
+            mark(f.node)
+        return owner
+
+    def _find_roots(self, mod: ModuleInfo):
+        owner = self._enclosing(mod)
+        for node in ast.walk(mod.tree):
+            fn = owner.get(id(node))
+            if isinstance(node, ast.Call):
+                name = (node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                        if isinstance(node.func, ast.Name) else None)
+                if name in _JIT_NAMES and node.args:
+                    for root in self._jit_arg_roots(node.args[0], fn,
+                                                    mod):
+                        self.jit_roots.add(root.fq)
+                elif name in _PALLAS_NAMES and node.args:
+                    for root in self._jit_arg_roots(node.args[0], fn,
+                                                    mod):
+                        self.jit_roots.add(root.fq)
+                elif name in _THREAD_CTORS:
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            t = self.resolve(kw.value, fn, mod)
+                            if t is not None:
+                                self.thread_roots.add(t.fq)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    tgt = dec.func if isinstance(dec, ast.Call) \
+                        else dec
+                    dn = _dotted_of(tgt) or ""
+                    leaf = dn.split(".")[-1]
+                    if leaf in _JIT_NAMES:
+                        self._root_def(node)
+                    elif leaf == "partial" and isinstance(dec,
+                                                          ast.Call) \
+                            and dec.args:
+                        inner = _dotted_of(dec.args[0]) or ""
+                        if inner.split(".")[-1] in _JIT_NAMES:
+                            self._root_def(node)
+            elif isinstance(node, ast.Assign):
+                # sys.excepthook = hook  -> thread-ish root (runs on
+                # an arbitrary crashing thread)
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == "excepthook":
+                        hook = self.resolve(node.value, fn, mod)
+                        if hook is not None:
+                            self.thread_roots.add(hook.fq)
+        # do_* handlers on HTTP handler subclasses run on the server's
+        # worker threads
+        for ci in mod.classes.values():
+            if any(b.split(".")[-1] in _HANDLER_BASES
+                   for b in ci.bases):
+                for name, m in ci.methods.items():
+                    if name.startswith("do_"):
+                        self.thread_roots.add(m.fq)
+
+    def _root_def(self, node):
+        for f in self.functions.values():
+            if f.node is node:
+                self.jit_roots.add(f.fq)
+                return
+
+    # ----------------------------------------------------- reachability
+
+    def reachable_from(self, roots: Set[str]) -> Set[str]:
+        seen = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            fq = stack.pop()
+            if fq in seen:
+                continue
+            seen.add(fq)
+            stack.extend(e for e in self.functions[fq].edges
+                         if e not in seen)
+        return seen
+
+    @property
+    def traced(self) -> Set[str]:
+        """Functions reachable from any jit/pallas root (the roots'
+        nested defs included — a closure defined inside a traced body
+        is traced when referenced)."""
+        if self._traced is None:
+            self._traced = self.reachable_from(self.jit_roots)
+        return self._traced
+
+    @property
+    def threaded(self) -> Set[str]:
+        if self._threaded is None:
+            self._threaded = self.reachable_from(self.thread_roots)
+        return self._threaded
+
+
+# --- engine entry points -----------------------------------------------
+
+
+def build_program(root: Optional[pathlib.Path] = None) -> Program:
+    return Program(PKG_ROOT if root is None else pathlib.Path(root))
+
+
+def run_flow(root: Optional[pathlib.Path] = None,
+             checkers=None,
+             program: Optional[Program] = None) -> List[Violation]:
+    """Run the flow-tier checkers; returns all violations, waived
+    included (callers gate on ``unwaived``-style filtering, same as
+    the legacy tier)."""
+    from commefficient_tpu.analysis.checkers import FLOW_CHECKERS
+    if program is None:
+        program = build_program(root)
+    checkers = FLOW_CHECKERS if checkers is None else checkers
+    out: List[Violation] = []
+    for checker in checkers:
+        for rel, line, msg in checker.check(program):
+            mod = program.modules.get(rel)
+            lines = mod.lines if mod is not None else []
+            waived = checker.name in waived_rules_at(lines, line)
+            out.append(Violation(checker.name, rel, line, msg,
+                                 waived=waived))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def run_file_rules(root: Optional[pathlib.Path], rules,
+                   program: Optional[Program] = None) \
+        -> List[Violation]:
+    """Drive the per-file (legacy) rules over every module. Shares
+    the parsed module table with the flow tier when ``program`` is
+    given, so one parse serves both."""
+    if program is None:
+        program = build_program(root)
+    out: List[Violation] = []
+    for rel in sorted(program.modules):
+        mod = program.modules[rel]
+        if mod.tree is None:
+            e = mod.syntax_error
+            out.append(Violation("syntax", rel, e.lineno or 0,
+                                 f"unparseable: {e.msg}"))
+            continue
+        for rule in rules:
+            for line, msg in rule.check(mod.rel, mod.lines, mod.tree):
+                waived = rule.name in waived_rules_at(mod.lines, line)
+                out.append(Violation(rule.name, rel, line, msg,
+                                     waived=waived))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out

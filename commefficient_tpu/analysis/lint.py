@@ -1,858 +1,66 @@
-"""AST rule engine over the package source — the grown-up form of the
-old grep guards (raw-clock guard, metrics_host-span guard in
-tests/test_telemetry.py), which now delegate here so there is a single
-source of truth for each rule.
+"""Lint facade over the flowlint engine (analysis/flow.py).
 
-Waivers: ``# audit: allow(<rule>[, <rule>...])`` on the offending line
-or the line directly above suppresses the hit. Waived violations are
-still reported (``waived=True``) and recorded in the audit baseline,
-so a *new* waiver is a visible diff, not a silent hole.
+Historically this module *was* the linter: 900+ lines of per-file AST
+rules. The rules now live in ``analysis/checkers/legacy.py`` (moved
+verbatim — findings are pinned identical by tests/test_flowlint.py)
+and are driven by the shared parse in ``analysis.flow``, alongside
+the whole-program flow checkers (trace-purity, prng-keys,
+wire-dtype-crossing, lock-confinement). This facade keeps the stable
+public surface every caller knows:
 
-Scoping is by path role relative to the package root:
+* ``run_lint(root, rules)`` — the per-file (legacy) tier only, same
+  signature and findings as ever;
+* ``run_all(root)`` — both tiers off one parse: legacy rules + flow
+  checkers (what ``scripts/audit.py`` gates by default);
+* ``unwaived`` / ``stale_waivers`` / ``lint_report`` — gating
+  helpers, now aware of both tiers' rule names so a waiver naming a
+  flow rule is legal and a typo'd one is still a hard failure.
 
-* ``telemetry/`` owns the raw clocks and the host transfer of ledger
-  scalars — exempt from ``raw-clock`` and the span rules.
-* ``core/`` and ``ops/`` are *compiled scope*: bodies there run under
-  jit tracing, so Python RNG is a frozen-constant bug and
-  ``np.asarray`` inside a traced closure is a tracer leak.
-* ``runtime/``, ``train/``, ``clientstore/`` are the host hot path:
-  device syncs (``.item()``, ``jax.device_get``, ``block_until_ready``,
-  ``_host``) must sit inside a telemetry ``span(...)`` block so the
-  ledger attributes their cost.
+Waivers: ``# audit: allow(<rule>[, <rule>...])`` on the offending
+line or the line directly above suppresses the hit. Waived
+violations are still reported (``waived=True``) and recorded in the
+audit baseline, so a *new* waiver is a visible diff, not a silent
+hole.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
-from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Set, Tuple
-
-PKG_ROOT = pathlib.Path(__file__).resolve().parents[1]
-
-WAIVER_RE = re.compile(r"#\s*audit:\s*allow\(([a-zA-Z0-9_\-, ]+)\)")
-
-COMPILED_SCOPE = ("core", "ops")
-HOST_HOT_PATH = ("runtime", "train", "clientstore")
-
-
-@dataclass
-class Violation:
-    rule: str
-    path: str          # relative to the scanned root
-    line: int
-    message: str
-    waived: bool = False
-
-    def __str__(self):
-        w = " [waived]" if self.waived else ""
-        return f"{self.path}:{self.line}: {self.rule}: {self.message}{w}"
-
-
-@dataclass
-class Rule:
-    name: str
-    description: str
-    # (rel_path, source lines, parsed tree) -> [(line, message)]
-    check: Callable[[pathlib.PurePath, List[str], ast.AST],
-                    List[Tuple[int, str]]]
-
-
-def _top(rel: pathlib.PurePath) -> str:
-    return rel.parts[0] if rel.parts else ""
-
-
-# --- rule: raw-clock ---------------------------------------------------
-
-
-_CLOCK_ATTRS = {"time", "perf_counter", "perf_counter_ns",
-                "monotonic", "monotonic_ns"}
-
-
-def _check_raw_clock(rel, lines, tree):
-    """time.time()/perf_counter() outside telemetry/ — all host timing
-    must flow through telemetry.clock so spans, Timer and the ledger
-    agree on what a second is."""
-    if _top(rel) == "telemetry":
-        return []
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if (isinstance(f, ast.Attribute) and f.attr in _CLOCK_ATTRS
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "time"):
-            hits.append((node.lineno,
-                         f"raw clock time.{f.attr}() — use "
-                         "telemetry.clock.wall/tick"))
-        elif (isinstance(f, ast.Name)
-                and f.id in {"perf_counter", "perf_counter_ns",
-                             "monotonic", "monotonic_ns"}):
-            hits.append((node.lineno,
-                         f"raw clock {f.id}() — use "
-                         "telemetry.clock.wall/tick"))
-    return hits
-
-
-# --- rule: probe-transfer-span -----------------------------------------
-
-
-def _check_probe_transfer_span(rel, lines, tree):
-    """Probe values may be materialised (_host / jax.device_get) only
-    inside a span("metrics_host") block — the sync point IS the
-    probes' runtime cost, so it must be ledger-attributed. Line-based
-    on purpose: byte-for-byte the semantics of the original grep guard
-    it replaced (context naming probes within +-3 lines, span within
-    the previous 10)."""
-    if _top(rel) == "telemetry":
-        return []
-    hits = []
-    for i, line in enumerate(lines):
-        if "_host(" not in line and "device_get(" not in line:
-            continue
-        stripped = line.lstrip()
-        if stripped.startswith("#") or stripped.startswith("def "):
-            continue
-        ctx = "\n".join(lines[max(0, i - 3):i + 2])
-        if "probe" not in ctx.lower() and "sprobes" not in ctx:
-            continue
-        back = "\n".join(lines[max(0, i - 10):i + 1])
-        if 'span("metrics_host")' not in back:
-            hits.append((i + 1, "probe value crosses to the host "
-                         'outside a span("metrics_host") block'))
-    return hits
-
-
-# --- rule: host-sync ---------------------------------------------------
-
-
-def _span_guarded_calls(tree) -> Set[int]:
-    """Line numbers of Call nodes lexically inside a ``with
-    <x>.span(...)`` block (any span name: the requirement is that the
-    sync is *attributed*, which span the caller judges)."""
-    guarded: Set[int] = set()
-
-    def visit(node, in_span):
-        if isinstance(node, ast.With):
-            for item in node.items:
-                c = item.context_expr
-                if (isinstance(c, ast.Call)
-                        and isinstance(c.func, ast.Attribute)
-                        and c.func.attr == "span"):
-                    in_span = True
-        if isinstance(node, ast.Call) and in_span:
-            guarded.add(node.lineno)
-        for child in ast.iter_child_nodes(node):
-            visit(child, in_span)
-
-    visit(tree, False)
-    return guarded
-
-
-def _check_host_sync(rel, lines, tree):
-    """Device syncs on the host hot path outside any telemetry span:
-    each one is a hidden blocking round-trip the ledger cannot see."""
-    if _top(rel) not in HOST_HOT_PATH:
-        return []
-    guarded = _span_guarded_calls(tree)
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or node.lineno in guarded:
-            continue
-        f = node.func
-        name = None
-        if isinstance(f, ast.Attribute):
-            if f.attr == "item" and not node.args and not node.keywords:
-                name = ".item()"
-            elif f.attr in {"device_get", "block_until_ready"}:
-                name = f.attr
-        elif isinstance(f, ast.Name):
-            if f.id in {"device_get", "block_until_ready", "_host"}:
-                name = f.id
-        if name:
-            hits.append((node.lineno,
-                         f"host sync {name} outside a telemetry "
-                         "span block"))
-    return hits
-
-
-# --- rule: np-on-tracer ------------------------------------------------
-
-
-def _nested_function_lines(tree) -> Set[int]:
-    """Line ranges of functions *defined inside other functions* — in
-    compiled-scope modules those closures are what jit traces."""
-    spans: List[Tuple[int, int]] = []
-
-    def visit(node, depth):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if depth >= 1:
-                spans.append((node.lineno, node.end_lineno or node.lineno))
-            depth += 1
-        for child in ast.iter_child_nodes(node):
-            visit(child, depth)
-
-    visit(tree, 0)
-    covered: Set[int] = set()
-    for a, b in spans:
-        covered.update(range(a, b + 1))
-    return covered
-
-
-def _check_np_on_tracer(rel, lines, tree):
-    """np.asarray / np.array inside a traced closure in compiled scope
-    forces the tracer to the host (ConcretizationTypeError at best, a
-    silent device->host sync via __array__ at worst). Module-level
-    numpy (hash-constant setup in ops/sketch.py and friends) is fine —
-    only *nested* function bodies are traced."""
-    if _top(rel) not in COMPILED_SCOPE:
-        return []
-    traced = _nested_function_lines(tree)
-    hits = []
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call) and node.lineno in traced
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in {"asarray", "array"}
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id in {"np", "numpy"}):
-            hits.append((node.lineno,
-                         f"np.{node.func.attr}() inside a traced "
-                         "closure — use jnp, or hoist to setup"))
-    return hits
-
-
-# --- rule: python-rng --------------------------------------------------
-
-
-def _check_python_rng(rel, lines, tree):
-    """Stdlib/NumPy RNG in compiled scope: traced once, the draw
-    freezes into the program as a constant — every execution reuses
-    round 0's randomness. Use jax.random with threaded keys."""
-    if _top(rel) not in COMPILED_SCOPE:
-        return []
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Attribute):
-            continue
-        # np.random.<fn> / numpy.random.<fn>
-        v = node.value
-        if (isinstance(v, ast.Attribute) and v.attr == "random"
-                and isinstance(v.value, ast.Name)
-                and v.value.id in {"np", "numpy"}):
-            hits.append((node.lineno,
-                         f"np.random.{node.attr} in compiled scope — "
-                         "use jax.random"))
-        # random.<fn> on the stdlib module
-        elif (isinstance(v, ast.Name) and v.id == "random"):
-            hits.append((node.lineno,
-                         f"random.{node.attr} in compiled scope — "
-                         "use jax.random"))
-    return hits
-
-
-# --- rule: noise-confinement -------------------------------------------
-
-
-_NOISE_FNS = {"PRNGKey", "normal", "truncated_normal", "laplace",
-              "gumbel", "cauchy"}
-
-
-def _check_noise_confinement(rel, lines, tree):
-    """Raw ``jax.random.PRNGKey``/``jax.random.normal`` (and friends)
-    outside ``privacy/`` are hard audit failures: every noise draw and
-    every key-stream genesis must route through privacy/mechanism.py
-    (``noise_stream`` / ``gaussian_noise`` / ``add_table_noise``) so
-    the DP accountant's claim — "all injected randomness is calibrated
-    and charged" — is checkable by construction. A stray
-    ``jax.random.normal`` anywhere else is either unaccounted noise
-    (a silent privacy hole) or an unseeded stream the replay contract
-    cannot reproduce. Exempt: ``privacy/`` (the owner), ``models/``
-    (parameter *initialisation* is pre-release randomness, not noise
-    injected into a private release), and ``data/chaos.py`` (the
-    test/bench-only fault injector, already fenced off by
-    chaos-confinement). Key *consumption* — ``fold_in``, ``split``,
-    threading keys through round plans — stays legal everywhere; only
-    genesis and draws are confined."""
-    if _top(rel) in ("privacy", "models") \
-            or rel.as_posix() == "data/chaos.py":
-        return []
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if not (isinstance(f, ast.Attribute) and f.attr in _NOISE_FNS):
-            continue
-        v = f.value
-        jax_random = (isinstance(v, ast.Attribute)
-                      and v.attr == "random"
-                      and isinstance(v.value, ast.Name)
-                      and v.value.id == "jax")
-        bare_random = isinstance(v, ast.Name) and v.id == "random"
-        if not (jax_random or bare_random):
-            continue
-        if f.attr == "PRNGKey":
-            hits.append((node.lineno,
-                         "raw jax.random.PRNGKey() outside privacy/ — "
-                         "mint streams via privacy.noise_stream so "
-                         "every injected-randomness source has one "
-                         "accountable owner"))
-        else:
-            hits.append((node.lineno,
-                         f"raw jax.random.{f.attr}() noise draw "
-                         "outside privacy/ — route through "
-                         "privacy.gaussian_noise/add_table_noise so "
-                         "the accountant charges it"))
-    return hits
-
-
-# --- rule: raw-devices -------------------------------------------------
-
-
-def _check_raw_devices(rel, lines, tree):
-    """jax.devices()/jax.local_devices() inside telemetry/: the
-    observatory must see the fleet through parallel/mesh.py
-    (``topology_summary`` / ``first_local_device``) so device
-    resolution has ONE owner — raw enumeration here silently disagrees
-    with the mesh on subset-mesh and multi-process runs."""
-    if _top(rel) != "telemetry":
-        return []
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if (isinstance(f, ast.Attribute)
-                and f.attr in {"devices", "local_devices"}
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "jax"):
-            hits.append((node.lineno,
-                         f"raw jax.{f.attr}() in telemetry/ — resolve "
-                         "devices via parallel.mesh "
-                         "(topology_summary/first_local_device)"))
-    return hits
-
-
-# --- rule: chaos-confinement -------------------------------------------
-
-
-def _is_chaos_module(modname) -> bool:
-    return bool(modname) and modname.split(".")[-1] == "chaos"
-
-
-def _check_chaos_confinement(rel, lines, tree):
-    """``data/chaos.py`` (byzantine/fault injection) is strictly a
-    test/bench facility: no production module may import it, so the
-    adversarial hooks can never ride along into a real run. Tests,
-    benches and scripts live outside the scanned package root and wire
-    chaos in through the public hooks (``transmit_transform``, loader
-    wrapping) instead."""
-    if rel.as_posix() == "data/chaos.py":
-        return []
-    hits = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if _is_chaos_module(a.name):
-                    hits.append((node.lineno,
-                                 f"import {a.name} outside "
-                                 "data/chaos.py — chaos is "
-                                 "test/bench-only"))
-        elif isinstance(node, ast.ImportFrom):
-            if _is_chaos_module(node.module) or any(
-                    a.name == "chaos" for a in node.names):
-                src = ("." * node.level) + (node.module or "")
-                hits.append((node.lineno,
-                             f"from {src} import ... pulls in "
-                             "data/chaos.py — chaos is "
-                             "test/bench-only"))
-    return hits
-
-
-# --- rule: fedservice-confinement --------------------------------------
-
-
-def _is_fedservice_module(modname) -> bool:
-    return bool(modname) and "fedservice" in modname.split(".")
-
-
-def _check_fedservice_confinement(rel, lines, tree):
-    """The multi-tenant daemon (``fedservice/``) sits ON TOP of the
-    runtime — it orchestrates FedModels, it is never a dependency of
-    one. A runtime module importing the service would invert the
-    layering (and let control-plane state leak into the bit-identical
-    single-job data plane), so outside ``fedservice/`` itself no
-    production module may import it or name its entry points.
-    Tests, benches and scripts live outside the scanned package root
-    and drive the daemon freely."""
-    if _top(rel) == "fedservice":
-        return []
-    hits = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if _is_fedservice_module(a.name):
-                    hits.append((node.lineno,
-                                 f"import {a.name} outside "
-                                 "fedservice/ — the daemon is a "
-                                 "top-layer orchestrator"))
-        elif isinstance(node, ast.ImportFrom):
-            if _is_fedservice_module(node.module) or any(
-                    a.name == "fedservice" for a in node.names):
-                src = ("." * node.level) + (node.module or "")
-                hits.append((node.lineno,
-                             f"from {src} import ... pulls in "
-                             "fedservice/ — the daemon is a "
-                             "top-layer orchestrator"))
-        elif isinstance(node, ast.Name) and \
-                node.id in ("FedService", "JobSpec"):
-            hits.append((node.lineno,
-                         f"{node.id} referenced outside fedservice/ "
-                         "— production modules must not depend on "
-                         "the daemon"))
-    return hits
-
-
-# --- rule: arrival-confinement -----------------------------------------
-
-
-def _check_arrival_confinement(rel, lines, tree):
-    """Arrival-process injection (asyncfed) is strictly a
-    test/bench facility, mirroring chaos-confinement: production
-    package modules must never construct an ``ArrivalSchedule`` (it
-    lives in data/chaos.py — importing it is already an import
-    violation; naming it at all is flagged here as defense in depth)
-    nor CALL ``attach_arrival_process`` with a schedule. The
-    forwarding hooks themselves (``def attach_arrival_process`` on
-    FedModel/AsyncRoundDriver, including the one-line relay in their
-    bodies) are the sanctioned injection surface for code living
-    outside the package root."""
-    if rel.as_posix() == "data/chaos.py":
-        return []
-    # line ranges of the sanctioned forwarding defs: a call to the
-    # inner hook from inside `def attach_arrival_process` is the
-    # relay, not an injection
-    relay = []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name == "attach_arrival_process":
-            relay.append((node.lineno, node.end_lineno or node.lineno))
-    hits = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name) and \
-                node.id == "ArrivalSchedule":
-            hits.append((node.lineno,
-                         "ArrivalSchedule named in a production "
-                         "module — arrival processes are "
-                         "test/bench-only (inject via "
-                         "attach_arrival_process from outside the "
-                         "package)"))
-        elif isinstance(node, ast.Attribute) and \
-                node.attr == "ArrivalSchedule":
-            hits.append((node.lineno,
-                         "ArrivalSchedule referenced in a production "
-                         "module — arrival processes are "
-                         "test/bench-only"))
-        elif isinstance(node, ast.Call):
-            f = node.func
-            name = (f.attr if isinstance(f, ast.Attribute)
-                    else f.id if isinstance(f, ast.Name) else None)
-            if name != "attach_arrival_process":
-                continue
-            if any(lo <= node.lineno <= hi for lo, hi in relay):
-                continue
-            hits.append((node.lineno,
-                         "attach_arrival_process() called from a "
-                         "production module — arrival injection is "
-                         "test/bench-only"))
-    return hits
-
-
-# --- rule: inline-partition-spec ---------------------------------------
-
-
-_SPEC_NAMES = {"PartitionSpec", "NamedSharding"}
-
-
-def _check_inline_partition_spec(rel, lines, tree):
-    """PartitionSpec/NamedSharding literals outside parallel/: sharding
-    layout has ONE owner — parallel/mesh.py's sanctioned constructors
-    (client_spec, table_shard_spec, server_state_spec, ...). An inline
-    spec in core/ or runtime/ silently forks the layout the program
-    auditor and the 1/M memory accounting reason about."""
-    if _top(rel) == "parallel":
-        return []
-    hits = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            if node.module and node.module.startswith("jax.sharding"):
-                for a in node.names:
-                    if a.name in _SPEC_NAMES:
-                        hits.append((
-                            node.lineno,
-                            f"from jax.sharding import {a.name} "
-                            "outside parallel/ — build specs through "
-                            "parallel.mesh"))
-        elif (isinstance(node, ast.Attribute)
-                and node.attr in _SPEC_NAMES):
-            hits.append((node.lineno,
-                         f"inline .{node.attr} outside parallel/ — "
-                         "build specs through parallel.mesh"))
-    return hits
-
-
-# --- rule: checkpoint-mesh-route ---------------------------------------
-
-
-_MESH_CONSTRUCTORS = {"client_sharding", "server_state_sharding",
-                      "replicated", "shard_batch", "make_mesh",
-                      "make_mesh2d"}
-
-
-def _check_checkpoint_mesh_route(rel, lines, tree):
-    """Every placement the checkpoint path applies at save/load time —
-    a ``device_put`` target or a ``sharding=`` argument — must come
-    from a parallel/mesh.py spec constructor (or be the explicit None
-    "keep the default layout"). The elastic-restore contract (a CxM
-    checkpoint restores bit-exact onto C'xM') holds precisely because
-    restore re-derives placement from the CURRENT mesh through the
-    same constructors FedModel/FedOptimizer initialised with; an
-    ad-hoc sharding built inline here would silently fork the layout
-    and break the migration."""
-    if rel.as_posix() != "runtime/checkpoint.py":
-        return []
-
-    def call_name(e):
-        f = e.func
-        return (f.attr if isinstance(f, ast.Attribute)
-                else f.id if isinstance(f, ast.Name) else None)
-
-    def sanctioned(e, names):
-        if isinstance(e, ast.Constant) and e.value is None:
-            return True
-        if isinstance(e, ast.Call):
-            return call_name(e) in _MESH_CONSTRUCTORS
-        if isinstance(e, ast.IfExp):
-            return (sanctioned(e.body, names)
-                    and sanctioned(e.orelse, names))
-        if isinstance(e, ast.Name):
-            return e.id in names
-        return False
-
-    # names whose EVERY assignment is a sanctioned placement (to a
-    # fixpoint, so spec = other_spec chains resolve)
-    assigns: Dict[str, List[ast.AST]] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    assigns.setdefault(t.id, []).append(node.value)
-    names: Set[str] = set()
-    changed = True
-    while changed:
-        changed = False
-        for name, vals in assigns.items():
-            if name not in names and all(
-                    sanctioned(v, names) for v in vals):
-                names.add(name)
-                changed = True
-
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if call_name(node) == "device_put" and len(node.args) >= 2 \
-                and not sanctioned(node.args[1], names):
-            hits.append((node.lineno,
-                         "device_put placement not built by a "
-                         "parallel.mesh spec constructor — checkpoint "
-                         "save/load shapes must route through "
-                         "parallel/mesh.py"))
-        for kw in node.keywords:
-            if kw.arg in ("sharding", "device") \
-                    and not sanctioned(kw.value, names):
-                hits.append((node.lineno,
-                             f"{kw.arg}= argument not built by a "
-                             "parallel.mesh spec constructor — "
-                             "checkpoint save/load shapes must route "
-                             "through parallel/mesh.py"))
-    return hits
-
-
-# --- rule: byte-literal -------------------------------------------------
-
-
-_BYTE_WIDTH_LITERALS = {1, 2, 4, 8, 1.0, 2.0, 4.0, 8.0}
-
-
-def _check_byte_literal(rel, lines, tree):
-    """Inline byte-width multiplies (``n * 4``) in accounting code on
-    the host path (runtime/, telemetry/): every one of them silently
-    hard-codes f32 on the wire, which is exactly the bug class the
-    quantized sketch work removed. Byte math must go through
-    ``accounting.bytes_of(shape, dtype)`` / ``dtype_bytes`` so a
-    --sketch_dtype change reprices every ledger entry at once. Only
-    statements whose source mentions "bytes" are in scope — scalar
-    math like momentum constants is untouched."""
-    if _top(rel) not in ("runtime", "telemetry"):
-        return []
-    hits = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.BinOp)
-                and isinstance(node.op, ast.Mult)):
-            continue
-        lit = None
-        for side in (node.left, node.right):
-            if (isinstance(side, ast.Constant)
-                    and type(side.value) in (int, float)
-                    and side.value in _BYTE_WIDTH_LITERALS):
-                lit = side.value
-        if lit is None:
-            continue
-        ctx = " ".join(
-            lines[node.lineno - 1:(node.end_lineno or node.lineno)])
-        if "bytes" not in ctx.lower():
-            continue
-        hits.append((node.lineno,
-                     f"inline byte-width literal * {lit} in "
-                     "accounting code — use accounting.bytes_of/"
-                     "dtype_bytes so the wire dtype prices it"))
-    return hits
-
-
-# --- rule: knob-mutation -----------------------------------------------
-
-
-_KNOB_ATTRS = {"sketch_dtype", "num_rows", "num_cols",
-               "approx_recall"}
-_CONFIG_RECEIVERS = {"cfg", "args", "config"}
-
-
-def _check_knob_mutation(rel, lines, tree):
-    """The compression knobs (``k``/``num_rows``/``num_cols``/
-    ``sketch_dtype``/``approx_recall``) are autopilot state: between
-    rounds the controller moves them ONLY through its sanctioned
-    re-plan API (``autopilot.apply_knobs`` onto the bucketed re-jit
-    cache), which keeps the compiled round variant, the byte
-    accounting and the replay record consistent. A direct store
-    anywhere else silently diverges the dispatched program from the
-    config that priced it — the exact bug class the variant cache
-    exists to remove. ``autopilot/`` is exempt (it IS the re-plan
-    API); ``config.py`` owns the initial values. Flagged: attribute
-    stores of the knob names (``.k`` only on config-shaped receivers
-    — cfg/args/config/self.args — so loop counters named ``k`` stay
-    legal), and ``replace(...)``/``dataclasses.replace(...)`` calls
-    passing knob keywords."""
-    if _top(rel) == "autopilot" or rel.as_posix() == "config.py":
-        return []
-
-    def recv(v):
-        if isinstance(v, ast.Name):
-            return v.id
-        if isinstance(v, ast.Attribute) \
-                and isinstance(v.value, ast.Name) \
-                and v.value.id == "self":
-            return v.attr
-        return None
-
-    hits = []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Assign, ast.AugAssign)):
-            targets = (node.targets if isinstance(node, ast.Assign)
-                       else [node.target])
-            for t in targets:
-                if not isinstance(t, ast.Attribute):
-                    continue
-                if t.attr in _KNOB_ATTRS or (
-                        t.attr == "k"
-                        and recv(t.value) in _CONFIG_RECEIVERS):
-                    hits.append((t.lineno,
-                                 f"direct write to .{t.attr} outside "
-                                 "autopilot/ — knob moves must go "
-                                 "through autopilot.apply_knobs so "
-                                 "the re-jit cache, accounting and "
-                                 "replay record stay consistent"))
-        elif isinstance(node, ast.Call):
-            f = node.func
-            name = (f.attr if isinstance(f, ast.Attribute)
-                    else f.id if isinstance(f, ast.Name) else None)
-            if name != "replace":
-                continue
-            knobs = sorted(kw.arg for kw in node.keywords
-                           if kw.arg in _KNOB_ATTRS | {"k"})
-            if knobs:
-                hits.append((node.lineno,
-                             f"replace({', '.join(knobs)}=...) "
-                             "outside autopilot/ — knob moves must "
-                             "go through autopilot.apply_knobs"))
-    return hits
-
-
-# --- rule: mutable-default-arg -----------------------------------------
-
-
-def _check_mutable_default(rel, lines, tree):
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        for default in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None]:
-            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
-                    isinstance(default, ast.Call)
-                    and isinstance(default.func, ast.Name)
-                    and default.func.id in {"list", "dict", "set"}):
-                hits.append((default.lineno,
-                             f"mutable default argument in "
-                             f"{node.name}() — use None + init in body"))
-    return hits
-
-
-# --- rule: live-confinement --------------------------------------------
-
-#: top-level modules that own a socket when imported
-_SOCKET_MODULES = {"socket", "socketserver", "http"}
-#: the package's only sanctioned socket owner
-_LIVE_HOME = "telemetry/live.py"
-#: the only module that may construct an SLO engine directly (every
-#: other caller routes through build_slo_engine)
-_SLO_HOME = "telemetry/slo.py"
-_SERVER_CTORS = {"LiveServer", "ThreadingHTTPServer", "HTTPServer"}
-
-
-def _check_live_confinement(rel, lines, tree):
-    """The live operations plane (telemetry/live.py) is the package's
-    ONLY sanctioned socket owner and exporter-thread spawner: no
-    other production module may import ``socket``/``socketserver``/
-    ``http.server`` or construct an HTTP server, and the compiled
-    round path (``core/``, ``runtime/``) may not spawn threads at all
-    — an exporter accidentally living next to the round loop is
-    exactly the state-mutation hazard the read-only-snapshot design
-    exists to prevent. SLO engines are constructed only inside
-    ``telemetry/slo.py`` (``build_slo_engine`` is the sanctioned
-    entry). Scripts and tests live outside the scanned package root
-    and may do any of this freely."""
-    posix = rel.as_posix()
-    hits = []
-    for node in ast.walk(tree):
-        if posix != _LIVE_HOME:
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    if a.name.split(".")[0] in _SOCKET_MODULES:
-                        hits.append((node.lineno,
-                                     f"import {a.name} outside "
-                                     "telemetry/live.py — the live "
-                                     "plane is the only sanctioned "
-                                     "socket owner"))
-            elif isinstance(node, ast.ImportFrom) and node.level == 0 \
-                    and node.module \
-                    and node.module.split(".")[0] in _SOCKET_MODULES:
-                hits.append((node.lineno,
-                             f"from {node.module} import ... outside "
-                             "telemetry/live.py — the live plane is "
-                             "the only sanctioned socket owner"))
-        if isinstance(node, ast.Call):
-            fn = node.func
-            name = (fn.id if isinstance(fn, ast.Name)
-                    else fn.attr if isinstance(fn, ast.Attribute)
-                    else None)
-            if name in _SERVER_CTORS and posix != _LIVE_HOME:
-                hits.append((node.lineno,
-                             f"{name}(...) constructed outside "
-                             "telemetry/live.py — attach via "
-                             "attach_live_plane"))
-            elif name == "SLOEngine" and posix != _SLO_HOME:
-                hits.append((node.lineno,
-                             "SLOEngine(...) constructed outside "
-                             "telemetry/slo.py — use "
-                             "build_slo_engine"))
-            elif name == "Thread" and _top(rel) in ("core", "runtime") \
-                    and isinstance(fn, ast.Attribute) \
-                    and isinstance(fn.value, ast.Name) \
-                    and fn.value.id == "threading":
-                hits.append((node.lineno,
-                             "threading.Thread spawned in the "
-                             "compiled round path — host threads "
-                             "must not live next to the round loop"))
-            elif name == "start_new_thread":
-                hits.append((node.lineno,
-                             "start_new_thread in a production "
-                             "module — spawn threads only through "
-                             "sanctioned facilities"))
-    return hits
-
-
-ALL_RULES = [
-    Rule("raw-clock",
-         "time.time()/perf_counter() outside telemetry/",
-         _check_raw_clock),
-    Rule("probe-transfer-span",
-         'probe host transfer outside span("metrics_host")',
-         _check_probe_transfer_span),
-    Rule("host-sync",
-         "device sync on the host hot path outside a telemetry span",
-         _check_host_sync),
-    Rule("np-on-tracer",
-         "np.asarray/np.array inside a traced closure",
-         _check_np_on_tracer),
-    Rule("python-rng",
-         "stdlib/NumPy RNG in compiled scope",
-         _check_python_rng),
-    Rule("noise-confinement",
-         "raw jax.random.PRNGKey/normal noise call outside privacy/",
-         _check_noise_confinement),
-    Rule("raw-devices",
-         "raw jax.devices()/jax.local_devices() inside telemetry/",
-         _check_raw_devices),
-    Rule("chaos-confinement",
-         "data/chaos.py imported by a production module",
-         _check_chaos_confinement),
-    Rule("arrival-confinement",
-         "arrival-process injection outside tests/benches/scripts",
-         _check_arrival_confinement),
-    Rule("fedservice-confinement",
-         "fedservice/ daemon imported by a production module",
-         _check_fedservice_confinement),
-    Rule("live-confinement",
-         "socket/HTTP-server/thread use outside telemetry/live.py",
-         _check_live_confinement),
-    Rule("inline-partition-spec",
-         "PartitionSpec/NamedSharding built outside parallel/",
-         _check_inline_partition_spec),
-    Rule("checkpoint-mesh-route",
-         "checkpoint placement not built by parallel.mesh constructors",
-         _check_checkpoint_mesh_route),
-    Rule("byte-literal",
-         "inline byte-width multiply in runtime/telemetry accounting",
-         _check_byte_literal),
-    Rule("knob-mutation",
-         "compression knob written outside autopilot's re-plan API",
-         _check_knob_mutation),
-    Rule("mutable-default-arg",
-         "mutable default argument",
-         _check_mutable_default),
-]
-
-RULES_BY_NAME = {r.name: r for r in ALL_RULES}
-
-
-def waived_rules_at(lines: List[str], line: int) -> Set[str]:
-    """Rules waived at 1-based ``line``: an ``# audit: allow(...)``
-    comment on the line itself or the line directly above."""
-    out: Set[str] = set()
-    for lno in (line, line - 1):
-        if 1 <= lno <= len(lines):
-            m = WAIVER_RE.search(lines[lno - 1])
-            if m:
-                out.update(x.strip() for x in m.group(1).split(","))
-    return out
+from typing import Dict, List, Optional
+
+from commefficient_tpu.analysis.flow import (  # noqa: F401
+    PKG_ROOT,
+    WAIVER_RE,
+    Program,
+    Rule,
+    Violation,
+    build_program,
+    run_file_rules,
+    run_flow,
+    waived_rules_at,
+)
+from commefficient_tpu.analysis.checkers import (  # noqa: F401
+    COMPILED_SCOPE,
+    FLOW_CHECKERS,
+    FLOW_CHECKERS_BY_NAME,
+    FLOW_RULE_NAMES,
+    HOST_HOT_PATH,
+    LEGACY_RULES,
+)
+
+#: the per-file tier, under its historical name — ``RULES_BY_NAME``
+#: spans BOTH tiers so waiver validation knows every legal rule name
+ALL_RULES = LEGACY_RULES
+RULES_BY_NAME = {r.name: r for r in LEGACY_RULES}
+RULES_BY_NAME.update(FLOW_CHECKERS_BY_NAME)
 
 
 def lint_file(path: pathlib.Path, rel: pathlib.PurePath,
               rules=None) -> List[Violation]:
-    rules = ALL_RULES if rules is None else rules
+    """Per-file tier on a single file (no cross-module context, so
+    flow checkers don't apply here)."""
+    rules = LEGACY_RULES if rules is None else rules
+    import ast
     text = path.read_text()
     lines = text.splitlines()
     try:
@@ -872,13 +80,22 @@ def lint_file(path: pathlib.Path, rel: pathlib.PurePath,
 
 def run_lint(root: Optional[pathlib.Path] = None,
              rules=None) -> List[Violation]:
-    """Lint every .py under ``root`` (default: the installed package).
-    Returns all violations, waived ones included — callers gate on
-    ``unwaived(...)``."""
-    root = PKG_ROOT if root is None else pathlib.Path(root)
-    out: List[Violation] = []
-    for path in sorted(root.rglob("*.py")):
-        out.extend(lint_file(path, path.relative_to(root), rules))
+    """Run the per-file (legacy) tier over every .py under ``root``
+    (default: the installed package). Returns all violations, waived
+    ones included — callers gate on ``unwaived(...)``."""
+    rules = LEGACY_RULES if rules is None else rules
+    return run_file_rules(root, rules)
+
+
+def run_all(root: Optional[pathlib.Path] = None,
+            program: Optional[Program] = None) -> List[Violation]:
+    """Both tiers off one parse: legacy per-file rules + flow
+    checkers. This is what the audit gates."""
+    if program is None:
+        program = build_program(root)
+    out = run_file_rules(root, LEGACY_RULES, program=program)
+    out.extend(run_flow(root, program=program))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
     return out
 
 
@@ -887,25 +104,33 @@ def unwaived(violations: List[Violation]) -> List[Violation]:
 
 
 def stale_waivers(root: Optional[pathlib.Path] = None,
-                  violations: Optional[List[Violation]] = None
-                  ) -> List[str]:
+                  violations: Optional[List[Violation]] = None,
+                  rule_names=None) -> List[str]:
     """Waiver comments that no longer suppress anything. An
     ``allow(R)`` waiver comment at line L covers an R violation at L
     or L + 1 (the inverse of ``waived_rules_at``); when the code it
     excused was fixed or moved, the waiver outlives it and silently
     licenses future regressions on that line — so the audit flags it
     for deletion. Also flags waivers naming unknown rules (typo'd
-    waivers waive nothing)."""
+    waivers waive nothing). Rule names from BOTH tiers are legal;
+    when ``violations`` is not supplied, both tiers run so a waiver
+    matched only by a flow finding isn't misreported as stale.
+    ``rule_names`` restricts staleness checking to those rules (pass
+    the legacy names when the flow tier was skipped — its waivers
+    can't be judged without its findings); unknown-rule waivers are
+    always flagged."""
     root = PKG_ROOT if root is None else pathlib.Path(root)
     if violations is None:
-        violations = run_lint(root)
+        violations = run_all(root)
+    checked = set(RULES_BY_NAME) if rule_names is None \
+        else set(rule_names)
     waived_by_path: Dict[str, List[Violation]] = {}
     for v in violations:
         if v.waived:
             waived_by_path.setdefault(v.path, []).append(v)
     out: List[str] = []
     for path in sorted(root.rglob("*.py")):
-        rel = str(path.relative_to(root))
+        rel = path.relative_to(root).as_posix()
         vs = waived_by_path.get(rel, [])
         for i, line in enumerate(path.read_text().splitlines(), 1):
             m = WAIVER_RE.search(line)
@@ -916,6 +141,8 @@ def stale_waivers(root: Optional[pathlib.Path] = None,
                 if rule not in RULES_BY_NAME:
                     out.append(f"{rel}:{i}: waiver names unknown "
                                f"rule '{rule}'")
+                elif rule not in checked:
+                    continue  # that tier didn't run this invocation
                 elif not any(v.rule == rule and v.line in (i, i + 1)
                              for v in vs):
                     out.append(f"{rel}:{i}: stale waiver "

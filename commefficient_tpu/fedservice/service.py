@@ -30,6 +30,7 @@ ledger_merge.py`` joins both shard families.
 import dataclasses
 import os
 import tempfile
+import threading
 
 import numpy as np
 
@@ -47,6 +48,16 @@ from commefficient_tpu.telemetry.alarms import (AlarmEngine,
                                                 DivergenceAbort)
 from commefficient_tpu.telemetry.live import attach_live_plane
 from commefficient_tpu.telemetry.slo import build_slo_engine
+
+#: lock-confinement declarations (flowlint ``lock-confinement``): the
+#: scheduler state is read by probe/admission paths that outlive the
+#: tick loop — an HTTP scrape asking ``active_jobs`` or an operator
+#: admitting a tenant while a tick runs must not iterate ``_jobs``
+#: while ``admit`` appends, and the device free-list carve must be
+#: atomic. ``_ticks``/``_admitted``/``_rejected`` are plain counters
+#: touched only by the single scheduler thread — deliberately not
+#: declared.
+_LOCK_MAP = {"_jobs": "_lock", "_by_id": "_lock", "_free": "_lock"}
 
 
 class _Job:
@@ -98,6 +109,7 @@ class FedService:
         self._ckpt_dir = ckpt_dir
         self._devices = list(devices) if devices is not None \
             else list(jax.devices())
+        self._lock = threading.Lock()
         self._free = list(self._devices)
         self._jobs = []
         self._by_id = {}
@@ -146,12 +158,14 @@ class FedService:
             if str(spec.job_id) in self._by_id:
                 raise AdmissionError(
                     f"job id {spec.job_id!r} already admitted")
-            for other in self._jobs:
-                if int(other.cfg.seed) == int(spec.cfg.seed):
-                    raise AdmissionError(
-                        f"job {spec.job_id}: seed {spec.cfg.seed} "
-                        f"collides with job {other.spec.job_id!r} — "
-                        "per-job RNG streams must be disjoint")
+            with self._lock:
+                for other in self._jobs:
+                    if int(other.cfg.seed) == int(spec.cfg.seed):
+                        raise AdmissionError(
+                            f"job {spec.job_id}: seed {spec.cfg.seed}"
+                            f" collides with job "
+                            f"{other.spec.job_id!r} — per-job RNG "
+                            "streams must be disjoint")
             need = spec.demand_devices()
             if need > len(self._free):
                 raise AdmissionError(
@@ -186,8 +200,9 @@ class FedService:
         self._admitted += 1
         mesh, devices = None, None
         if need:
-            devices = self._free[:need]
-            self._free = self._free[need:]
+            with self._lock:
+                devices = self._free[:need]
+                self._free = self._free[need:]
             mesh = carve_submeshes([spec.mesh_demand],
                                    devices=devices)[0]
         base = getattr(self.cfg, "ledger", "") or ""
@@ -213,8 +228,9 @@ class FedService:
             job.autosaver = RoundAutosaver(
                 cfg, job.model, job.opt, None, None, None,
                 tag=f"job{index}")
-        self._jobs.append(job)
-        self._by_id[str(spec.job_id)] = job
+        with self._lock:
+            self._jobs.append(job)
+            self._by_id[str(spec.job_id)] = job
         if self.runs_dir:
             registry.write_manifest(
                 self.runs_dir, args=cfg, ledger=shard,
@@ -251,8 +267,10 @@ class FedService:
         try:
             return self._by_id[str(job_id)]
         except KeyError:
+            with self._lock:
+                have = sorted(self._by_id)
             raise KeyError(f"no admitted job {job_id!r}; have "
-                           f"{sorted(self._by_id)}") from None
+                           f"{have}") from None
 
     def attach_arrival_process(self, job_id, fn):
         """Per-job arrival relay: forwards ``fn`` to the job's async
@@ -261,7 +279,8 @@ class FedService:
         self._job(job_id).model.attach_arrival_process(fn)
 
     def active_jobs(self) -> int:
-        return sum(1 for job in self._jobs if not job.done)
+        with self._lock:
+            return sum(1 for job in self._jobs if not job.done)
 
     def job_state(self, job_id):
         """The job's current (or final) replicated server weights."""
@@ -278,7 +297,9 @@ class FedService:
         own FedModel SLO engine reads burn >= 1), plus "service" when
         the daemon's own engine is. Admission consults this."""
         burning = []
-        for job in self._jobs:
+        with self._lock:
+            jobs = list(self._jobs)
+        for job in jobs:
             if job.done or job.model is None:
                 continue
             slo = getattr(job.model, "_slo", None)
@@ -295,7 +316,8 @@ class FedService:
         chosen job one round, then write the fairness record to the
         service ledger and evaluate the alarm rules on it. Returns
         the fired alarms (``abort`` raises DivergenceAbort instead)."""
-        runnable = [job for job in self._jobs if not job.done]
+        with self._lock:
+            runnable = [job for job in self._jobs if not job.done]
         if not runnable:
             return []
         if self.policy == "fair":
@@ -358,7 +380,8 @@ class FedService:
         job.model.finalize()
         job.done = True
         if job.devices:
-            self._free.extend(job.devices)
+            with self._lock:
+                self._free.extend(job.devices)
             job.devices = None
 
     def _fairness_probes(self, runnable, chosen) -> dict:
@@ -401,7 +424,8 @@ class FedService:
         save_checkpoint(path, job.model, job.opt)
         job.model.finalize()
         if job.devices:
-            self._free.extend(job.devices)
+            with self._lock:
+                self._free.extend(job.devices)
             job.devices = None
         mesh, devices = None, None
         if mesh_demand is not None:
@@ -411,8 +435,9 @@ class FedService:
                 raise AdmissionError(
                     f"job {job_id}: migration demand {c}x{m} needs "
                     f"{need} devices, {len(self._free)} free")
-            devices = self._free[:need]
-            self._free = self._free[need:]
+            with self._lock:
+                devices = self._free[:need]
+                self._free = self._free[need:]
             mesh = carve_submeshes([mesh_demand],
                                    devices=devices)[0]
         job.mesh, job.devices = mesh, devices
@@ -429,7 +454,9 @@ class FedService:
     def close(self):
         """Drain-free shutdown: finalize still-live jobs, stamp the
         service meta record, close the service ledger."""
-        for job in self._jobs:
+        with self._lock:
+            jobs = list(self._jobs)
+        for job in jobs:
             if not job.done:
                 job.final_state = np.array(job.model.ps_weights)
                 job.model.finalize()

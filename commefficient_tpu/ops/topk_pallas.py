@@ -89,7 +89,10 @@ def take_mask_pallas(sq, t_key, need, interpret: bool = False):
         rank = (lane_cum.astype(jnp.int32)
                 + row_off.astype(jnp.int32) + cnt_ref[0])  # 1-based
         take = gt | (eq & (rank <= need_ref[0]))
-        out_ref[:] = take.astype(jnp.int8)
+        # int8 here is a kernel-local selection bitmap (VMEM out
+        # buffer), not a wire format — it never crosses the ICI/host
+        # boundary, so quant.py's byte accounting doesn't apply.
+        out_ref[:] = take.astype(jnp.int8)  # audit: allow(wire-dtype-crossing)
         cnt_ref[0] = cnt_ref[0] + jnp.sum(eqf).astype(jnp.int32)
 
     out = pl.pallas_call(

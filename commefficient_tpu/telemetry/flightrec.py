@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 from collections import deque
 
 from commefficient_tpu.telemetry import clock
@@ -35,6 +36,21 @@ from commefficient_tpu.telemetry.sinks import _json_default
 
 POSTMORTEM_SCHEMA = 1
 POSTMORTEM_PREFIX = "postmortem_"
+
+#: lock-confinement declarations (flowlint ``lock-confinement``).
+#: The recorder is written by the round loop but dumped from OTHER
+#: threads — the crash excepthook fires on whichever thread raised,
+#: and a daemon's alarm path can dump while another job's sink is
+#: mid-``write``. Iterating ``_ring``/``_events`` (deques) while a
+#: writer appends past maxlen raises ``RuntimeError: deque mutated
+#: during iteration``, so every touch goes through ``_lock``.
+_LOCK_MAP = {
+    "_ring": "_lock",
+    "_events": "_lock",
+    "_meta": "_lock",
+    "_dumped": "_lock",
+    "last_bundle": "_lock",
+}
 
 #: recent compile/alarm events retained alongside the round ring
 EVENT_QUEUE = 64
@@ -60,6 +76,7 @@ class FlightRecorder:
         from commefficient_tpu.telemetry import registry
         self._cfg = cfg
         self.ring_rounds = int(ring_rounds)
+        self._lock = threading.Lock()
         self._ring = deque(maxlen=self.ring_rounds)
         self._events = deque(maxlen=EVENT_QUEUE)
         self._meta = None
@@ -79,23 +96,26 @@ class FlightRecorder:
     def write(self, rec):
         kind = rec.get("kind")
         if kind == "meta":
-            self._meta = dict(rec)
+            with self._lock:
+                self._meta = dict(rec)
             return
         if kind != "round":
             return
-        self._ring.append(rec)
         counters = rec.get("counters") or {}
-        if counters.get("compile_events"):
-            self._events.append({
-                "kind": "compile", "round": rec.get("round"),
-                "events": counters["compile_events"],
-                "secs": counters.get("compile_secs")})
         alarms = rec.get("alarms") or []
-        for alarm in alarms:
-            self._events.append(dict(alarm, kind="alarm"))
+        with self._lock:
+            self._ring.append(rec)
+            if counters.get("compile_events"):
+                self._events.append({
+                    "kind": "compile", "round": rec.get("round"),
+                    "events": counters["compile_events"],
+                    "secs": counters.get("compile_secs")})
+            for alarm in alarms:
+                self._events.append(dict(alarm, kind="alarm"))
         if alarms:
             # the firing record is already IN the ring (appended
-            # above), so the bundle always contains its own trigger
+            # above), so the bundle always contains its own trigger;
+            # dump() takes the lock itself, so call it outside ours
             self.dump("alarm", rule=str(alarms[0].get("rule")),
                       context={"alarms": alarms,
                                "round": rec.get("round")})
@@ -110,8 +130,19 @@ class FlightRecorder:
         the prior path when this (reason, rule) already dumped, or
         None when the write failed — warned, never raised)."""
         key = (str(reason), None if rule is None else str(rule))
-        if key in self._dumped:
-            return self.last_bundle
+        with self._lock:
+            if key in self._dumped:
+                return self.last_bundle
+            # claim the key BEFORE the file I/O so a concurrent dump
+            # of the same incident (crash hook racing the alarm path)
+            # can't write twice; rolled back below if the write fails.
+            # Snapshot the ring under the same lock — a writer
+            # appending past maxlen while we iterate would raise
+            # "deque mutated during iteration" and lose the bundle.
+            self._dumped.add(key)
+            rounds = list(self._ring)
+            events = list(self._events)
+            meta = self._meta
         bundle = {
             "schema": POSTMORTEM_SCHEMA,
             "kind": "postmortem",
@@ -123,9 +154,9 @@ class FlightRecorder:
             "config": self._config,
             "config_hash": self._config_hash,
             "ring_rounds": self.ring_rounds,
-            "rounds": list(self._ring),
-            "events": list(self._events),
-            "meta": self._meta,
+            "rounds": rounds,
+            "events": events,
+            "meta": meta,
         }
         try:
             from commefficient_tpu.telemetry import registry
@@ -150,9 +181,11 @@ class FlightRecorder:
         except Exception as e:  # noqa: BLE001 — observability only
             print(f"WARNING: postmortem bundle not written "
                   f"({type(e).__name__}: {e})", file=sys.stderr)
+            with self._lock:
+                self._dumped.discard(key)
             return None
-        self._dumped.add(key)
-        self.last_bundle = path
+        with self._lock:
+            self.last_bundle = path
         if self.runs_dir:
             try:
                 from commefficient_tpu.telemetry import registry

@@ -38,6 +38,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 #: metric namespace prefix on every exported series
 PREFIX = "commeff_"
 
+#: lock-confinement declarations (enforced by the flowlint
+#: ``lock-confinement`` checker): every write to / iteration over
+#: these attrs must sit inside ``with <lock>:`` lexically. The
+#: registry maps are mutated by round-loop threads and iterated by
+#: the exporter thread; ``_PLANE`` is the process-wide singleton the
+#: daemon and its jobs race to initialise.
+_LOCK_MAP = {
+    "_counters": "_lock",
+    "_gauges": "_lock",
+    "_summaries": "_lock",
+    "_labels": "_lock",
+    "_PLANE": "_PLANE_LOCK",
+}
+
 #: rolling samples kept per summary series (quantiles are over this
 #: window; _sum/_count are whole-run)
 SUMMARY_WINDOW = 256
@@ -87,27 +101,25 @@ class LiveRegistry:
         self._summaries = {}
         self._labels = {}
 
-    def _key(self, labels):
-        key = _labels_key(labels)
-        self._labels[key] = dict(labels or {})
-        return key
-
     def counter_add(self, name: str, value, labels=None):
+        key = _labels_key(labels)
         with self._lock:
+            self._labels[key] = dict(labels or {})
             series = self._counters.setdefault(name, {})
-            key = self._key(labels)
             series[key] = series.get(key, 0.0) + float(value)
 
     def gauge_set(self, name: str, value, labels=None):
+        key = _labels_key(labels)
         with self._lock:
-            self._gauges.setdefault(name, {})[self._key(labels)] = \
-                float(value)
+            self._labels[key] = dict(labels or {})
+            self._gauges.setdefault(name, {})[key] = float(value)
 
     def observe(self, name: str, value, labels=None):
         """One sample into a rolling-window summary series."""
+        key = _labels_key(labels)
         with self._lock:
+            self._labels[key] = dict(labels or {})
             series = self._summaries.setdefault(name, {})
-            key = self._key(labels)
             window, total, count = series.get(
                 key, (deque(maxlen=SUMMARY_WINDOW), 0.0, 0))
             window.append(float(value))

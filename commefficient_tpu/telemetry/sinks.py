@@ -12,11 +12,18 @@ import glob
 import json
 import os
 import re
+import threading
 
 import numpy as np
 
 from commefficient_tpu.telemetry.record import (make_bench_record,
                                                 make_summary_record)
+
+#: lock-confinement declaration (flowlint ``lock-confinement``): the
+#: JSONLSink two-writer guard is a process-wide class dict — a daemon
+#: opening per-job shards from worker threads races the check-then-
+#: claim, so claim and eviction must hold ``_live_lock``.
+_LOCK_MAP = {"_live": "_live_lock"}
 
 
 def shard_ledger_path(path: str, process_index: int) -> str:
@@ -160,6 +167,7 @@ class JSONLSink:
     #: is a *dead* writer (crash/resume path) — it can never write
     #: again, so its claim is evicted rather than honoured.
     _live = {}
+    _live_lock = threading.Lock()
 
     def __init__(self, path: str, process=None, resume_after=None):
         self.path = path
@@ -167,20 +175,35 @@ class JSONLSink:
         self.resume_after = (None if resume_after is None
                              else int(resume_after))
         abspath = os.path.abspath(path)
-        prior = JSONLSink._live.get(abspath)
-        if prior is not None and prior._f is not None \
-                and not prior._f.closed:
-            raise RuntimeError(
-                f"ledger {path} already has a live JSONLSink in this "
-                "process — two writers on one path would interleave "
-                "torn records. Close the first sink, or shard the "
-                "path (shard_ledger_path / job_ledger_path)")
-        parent = os.path.dirname(abspath)
-        os.makedirs(parent, exist_ok=True)
-        recover_torn_tail(path)
-        self._f = open(path, "a")
+        self._f = None
         self._abspath = abspath
-        JSONLSink._live[abspath] = self
+        # claim under the lock BEFORE opening: two threads racing the
+        # unlocked check-then-claim would both pass the prior check
+        # and both open the file — the exact interleaving the guard
+        # exists to refuse
+        with JSONLSink._live_lock:
+            prior = JSONLSink._live.get(abspath)
+            # a claimed prior with _f None is mid-__init__ (close()
+            # and a failed open both drop the claim) — still live
+            if prior is not None and (prior._f is None
+                                      or not prior._f.closed):
+                raise RuntimeError(
+                    f"ledger {path} already has a live JSONLSink in "
+                    "this process — two writers on one path would "
+                    "interleave torn records. Close the first sink, "
+                    "or shard the path (shard_ledger_path / "
+                    "job_ledger_path)")
+            JSONLSink._live[abspath] = self
+        try:
+            parent = os.path.dirname(abspath)
+            os.makedirs(parent, exist_ok=True)
+            recover_torn_tail(path)
+            self._f = open(path, "a")
+        except BaseException:
+            with JSONLSink._live_lock:
+                if JSONLSink._live.get(abspath) is self:
+                    del JSONLSink._live[abspath]
+            raise
 
     def write(self, rec):
         if self.resume_after is not None \
@@ -199,8 +222,9 @@ class JSONLSink:
         if self._f is not None:
             self._f.close()
             self._f = None
-            if JSONLSink._live.get(self._abspath) is self:
-                del JSONLSink._live[self._abspath]
+            with JSONLSink._live_lock:
+                if JSONLSink._live.get(self._abspath) is self:
+                    del JSONLSink._live[self._abspath]
 
 
 def _json_default(obj):

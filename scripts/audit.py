@@ -5,7 +5,14 @@ baseline gate.
     python scripts/audit.py --baseline audit_baseline.json
     python scripts/audit.py --write-baseline     # refresh the pin
     python scripts/audit.py --lint-only          # no jax, instant
+    python scripts/audit.py --no-flow            # file-local rules only
     python scripts/audit.py --json report.json   # full report dump
+
+The lint pass runs both tiers by default: the file-local legacy rules
+and the flowlint whole-program checkers (call-graph trace-purity,
+PRNG-key discipline, wire-dtype crossing, lock-confinement).
+``--no-flow`` skips the flow tier (escape hatch for a broken parse —
+file-local rules still run).
 
 Exit status: 0 clean, 1 on any invariant failure, unwaived lint hit,
 or baseline regression. The program pass always runs on the canonical
@@ -44,13 +51,25 @@ def main(argv=None):
                     help="dump the full report to this path")
     ap.add_argument("--lint-only", action="store_true")
     ap.add_argument("--program-only", action="store_true")
+    ap.add_argument("--flow", dest="flow", action="store_true",
+                    default=True,
+                    help="run the flowlint whole-program checkers "
+                         "(default)")
+    ap.add_argument("--no-flow", dest="flow", action="store_false",
+                    help="skip the flow tier; file-local rules only")
     args = ap.parse_args(argv)
 
     from commefficient_tpu.analysis import lint as lint_mod
     lint_summary = {"unwaived": [], "waived": [], "stale_waivers": []}
     if not args.program_only:
-        violations = lint_mod.run_lint()
-        stale = lint_mod.stale_waivers(violations=violations)
+        if args.flow:
+            violations = lint_mod.run_all()
+            stale = lint_mod.stale_waivers(violations=violations)
+        else:
+            violations = lint_mod.run_lint()
+            stale = lint_mod.stale_waivers(
+                violations=violations,
+                rule_names=[r.name for r in lint_mod.LEGACY_RULES])
         lint_summary = lint_mod.lint_report(violations, stale=stale)
         for v in lint_summary["unwaived"]:
             print(f"LINT  {v}")

@@ -18,6 +18,11 @@
         scaling curves (scripts/scaling_bench.py sweeps) found in the
         registry — no hand-typed paths
 
+    python scripts/telemetry_report.py --audit
+        findings diff: the committed audit_baseline.json vs a fresh
+        two-tier lint run — waived/new/fixed counts per rule, the
+        "did this branch move the static-analysis needle" view
+
 Schema-v3 ledgers additionally render the trace-derived device-time
 breakdown (compute / collective / transfer / host-gap per round) and
 the roofline expectation next to the host-span percentiles. Schema-v4
@@ -924,6 +929,64 @@ def postmortem_report(path: str, as_json: bool) -> int:
     return 0
 
 
+def _finding_rule(finding: str) -> str:
+    """Rule name out of a rendered finding: ``path:NN: rule: msg``."""
+    parts = finding.split(": ", 2)
+    return parts[1] if len(parts) >= 3 else "?"
+
+
+def audit_report(baseline_path: str, as_json: bool,
+                 program=None, violations=None) -> int:
+    """Findings diff: the committed audit baseline vs a fresh run of
+    both lint tiers (legacy rules + flowlint checkers). Per rule:
+    how many waived findings stand, which are NEW since the baseline
+    (including any unwaived hit — those never enter a baseline), and
+    which the baseline still carries but the tree has FIXED."""
+    from commefficient_tpu.analysis.baseline import load_baseline
+    from commefficient_tpu.analysis.lint import (run_all,
+                                                 stale_waivers)
+    baseline = load_baseline(baseline_path)
+    pinned = set(baseline.get("lint", {}).get("waived", []))
+    if violations is None:
+        violations = run_all(program=program)
+    stale = stale_waivers(violations=violations)
+    fresh_waived = {str(v) for v in violations if v.waived}
+    fresh_unwaived = sorted(str(v) for v in violations
+                            if not v.waived)
+    new = sorted(fresh_waived - pinned) + fresh_unwaived
+    fixed = sorted(pinned - fresh_waived)
+
+    per_rule: dict = {}
+    for bucket, findings in (("waived", sorted(fresh_waived)),
+                             ("new", new), ("fixed", fixed)):
+        for f in findings:
+            entry = per_rule.setdefault(
+                _finding_rule(f), {"waived": 0, "new": 0, "fixed": 0})
+            entry[bucket] += 1
+    if as_json:
+        print(json.dumps({
+            "baseline": baseline_path, "per_rule": per_rule,
+            "new": new, "fixed": fixed,
+            "waived": sorted(fresh_waived),
+            "unwaived": fresh_unwaived, "stale_waivers": stale}))
+        return 1 if (new or fixed or stale) else 0
+    lines = [f"== audit findings vs {baseline_path} =="]
+    for rule in sorted(per_rule):
+        c = per_rule[rule]
+        lines.append(f"  {rule:24} waived {c['waived']:3}  "
+                     f"new {c['new']:3}  fixed {c['fixed']:3}")
+    for f in new:
+        lines.append(f"  NEW   {f}")
+    for f in fixed:
+        lines.append(f"  FIXED {f} — refresh the baseline")
+    for s in stale:
+        lines.append(f"  STALE {s}")
+    if not (new or fixed or stale):
+        lines.append("  in sync: tree findings match the baseline")
+    print("\n".join(lines))
+    return 1 if (new or fixed or stale) else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="render or diff telemetry run ledgers")
@@ -938,10 +1001,17 @@ def main(argv=None):
     ap.add_argument("--postmortem", default=None,
                     help="render a flight-recorder postmortem bundle "
                          "(telemetry/flightrec.py JSON)")
+    ap.add_argument("--audit", nargs="?", const="audit_baseline.json",
+                    default=None, metavar="BASELINE",
+                    help="findings diff: committed audit baseline vs "
+                         "a fresh two-tier lint run (new/fixed/"
+                         "waived counts per rule)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
 
+    if args.audit is not None:
+        return audit_report(args.audit, args.json)
     if args.postmortem is not None:
         return postmortem_report(args.postmortem, args.json)
     if args.runs_dir is not None:

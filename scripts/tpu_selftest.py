@@ -455,6 +455,28 @@ def audit_smoke():
             f"fp {entry['fingerprint'][:12]}")
 
 
+def flowlint_smoke():
+    """The flowlint whole-program tier on the deployed tree: zero
+    unwaived findings from the call-graph checkers (trace-purity,
+    prng-keys, wire-dtype-crossing, lock-confinement) and the engine
+    staying inside its 10 s wall-time budget — a daemon image ships
+    with the same static guarantees CI pinned."""
+    import time as _time
+
+    from commefficient_tpu.analysis.flow import build_program
+    from commefficient_tpu.analysis.lint import (run_all, unwaived)
+
+    t0 = _time.monotonic()
+    program = build_program(None)
+    hits = unwaived(run_all(program=program))
+    elapsed = _time.monotonic() - t0
+    assert not hits, f"unwaived flowlint findings: {hits[:5]}"
+    assert elapsed < 10.0, f"engine took {elapsed:.1f}s (budget 10s)"
+    return (f"flow tier clean; {len(program.jit_roots)} jit roots, "
+            f"{len(program.thread_roots)} thread roots, "
+            f"{len(program.traced)} traced fns in {elapsed:.1f}s")
+
+
 def flash_attention_parity():
     """attn_impl="flash" (Pallas flash-attention kernel) vs the XLA
     attention lowering on the same GPT-2 block — forward and gradient
@@ -1065,6 +1087,7 @@ def main():
     check("service_smoke", service_smoke)
     check("autopilot_smoke", autopilot_smoke)
     check("audit_smoke", audit_smoke)
+    check("flowlint_smoke", flowlint_smoke)
     check("trace_smoke", trace_smoke)
     check("scaling_smoke", scaling_smoke)
     check("mesh2d_smoke", mesh2d_smoke)

@@ -30,6 +30,25 @@ def devices():
     return devs
 
 
+@pytest.fixture(scope="session")
+def package_parse():
+    """One timed cold flowlint run (parse + both lint tiers) on the
+    real package, shared by test_audit and test_flowlint — the suite
+    pays for exactly one engine run. ``elapsed`` is the cold wall
+    time, used by the <10 s engine-budget assertion."""
+    import time
+
+    from commefficient_tpu.analysis.flow import build_program
+    from commefficient_tpu.analysis.lint import run_all
+
+    t0 = time.monotonic()
+    program = build_program(None)
+    violations = run_all(program=program)
+    elapsed = time.monotonic() - t0
+    return {"program": program, "violations": violations,
+            "elapsed": elapsed}
+
+
 # --- fast/slow tiers -----------------------------------------------------
 # ``pytest -m fast`` is the <2-minute oracle tier: compression-op math,
 # server-mode oracles, sharding invariance, accounting, data-layer

@@ -1,0 +1,6 @@
+"""Legacy-tier seed: mutable default argument."""
+
+
+def accumulate(x, out=[]):
+    out.append(x)
+    return out

@@ -1,0 +1,29 @@
+"""Method dispatch: the traced closure calls ``eng.run`` on a local
+constructed in the enclosing builder scope; ``run`` dispatches
+``self.now()`` through the base class, where the clock hides."""
+
+import time
+
+import jax
+
+
+class Base:
+    def now(self):
+        return time.time()
+
+
+class Engine(Base):
+    def run(self, x):
+        return self.now() + x
+
+
+def build(cfg):
+    eng = Engine()
+
+    def traced(x):
+        return eng.run(x)
+
+    return traced
+
+
+step = jax.jit(build(None))

@@ -1,0 +1,12 @@
+"""Helpers called from the traced closure in rounds.py — the
+impurity lives HERE, two call-graph hops from the jit root."""
+
+import time
+
+
+def tick():
+    return time.time()
+
+
+def helper(x):
+    return x * 2

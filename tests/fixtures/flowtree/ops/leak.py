@@ -1,0 +1,13 @@
+"""Wire-dtype crossing: an unowned int8 cast (flagged) and a waived
+bf16 cast (suppressed, recorded)."""
+
+import jax.numpy as jnp
+
+
+def encode_wrong(x):
+    return x.astype(jnp.int8)
+
+
+def canary(x):
+    # audit: allow(wire-dtype-crossing) — fixture waiver
+    return x.astype(jnp.bfloat16)

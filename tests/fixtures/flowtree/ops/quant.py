@@ -1,0 +1,10 @@
+"""The sanctioned cast owner: the same casts are clean here."""
+
+import jax.numpy as jnp
+
+
+def encode(x):
+    return x.astype(jnp.int8)
+
+
+WIDTH = {"int8": 1, "bf16": 2, "f32": 4}

@@ -1,0 +1,26 @@
+"""PRNG-key discipline fixtures: one reuse-after-split, one dropped
+split stream, and the clean disjoint-stream idiom."""
+
+from jax import random
+
+
+def bad_reuse(rng):
+    k1, k2 = random.split(rng)
+    a = random.normal(rng, (4,))
+    return a, k1, k2
+
+
+def bad_drop(rng):
+    k1, k2 = random.split(rng)
+    return random.normal(k1, (4,))
+
+
+def good(rng):
+    k1, k2 = random.split(rng)
+    return random.normal(k1, ()) + random.uniform(k2, ())
+
+
+def good_fold(rng, t):
+    child = random.fold_in(rng, t)
+    other = random.fold_in(rng, t + 1)
+    return random.normal(child, ()) + random.normal(other, ())

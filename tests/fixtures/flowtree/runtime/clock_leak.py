@@ -1,0 +1,12 @@
+"""Legacy-tier seeds: raw-clock fires twice, one waived."""
+
+import time
+
+
+def probe():
+    # audit: allow(raw-clock) — fixture waiver
+    return time.time()
+
+
+def stamp():
+    return time.time()

@@ -1,0 +1,3 @@
+"""A private wire-width byte table outside the owners — flagged."""
+
+WIDTH = {"int8": 1, "bf16": 2}

@@ -1,0 +1,26 @@
+"""Lock-confinement fixtures: _LOCK_MAP-declared state written and
+iterated with and without the lock."""
+
+import threading
+
+_LOCK_MAP = {"_items": "_lock"}
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add_unlocked(self, x):
+        self._items.append(x)
+
+    def add_locked(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)
+
+    def leak_iter(self):
+        return [x for x in self._items]

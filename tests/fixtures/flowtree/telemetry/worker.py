@@ -1,0 +1,16 @@
+"""Thread root: ``Thread(target=self._run)`` makes ``Pump._run`` a
+thread entry point (program.thread_roots)."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        return drain()
+
+
+def drain():
+    return 0

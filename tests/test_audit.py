@@ -14,7 +14,8 @@ import pytest
 from commefficient_tpu.analysis import baseline as base_mod
 from commefficient_tpu.analysis import hlo
 from commefficient_tpu.analysis.lint import (RULES_BY_NAME, lint_report,
-                                             run_lint, unwaived)
+                                             run_lint,
+                                             unwaived)
 from commefficient_tpu.analysis.program import (SERVER_CFG_KW,
                                                 ProgramSpec,
                                                 audit_client_program,
@@ -393,7 +394,14 @@ def test_chunked_and_server_programs_are_collective_free(audit_report):
 # --- tier-1 baseline gate ----------------------------------------------
 
 
-def test_report_matches_committed_baseline(audit_report):
+@pytest.fixture(scope="module")
+def lint_summary(package_parse):
+    # both lint tiers off the suite's one shared engine run
+    # (conftest.package_parse) — the baseline tests only read this
+    return lint_report(package_parse["violations"])
+
+
+def test_report_matches_committed_baseline(audit_report, lint_summary):
     """The CI gate: a fresh audit must diff clean against the
     committed audit_baseline.json. Any new collective, lost donation,
     host transfer, fingerprint drift, or new lint waiver fails here
@@ -404,15 +412,15 @@ def test_report_matches_committed_baseline(audit_report):
         "audit_baseline.json missing — run scripts/audit.py " \
         "--write-baseline"
     baseline = base_mod.load_baseline(baseline_path)
-    report = base_mod.build_report(audit_report,
-                                   lint_report(run_lint()))
+    # both lint tiers — the baseline pins flow-checker waivers too
+    report = base_mod.build_report(audit_report, lint_summary)
     problems = base_mod.diff_against_baseline(report, baseline)
     assert problems == [], "\n".join(problems)
 
 
-def test_baseline_roundtrip_and_diff_detects_drift(audit_report):
-    report = base_mod.build_report(audit_report,
-                                   lint_report(run_lint()))
+def test_baseline_roundtrip_and_diff_detects_drift(audit_report,
+                                                   lint_summary):
+    report = base_mod.build_report(audit_report, lint_summary)
     pinned = json.loads(json.dumps(base_mod.to_baseline(report)))
     assert base_mod.diff_against_baseline(report, pinned) == []
     # fingerprint drift is a visible failure

@@ -424,3 +424,50 @@ class TestSinkGuard:
         c = JSONLSink(base)
         for s in (a, b, c):
             s.close()
+
+
+class TestSchedulerLocks:
+    @pytest.mark.slow  # compiles a 2-job service run (~7 s); the
+    # cheap lock regressions stay in tier-1 via test_live_ops
+    def test_probe_threads_race_the_tick_loop(self):
+        """flowlint lock-confinement regression: an HTTP scrape
+        asking ``active_jobs``/``slo_burning_jobs`` while the
+        scheduler ticks (and ``admit`` appends) must never hit
+        'list/dict mutated during iteration' — every ``_jobs`` /
+        ``_by_id`` / ``_free`` touch now goes through the service
+        lock."""
+        import threading
+
+        svc = FedService(_svc_cfg(), policy="fair")
+        errors = []
+        stop = threading.Event()
+
+        def scrape():
+            while not stop.is_set():
+                try:
+                    svc.active_jobs()
+                    svc.slo_burning_jobs()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=scrape) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for j, seed in enumerate((3, 4)):
+                svc.admit(JobSpec(f"j{j}", _job_cfg(seed), _builder,
+                                  _mk_batch_fn(seed, 1), rounds=1))
+            svc.run(max_ticks=4)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+            svc.close()
+        assert errors == []
+        assert svc.active_jobs() == 0
+
+
+def _mk_batch_fn(seed, n):
+    batches = _batches(seed, n)
+    return lambda r: batches[r] if r < len(batches) else None

@@ -1,0 +1,360 @@
+"""flowlint (analysis/flow.py + analysis/checkers/): the call-graph
+engine's root finding and resolution (aliased imports, method
+dispatch, the builder idiom, thread targets), one positive and one
+negative fixture per flow checker, waiver handling, the committed
+fixture-tree pin (legacy findings byte-identical to the pre-migration
+engine — the migration moved ``analysis/lint.py``'s rules verbatim
+into ``checkers/legacy.py`` and this pin keeps them that way), the
+lint-report/baseline round trip, and the <10 s engine wall-time
+budget on the real package.
+"""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from commefficient_tpu.analysis import baseline as base_mod
+from commefficient_tpu.analysis.flow import build_program, run_flow
+from commefficient_tpu.analysis.lint import (FLOW_CHECKERS_BY_NAME,
+                                             LEGACY_RULES,
+                                             RULES_BY_NAME, lint_report,
+                                             run_all, run_lint,
+                                             stale_waivers, unwaived)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+FLOWTREE = REPO_ROOT / "tests" / "fixtures" / "flowtree"
+
+
+def _write_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def _flow(root, rule):
+    return run_flow(root=root,
+                    checkers=[FLOW_CHECKERS_BY_NAME[rule]])
+
+
+# --- the committed fixture tree: one pin for the whole engine ----------
+
+
+@pytest.fixture(scope="module")
+def flowtree_program():
+    return build_program(FLOWTREE)
+
+
+def test_flowtree_findings_match_committed_pin(flowtree_program):
+    """Both tiers on the committed fixture tree must reproduce the
+    pinned findings exactly — rule, path, line, message, and waived
+    bit. This is the migration-identity gate: the legacy rules moved
+    verbatim out of lint.py, and any drift in either tier's findings
+    shows up here as a diff against the committed JSON."""
+    got = [{"rule": v.rule, "path": v.path, "line": v.line,
+            "message": v.message, "waived": v.waived}
+           for v in run_all(root=FLOWTREE, program=flowtree_program)]
+    expected = json.loads(
+        (REPO_ROOT / "tests" / "fixtures"
+         / "flowtree_expected.json").read_text())
+    assert got == expected
+
+
+def test_legacy_tier_alone_matches_pin_subset(flowtree_program):
+    """``run_lint`` (the historical entry point) must produce exactly
+    the legacy-rule subset of the pin — same findings the
+    pre-migration per-file linter produced."""
+    legacy_names = {r.name for r in LEGACY_RULES}
+    got = [str(v) for v in run_lint(root=FLOWTREE)]
+    expected = [str_of(e) for e in json.loads(
+        (REPO_ROOT / "tests" / "fixtures"
+         / "flowtree_expected.json").read_text())
+        if e["rule"] in legacy_names]
+    assert got == expected
+
+
+def str_of(e):
+    w = " [waived]" if e["waived"] else ""
+    return f"{e['path']}:{e['line']}: {e['rule']}: {e['message']}{w}"
+
+
+# --- call-graph resolution ---------------------------------------------
+
+
+def test_builder_idiom_roots_sibling_closures(flowtree_program):
+    """``jax.jit(build_outer(cfg))`` where build_outer returns
+    ``build_round(cfg)`` roots the sibling builder's closure too."""
+    assert "core/rounds.py::build_round.<locals>.traced" \
+        in flowtree_program.jit_roots
+
+
+def test_aliased_imports_reach_two_hops(flowtree_program):
+    """The traced closure calls ``cu.tick()`` (module alias) and
+    ``aliased_helper`` (from-import asname) — both helpers must be in
+    the traced set; the unrooted host loop must not be."""
+    traced = flowtree_program.traced
+    assert "core/util.py::tick" in traced
+    assert "core/util.py::helper" in traced
+    assert "core/rounds.py::host_loop" not in traced
+
+
+def test_method_dispatch_through_ctor_and_bases(flowtree_program):
+    """``eng = Engine()`` in the builder scope, ``eng.run(x)`` in the
+    closure, ``self.now()`` found on the base class: three dispatch
+    mechanisms chained."""
+    traced = flowtree_program.traced
+    assert "core/engine.py::Engine.run" in traced
+    assert "core/engine.py::Base.now" in traced
+
+
+def test_thread_target_is_a_root(flowtree_program):
+    assert "telemetry/worker.py::Pump._run" \
+        in flowtree_program.thread_roots
+    assert "telemetry/worker.py::drain" in flowtree_program.threaded
+
+
+def test_external_module_attrs_never_dispatch(tmp_path):
+    """``jnp.take(...)`` must NOT resolve to some in-package class's
+    ``take`` method — an alias of an external module contributes no
+    edges (the false-positive class that motivated local ctor-type
+    inference)."""
+    root = _write_tree(tmp_path, {
+        "ops/a.py": """
+            import jax
+            import jax.numpy as jnp
+            import time
+
+            class Store:
+                def take(self, i):
+                    return time.time()
+
+            def build(cfg):
+                def traced(x):
+                    return jnp.take(x, 0)
+                return traced
+
+            step = jax.jit(build(None))
+            """,
+    })
+    p = build_program(root)
+    assert "ops/a.py::Store.take" not in p.traced
+    assert unwaived(_flow(root, "trace-purity")) == []
+
+
+# --- per-checker positive/negative fixtures ----------------------------
+
+
+def test_trace_purity_positive_and_negative(flowtree_program):
+    vs = run_flow(root=FLOWTREE, program=flowtree_program,
+                  checkers=[FLOW_CHECKERS_BY_NAME["trace-purity"]])
+    hit_paths = {(v.path, v.line) for v in vs}
+    # positive: the clock two hops from the root
+    assert ("core/util.py", 8) in hit_paths
+    # negative: the same impurity in the unreachable host loop
+    assert not any(v.path == "core/rounds.py" for v in vs)
+
+
+def test_prng_positive_and_negative(flowtree_program):
+    vs = run_flow(root=FLOWTREE, program=flowtree_program,
+                  checkers=[FLOW_CHECKERS_BY_NAME["prng-keys"]])
+    msgs = [v.message for v in vs]
+    assert any("used after split" in m for m in msgs)
+    assert any("never consumed" in m for m in msgs)
+    # negative: good() and good_fold() produce nothing past line 20
+    assert all(v.line < 18 for v in vs), vs
+
+
+def test_wire_positive_negative_and_waiver(flowtree_program):
+    vs = run_flow(root=FLOWTREE, program=flowtree_program,
+                  checkers=[
+                      FLOW_CHECKERS_BY_NAME["wire-dtype-crossing"]])
+    by_path = {}
+    for v in vs:
+        by_path.setdefault(v.path, []).append(v)
+    # positive: the unowned cast and the private byte table
+    assert any(not v.waived for v in by_path["ops/leak.py"])
+    assert "runtime/price.py" in by_path
+    # negative: the owner module is exempt
+    assert "ops/quant.py" not in by_path
+    # waiver: the bf16 canary is reported but waived
+    waived = [v for v in by_path["ops/leak.py"] if v.waived]
+    assert len(waived) == 1 and "bfloat16" in waived[0].message
+
+
+def test_lock_confinement_positive_and_negative(flowtree_program):
+    vs = run_flow(root=FLOWTREE, program=flowtree_program,
+                  checkers=[
+                      FLOW_CHECKERS_BY_NAME["lock-confinement"]])
+    kinds = {(v.line, v.message.split(" of ")[0]) for v in vs}
+    assert (15, ".append() mutation") in kinds     # add_unlocked
+    assert (26, "comprehension iteration") in kinds  # leak_iter
+    # negative: locked append, locked snapshot, __init__ stores
+    assert len(vs) == 2, vs
+
+
+def test_lock_map_undeclared_module_is_silent(tmp_path):
+    root = _write_tree(tmp_path, {
+        "telemetry/free.py": """
+            class S:
+                def __init__(self):
+                    self._items = []
+
+                def add(self, x):
+                    self._items.append(x)
+            """,
+    })
+    assert _flow(root, "lock-confinement") == []
+
+
+# --- waivers and staleness across tiers --------------------------------
+
+
+def test_flow_waiver_suppresses_and_wrong_rule_does_not(tmp_path):
+    root = _write_tree(tmp_path, {
+        "core/x.py": """
+            import jax.numpy as jnp
+
+            def f(x):
+                # audit: allow(wire-dtype-crossing)
+                return x.astype(jnp.int8)
+            """,
+    })
+    vs = _flow(root, "wire-dtype-crossing")
+    assert len(vs) == 1 and vs[0].waived and unwaived(vs) == []
+    root2 = _write_tree(tmp_path / "b", {
+        "core/x.py": """
+            import jax.numpy as jnp
+
+            def f(x):
+                # audit: allow(trace-purity)
+                return x.astype(jnp.int8)
+            """,
+    })
+    assert len(unwaived(_flow(root2, "wire-dtype-crossing"))) == 1
+
+
+def test_stale_flow_waiver_is_flagged(tmp_path):
+    root = _write_tree(tmp_path, {
+        "core/x.py": """
+            def f(x):
+                # audit: allow(lock-confinement)
+                return x
+            """,
+    })
+    stale = stale_waivers(root=root, violations=run_all(root=root))
+    assert len(stale) == 1 and "lock-confinement" in stale[0]
+    # restricting staleness to the legacy tier skips (not flags) it
+    assert stale_waivers(
+        root=root, violations=run_lint(root=root),
+        rule_names=[r.name for r in LEGACY_RULES]) == []
+
+
+def test_fixture_tree_has_no_stale_waivers(flowtree_program):
+    assert stale_waivers(
+        root=FLOWTREE,
+        violations=run_all(root=FLOWTREE,
+                           program=flowtree_program)) == []
+
+
+# --- report / baseline round trip --------------------------------------
+
+
+def test_lint_report_spans_both_tiers_and_round_trips(
+        flowtree_program):
+    vs = run_all(root=FLOWTREE, program=flowtree_program)
+    report = lint_report(vs)
+    # every flow rule is a legal (baseline-visible) rule name
+    for rule in ("trace-purity", "prng-keys", "wire-dtype-crossing",
+                 "lock-confinement"):
+        assert rule in report["rules"]
+    # waived findings from BOTH tiers land in the baseline subset
+    full = base_mod.build_report({"programs": {}}, report)
+    pinned = json.loads(json.dumps(base_mod.to_baseline(full)))
+    waived = pinned["lint"]["waived"]
+    assert any("wire-dtype-crossing" in w for w in waived)
+    assert any("raw-clock" in w for w in waived)
+    # unwaived findings are failures and never enter the baseline
+    assert full["failures"]
+    assert all("[waived]" in w for w in waived)
+    # a NEW waiver against this baseline is a visible diff
+    report2 = json.loads(json.dumps(full))
+    report2["failures"] = []
+    report2["lint"]["waived"].append(
+        "x.py:1: lock-confinement: new [waived]")
+    problems = base_mod.diff_against_baseline(report2, pinned)
+    assert any("new lint waiver" in p for p in problems)
+
+
+def test_telemetry_report_audit_diff(capsys, tmp_path,
+                                     package_parse):
+    """``telemetry_report.py --audit``: in sync against the committed
+    baseline (exit 0), and a doctored baseline renders the extra
+    entry as FIXED with exit 1."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        str(REPO_ROOT / "scripts" / "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    committed = str(REPO_ROOT / "audit_baseline.json")
+    # audit_report is what ``--audit`` dispatches to; driving it
+    # directly lets both checks reuse the suite's one engine run
+    # instead of paying two cold ones
+    assert mod.audit_report(
+        committed, as_json=False,
+        violations=package_parse["violations"]) == 0
+    out = capsys.readouterr().out
+    assert "in sync" in out and "wire-dtype-crossing" in out
+
+    doctored = json.loads(pathlib.Path(committed).read_text())
+    doctored["lint"]["waived"].append(
+        "ghost.py:1: host-sync: long gone [waived]")
+    p = tmp_path / "doctored.json"
+    p.write_text(json.dumps(doctored))
+    assert mod.audit_report(
+        str(p), as_json=False,
+        violations=package_parse["violations"]) == 1
+    out = capsys.readouterr().out
+    assert "FIXED ghost.py:1" in out
+
+
+# --- the real tree -----------------------------------------------------
+
+
+# ``package_parse`` — the session-scoped single engine run on the
+# real package — lives in conftest.py (test_audit shares it).
+
+
+@pytest.fixture(scope="module")
+def package_program(package_parse):
+    return package_parse["program"]
+
+
+def test_package_flow_tier_is_clean(package_parse):
+    flow_rules = {"trace-purity", "prng-keys", "wire-dtype-crossing",
+                  "lock-confinement"}
+    bad = [v for v in unwaived(package_parse["violations"])
+           if v.rule in flow_rules]
+    assert bad == [], "unwaived flow-tier violations in the package"
+
+
+def test_package_roots_look_sane(package_program):
+    p = package_program
+    assert len(p.jit_roots) >= 10
+    assert any(fq.startswith("core/rounds.py::") for fq in p.traced)
+    assert any(fq.startswith("core/server.py::") for fq in p.traced)
+    assert p.thread_roots, "no thread roots found in the package"
+
+
+def test_engine_wall_time_budget(package_parse):
+    """Full cold parse + both tiers on the whole package in under
+    10 s — the audit runs this on every CI pass, so the engine
+    staying cheap is part of its contract. (Timed around the shared
+    module fixture so tier-1 doesn't pay for a second cold run.)"""
+    elapsed = package_parse["elapsed"]
+    assert elapsed < 10.0, f"engine took {elapsed:.1f}s (budget 10s)"

@@ -402,6 +402,120 @@ def test_postmortem_survives_sigkill(tmp_path):
     assert [r["round"] for r in bundle["rounds"]] == [2, 3, 4, 5]
 
 
+# --- lock confinement under real threads (flowlint regression) ---------
+
+
+def test_flightrec_concurrent_writer_and_dump(tmp_path):
+    """The crash-hook/alarm threads dump while the round loop
+    appends: the ring snapshot under the lock means no 'deque mutated
+    during iteration', and the claim-before-I/O means two racing
+    dumps of the SAME incident write exactly one bundle."""
+    import threading
+
+    out = str(tmp_path / "pm")
+    fr = FlightRecorder(Config(), 4, labels={"job": "j"},
+                        out_dir=out)
+    fr.write({"kind": "meta", "plan": {}})
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        r = 0
+        while not stop.is_set():
+            try:
+                fr.write(_round_rec(r))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            r += 1
+
+    def dumper(reason):
+        try:
+            fr.dump(reason, rule="crash_race")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    w = threading.Thread(target=writer)
+    w.start()
+    dumpers = [threading.Thread(target=dumper, args=("crash",))
+               for _ in range(4)]
+    for t in dumpers:
+        t.start()
+    for t in dumpers:
+        t.join()
+    stop.set()
+    w.join()
+    assert errors == []
+    bundles = [n for n in os.listdir(out) if n.endswith(".json")]
+    assert len(bundles) == 1, bundles  # one incident, one bundle
+    _, problems = load_postmortem(os.path.join(out, bundles[0]))
+    assert problems == []
+
+
+def test_live_registry_concurrent_writers():
+    """HTTP scrape threads render while round loops publish: every
+    label-map write now happens under the registry lock, so N
+    hammering threads lose no increments and render() never sees a
+    mid-write dict."""
+    import threading
+
+    reg = LiveRegistry()
+    errors = []
+
+    def pound(j):
+        try:
+            for i in range(200):
+                reg.counter_add("ffl_rounds_total", 1.0,
+                                labels={"job": str(j)})
+                reg.gauge_set("ffl_loss", float(i),
+                              labels={"job": str(j)})
+                reg.render()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=pound, args=(j,))
+               for j in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    snap = reg.snapshot()
+    counts = snap["counters"]["ffl_rounds_total"]
+    assert sorted(counts.values()) == [200.0] * 4
+
+
+def test_jsonl_sink_concurrent_claim_single_winner(tmp_path):
+    """Two threads racing to open the same ledger path: the claim is
+    taken under ``_live_lock`` BEFORE the file opens, so exactly one
+    construction succeeds and the losers get the live-writer error —
+    never two writers interleaving on one shard."""
+    import threading
+
+    from commefficient_tpu.telemetry.sinks import JSONLSink
+
+    path = str(tmp_path / "led.jsonl")
+    results = []
+
+    def construct():
+        try:
+            results.append(JSONLSink(path))
+        except RuntimeError as e:
+            results.append(e)
+
+    threads = [threading.Thread(target=construct) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sinks = [r for r in results if isinstance(r, JSONLSink)]
+    errs = [r for r in results if isinstance(r, RuntimeError)]
+    assert len(sinks) == 1 and len(errs) == 3, results
+    sinks[0].close()
+    # the claim dies with close(): reopening is legal again
+    JSONLSink(path).close()
+
+
 def test_report_renders_postmortem(tmp_path, capsys):
     out = str(tmp_path / "pm")
     fr = FlightRecorder(Config(), 3, labels={"job": "7"}, out_dir=out)
