@@ -1,0 +1,50 @@
+"""causal-confinement: span machinery unreachable from jit roots.
+
+``--causal_trace`` sells a hard promise: tracing is host-side only
+and the compiled program is byte-identical with the flag off (the
+HLO-fingerprint tests pin the off mode). The cheapest way to break
+that promise silently is a refactor that threads a tracer call into
+a traced body — a span open inside a jitted round would freeze the
+``clock.tick()`` read into the program (trace-purity would also
+object) or, subtler, perturb what gets staged without tripping any
+per-call rule. This checker guards the promise structurally: NO
+function defined in the causal modules (``telemetry/causal.py``,
+``telemetry/critpath.py``) may be reachable from any jit/pallas
+root, period — not "is pure enough", but "is not on the traced call
+graph at all".
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from commefficient_tpu.analysis.flow import FlowChecker, Program
+
+#: modules whose every function must stay off the traced call graph
+CONFINED_RELS = ("telemetry/causal.py", "telemetry/critpath.py")
+
+
+def check(program: Program) -> List[Tuple[str, int, str]]:
+    out = []
+    seen = set()
+    for fq in sorted(program.traced):
+        fn = program.functions[fq]
+        rel = fn.module.rel.as_posix()
+        if rel not in CONFINED_RELS:
+            continue
+        key = (rel, fn.node.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append((rel, fn.node.lineno,
+                    f"causal-trace function {fn.qual} is reachable "
+                    "from a jit root — span machinery is host-side "
+                    "only (--causal_trace must stay HLO-identical "
+                    "off and on)"))
+    return out
+
+
+CHECKER = FlowChecker(
+    "causal-confinement",
+    "causal span/critpath code reachable from a jit root",
+    check)

@@ -439,6 +439,14 @@ class Config:
     # where postmortem bundles land (stamped into the run registry
     # when --runs_dir is known)
     postmortem_dir: str = "runs/postmortems"
+    # causal round tracing (telemetry/causal.py): record the round's
+    # span DAG with deterministic ids and stamp it on the round
+    # record (optional schema-v7 "causal" key) for the critical-path
+    # explainer (telemetry/critpath.py). Off (default): no tracer is
+    # constructed, no ledger field appears, and the compiled program
+    # is bit-identical. Entirely host-side; hash-excluded like the
+    # other observability taps.
+    causal_trace: bool = False
     # per-job SLO targets (telemetry/slo.py) — each 0 leaves that
     # objective un-armed; any nonzero target arms the SLO engine,
     # which merges slo_burn_* probes into the round record and stamps
@@ -1189,6 +1197,14 @@ def build_parser(default_lr: Optional[float] = None,
     parser.add_argument("--postmortem_dir", type=str,
                         default="runs/postmortems",
                         help="directory postmortem bundles land in")
+    parser.add_argument("--causal_trace", action="store_true",
+                        dest="causal_trace",
+                        help="causal round tracing: record the "
+                        "round's span DAG (deterministic ids) onto "
+                        "round records for the critical-path "
+                        "explainer (telemetry_report.py --critpath); "
+                        "host-side only, off keeps the build "
+                        "bit-identical")
     parser.add_argument("--slo_round_p95", type=float, default=0.0,
                         help="SLO round-latency objective: a round "
                         "slower than this many seconds is a "

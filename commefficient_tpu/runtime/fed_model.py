@@ -407,6 +407,18 @@ class FedModel:
         # is set; observed once per synchronous round in step()
         from commefficient_tpu.telemetry.slo import build_slo_engine
         self._slo = build_slo_engine(args)
+        # causal round tracer (telemetry/causal.py): None unless
+        # --causal_trace — every telemetry span then also records a
+        # causal frame, and the asyncfed driver adds cohort-issue /
+        # arrival-dequeue spans through the same tracer. The job
+        # index keys the deterministic trace ids, so daemon-side
+        # grant spans stitch in by id across the process boundary.
+        from commefficient_tpu.telemetry.causal import \
+            build_causal_tracer
+        self.telemetry.set_causal_tracer(
+            build_causal_tracer(args, job=job))
+        if self._async_driver is not None:
+            self._async_driver.causal = self.telemetry.causal
         self.telemetry.emit_meta(
             num_clients=num_clients,
             num_devices=int(np.prod(self.mesh.devices.shape)),

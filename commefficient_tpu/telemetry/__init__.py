@@ -13,10 +13,16 @@ logdir naming and must not cycle through this package import.
 """
 
 from commefficient_tpu.telemetry import clock, trace
+from commefficient_tpu.telemetry.causal import (CausalTracer,
+                                                assemble_traces,
+                                                build_causal_tracer)
 from commefficient_tpu.telemetry.core import (NULL_TELEMETRY, Telemetry,
                                               build_telemetry,
                                               hbm_peak_bytes,
                                               host_rss_peak_bytes)
+from commefficient_tpu.telemetry.critpath import (critical_path,
+                                                  critpath_diff,
+                                                  median_buckets)
 from commefficient_tpu.telemetry.record import (LEDGER_SCHEMA_VERSION,
                                                 make_bench_record,
                                                 make_meta_record,
@@ -70,4 +76,10 @@ __all__ = [
     "SLOEngine",
     "SLOSpec",
     "build_slo_engine",
+    "CausalTracer",
+    "assemble_traces",
+    "build_causal_tracer",
+    "critical_path",
+    "critpath_diff",
+    "median_buckets",
 ]

@@ -53,17 +53,25 @@ NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("_spans", "_name", "_t0")
+    __slots__ = ("_spans", "_name", "_t0", "_causal")
 
-    def __init__(self, spans, name):
+    def __init__(self, spans, name, causal=None):
         self._spans = spans
         self._name = name
+        self._causal = causal
 
     def __enter__(self):
         self._t0 = clock.tick()
+        if self._causal is not None:
+            # open AFTER t0 so the causal frame nests inside the
+            # accumulated span second-for-second; nesting (driver
+            # spans inside async_fold) comes from the tracer's stack
+            self._causal.open(self._name)
         return self
 
     def __exit__(self, *exc):
+        if self._causal is not None:
+            self._causal.close_span()
         dt = clock.tick() - self._t0
         self._spans[self._name] = self._spans.get(self._name, 0.0) + dt
         return False
@@ -164,6 +172,11 @@ class Telemetry:
         # collective-skew check so trace-derived skew can escalate
         # like any other alarm rule
         self.on_device_time = None
+        # optional CausalTracer (--causal_trace): every _Span also
+        # opens/closes a causal frame, and closing a round stamps its
+        # span DAG onto the record as the optional v7 ``causal`` key.
+        # None (the default) keeps the hot path byte-identical.
+        self.causal = None
         if self._sinks:
             _ensure_compile_listener()
 
@@ -178,6 +191,11 @@ class Telemetry:
         once the run's logdir exists)."""
         self._sinks.append(sink)
         _ensure_compile_listener()
+
+    def set_causal_tracer(self, tracer):
+        """Attach a CausalTracer (or None to detach). Only meaningful
+        on an enabled Telemetry — causal stamps ride round records."""
+        self.causal = tracer if self._sinks else None
 
     def emit(self, rec):
         for sink in self._sinks:
@@ -199,6 +217,8 @@ class Telemetry:
         self._records[index] = rec
         self._current = rec
         self._compile_mark = (_COMPILE["events"], _COMPILE["secs"])
+        if self.causal is not None:
+            self.causal.begin_round(index)
         return rec
 
     def _close_current(self):
@@ -211,6 +231,10 @@ class Telemetry:
         rec["counters"]["compile_events"] = _COMPILE["events"] - ev0
         rec["counters"]["compile_secs"] = round(
             _COMPILE["secs"] - s0, 6)
+        if self.causal is not None:
+            stamp = self.causal.end_round()
+            if stamp is not None:
+                rec["causal"] = stamp
         self._closed_rounds.add(rec["round"])
         self._drain()
 
@@ -219,7 +243,7 @@ class Telemetry:
         round record; the shared no-op outside a round / disabled."""
         if self._current is None:
             return NULL_SPAN
-        return _Span(self._current["spans"], name)
+        return _Span(self._current["spans"], name, self.causal)
 
     def count(self, name: str, n: int = 1):
         if self._current is not None:

@@ -108,18 +108,36 @@ conventions:
              ``rounds`` list holds schema-validated round records;
              the run registry's ``postmortem``/``postmortem_reason``
              manifest keys are the lineage stamp.
+
+Schema v7 adds NO required keys — one optional round-record key
+(causal round tracing, telemetry/causal.py):
+
+``causal`` — absent unless the run set ``--causal_trace`` (absent,
+             not None: the off path must add zero ledger fields),
+             else {"trace", "job", "round", "wall", "spans"} where
+             ``spans`` is the round's span DAG — dicts with
+             deterministic ``id``, ``parent`` (None for the round
+             root), ``name``, critical-path ``bucket``, monotonic
+             ``b``/``e`` seconds, and an optional ``trace`` override
+             for spans a process records into ANOTHER trace (the
+             fedservice daemon's ``sched_grant`` riding its own tick
+             record but belonging to the tenant's round trace).
+             ``scripts/ledger_merge.py`` reassembles per-trace DAGs
+             by id across ``.p<k>``/``.job<j>`` shards;
+             telemetry/critpath.py folds each DAG into per-bucket
+             critical-path seconds.
 """
 
 from __future__ import annotations
 
 from commefficient_tpu.telemetry import clock
 
-LEDGER_SCHEMA_VERSION = 6
+LEDGER_SCHEMA_VERSION = 7
 
 # versions validate_record accepts: v1 (pre-probe), v2 (pre-trace),
-# v3 (pre-fleet), v4 (pre-DP) and v5 (pre-SLO) ledgers stay readable
-# by the report tooling
-READABLE_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
+# v3 (pre-fleet), v4 (pre-DP), v5 (pre-SLO) and v6 (pre-causal)
+# ledgers stay readable by the report tooling
+READABLE_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 # device_time keys whose values are nested dicts (v4); every other
 # bucket value must be numeric
@@ -157,6 +175,13 @@ ROUND_V5_KEYS = (
 ROUND_V6_KEYS = (
     "slo",                                 # None without an SLO engine
 )
+
+# v7 adds no required keys: ``causal`` is optional (present only
+# under --causal_trace) so the off path adds zero ledger fields
+ROUND_V7_KEYS = ()
+
+# keys every span dict inside a causal stamp must carry
+CAUSAL_SPAN_KEYS = ("id", "parent", "name", "bucket", "b", "e")
 
 
 def _base(kind: str) -> dict:
@@ -212,6 +237,40 @@ def make_summary_record(**fields) -> dict:
     return rec
 
 
+def _validate_causal(causal) -> list:
+    """Problems with an optional v7 ``causal`` stamp (the key is
+    validated only when present — absence is the off-mode contract)."""
+    if not isinstance(causal, dict):
+        return ["causal is not a dict"]
+    problems = []
+    if not isinstance(causal.get("trace"), str):
+        problems.append("causal.trace is not a string")
+    if not isinstance(causal.get("round"), int):
+        problems.append("causal.round is not an int")
+    if not isinstance(causal.get("wall"), (int, float)):
+        problems.append("causal.wall is non-numeric")
+    spans = causal.get("spans")
+    if not isinstance(spans, list):
+        return problems + ["causal.spans is not a list"]
+    for span in spans:
+        if not isinstance(span, dict):
+            problems.append("causal span is not a dict")
+            continue
+        for key in CAUSAL_SPAN_KEYS:
+            if key not in span:
+                problems.append(f"causal span missing {key!r}")
+        for key in ("id", "name", "bucket"):
+            if key in span and not isinstance(span[key], str):
+                problems.append(f"causal span {key} is not a string")
+        if span.get("parent") is not None \
+                and not isinstance(span.get("parent"), str):
+            problems.append("causal span parent is not str-or-None")
+        for key in ("b", "e"):
+            if key in span and not isinstance(span[key], (int, float)):
+                problems.append(f"causal span {key} is non-numeric")
+    return problems
+
+
 def validate_record(rec) -> list:
     """Schema check: a list of problem strings, empty when valid."""
     problems = []
@@ -253,6 +312,8 @@ def validate_record(rec) -> list:
         slo = rec.get("slo")
         if slo is not None and not isinstance(slo, dict):
             problems.append("slo is not a dict")
+        if "causal" in rec:                # optional (v7): validate
+            problems.extend(_validate_causal(rec["causal"]))
         dt = rec.get("device_time")
         if dt is not None:
             if not isinstance(dt, dict):

@@ -1076,6 +1076,104 @@ def live_smoke():
             f"postmortem bundle round-trips ({bundle['reason']})")
 
 
+def causal_smoke():
+    """--causal_trace on the REAL backend: a traced FedModel run
+    stamps every round record with a span DAG that closes (no orphan
+    parents), whose critical path reproduces the round wall within
+    clock tolerance — and the flag is provably inert off: the lowered
+    client-round program is byte-identical with the knob set (the
+    spans live entirely on the host)."""
+    import dataclasses
+    import json
+    import shutil
+    import tempfile
+
+    from commefficient_tpu.config import Config
+    from commefficient_tpu.core.rounds import (ClientStates,
+                                               build_client_round)
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+    from commefficient_tpu.telemetry.causal import assemble_traces
+    from commefficient_tpu.telemetry.critpath import (CLOCK_TOLERANCE,
+                                                      critical_path)
+
+    W, B, d, R = 8, 2, 1 << 10, 3
+
+    def loss(params, batch, cfg):
+        pred = batch["x"] @ params["w"]
+        n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+        l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    cfg = Config(mode="local_topk", error_type="local",
+                 local_momentum=0.9, virtual_momentum=0.0, k=8,
+                 num_workers=W, local_batch_size=B, num_clients=64,
+                 seed=3, causal_trace=True)
+
+    # 1. HLO identity: the knob must not perturb the compiled program
+    def lin_loss(p, b):
+        pred = b["x"] @ p
+        n = jnp.maximum(jnp.sum(b["mask"]), 1.0)
+        l = jnp.sum((pred - b["y"]) ** 2 * b["mask"]) / n
+        return l, (l * 0.0 + 1.0,)
+
+    lcfg = dataclasses.replace(cfg, causal_trace=False, grad_size=d)
+
+    def lower(c):
+        ps = jax.ShapeDtypeStruct((d,), jnp.float32)
+        cs = jax.eval_shape(
+            lambda: ClientStates.init(c, cfg.num_clients,
+                                      jnp.zeros((d,), jnp.float32)))
+        batch = {"x": jax.ShapeDtypeStruct((W, B, d), jnp.float32),
+                 "y": jax.ShapeDtypeStruct((W, B), jnp.float32),
+                 "mask": jax.ShapeDtypeStruct((W, B), jnp.float32)}
+        return jax.jit(build_client_round(c, lin_loss, B)).lower(
+            ps, cs, batch, jax.ShapeDtypeStruct((W,), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((), jnp.float32)).as_text()
+
+    assert lower(dataclasses.replace(lcfg, causal_trace=True)) \
+        == lower(lcfg), "--causal_trace perturbed the lowered HLO"
+
+    # 2. traced run: DAG closes, critical path == wall
+    rng = np.random.RandomState(7)
+    tmp = tempfile.mkdtemp(prefix="causal_smoke_")
+    try:
+        led = os.path.join(tmp, "run.jsonl")
+        rcfg = dataclasses.replace(cfg, ledger=led)
+        model = FedModel(None,
+                         {"w": jnp.zeros((d,), jnp.float32)}, loss,
+                         rcfg, padded_batch_size=B, mesh=None)
+        opt = FedOptimizer([{"lr": 0.25}], rcfg, model=model)
+        for _ in range(R):
+            model({"client_ids": rng.choice(64, W, replace=False)
+                   .astype(np.int32),
+                   "x": jnp.asarray(rng.randn(W, B, d), jnp.float32),
+                   "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+                   "mask": jnp.ones((W, B), jnp.float32)})
+            opt.step()
+        model.finalize()
+        records = [json.loads(line) for line in open(led)]
+        rounds = [r for r in records if r.get("kind") == "round"]
+        assert len(rounds) == R and all(
+            isinstance(r.get("causal"), dict) for r in rounds), rounds
+        worst = 0.0
+        for rec in rounds:
+            crit = critical_path(rec["causal"],
+                                 rec.get("device_time"))
+            gap = abs(sum(crit["buckets"].values()) - crit["wall"])
+            worst = max(worst, gap)
+            assert gap <= CLOCK_TOLERANCE, (gap, crit)
+        traces = assemble_traces(records)
+        orphans = {t: d_["orphans"] for t, d_ in traces.items()
+                   if d_["orphans"]}
+        assert len(traces) == R and not orphans, orphans
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return (f"HLO identical off/on; {R} traced rounds closed, "
+            f"critpath==wall to {worst:.1e}s")
+
+
 def main():
     print(f"devices: {jax.devices()}")
     check("pallas_vs_xla_sketch_parity", pallas_parity)
@@ -1096,6 +1194,7 @@ def main():
     check("chaos_smoke", chaos_smoke)
     check("dp_smoke", dp_smoke)
     check("live_smoke", live_smoke)
+    check("causal_smoke", causal_smoke)
     check("bench_vs_baseline", bench_throughput)
     if FAILED:
         print(f"\n{len(FAILED)} check(s) failed: {FAILED}")

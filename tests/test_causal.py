@@ -1,0 +1,678 @@
+"""Causal round tracing (telemetry/causal.py + critpath.py): the
+deterministic id scheme, golden-DAG critical-path attribution (the
+buckets-sum-to-wall invariant is exact by construction), the tracer
+lifecycle on a real CPU FedModel run (and the zero-ledger-field off
+mode), cross-process/cross-job stitching through ledger_merge
+including torn-tail shards, the flight recorder's critical-path diff
+on latency alarms + the postmortem render, fedwatch's crit column on
+both the scrape and ledger paths, the --critpath report, and the
+causal-confinement flowlint rule."""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import textwrap
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import Config
+from commefficient_tpu.telemetry.causal import (BUCKETS, SEQ_GRANT,
+                                                SEQ_ROOT,
+                                                CausalTracer,
+                                                assemble_traces,
+                                                bucket_of,
+                                                build_causal_tracer,
+                                                span_id, trace_id)
+from commefficient_tpu.telemetry.critpath import (CLOCK_TOLERANCE,
+                                                  critical_path,
+                                                  critpath_diff,
+                                                  dominant_bucket,
+                                                  median_buckets)
+from commefficient_tpu.telemetry.record import (make_round_record,
+                                                validate_record)
+
+W, B, DIM = 8, 2, 64
+
+
+def _load_script(name):
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- deterministic ids -------------------------------------------------
+
+
+def test_ids_are_pure_functions_of_job_round_seq():
+    """Both sides of a process boundary mint the same ids with no
+    handshake — the whole stitch protocol."""
+    assert trace_id(None, 3) == "jsolo.r3"
+    assert trace_id(0, 3) == "j0.r3"
+    assert trace_id("service", 12) == "jservice.r12"
+    assert span_id(2, 5, SEQ_GRANT) == "j2.r5.s2"
+    assert span_id(None, 0, SEQ_ROOT) == "jsolo.r0.s0"
+    # stability across calls (no clock / RNG component)
+    assert trace_id(7, 9) == trace_id(7, 9)
+
+
+def test_bucket_map_is_total():
+    assert bucket_of("h2d") == "h2d"
+    assert bucket_of("sched_grant") == "sched_wait"
+    assert bucket_of("checkpoint") == "flush"
+    # unknown span names can never silently inflate a named bucket
+    assert bucket_of("brand_new_phase") == "host_other"
+    assert BUCKETS[-1] == "host_other"
+
+
+# --- golden DAGs: the attribution invariant ----------------------------
+
+
+def _gspan(seq, name, b, e, parent_seq=SEQ_ROOT, job=None, r=0):
+    return {"id": span_id(job, r, seq),
+            "parent": None if parent_seq is None
+            else span_id(job, r, parent_seq),
+            "name": name, "bucket": bucket_of(name),
+            "b": float(b), "e": float(e)}
+
+
+def _golden_stamp():
+    """Root [0,10] with gather [1,3], h2d [3,4], dispatch [4,8]
+    (nesting a collective [6,7]), flush [8,9.5]. Hand-computed:
+    host_gather 2, h2d 1, compute 3 (dispatch minus its collective),
+    collective_exposed 1, flush 1.5, host_other 1.5 (root gaps
+    [0,1] + [9.5,10])."""
+    spans = [_gspan(SEQ_ROOT, "round", 0, 10, parent_seq=None),
+             _gspan(8, "gather", 1, 3),
+             _gspan(9, "h2d", 3, 4),
+             _gspan(10, "round_dispatch", 4, 8),
+             _gspan(11, "collective", 6, 7, parent_seq=10),
+             _gspan(12, "flush", 8, 9.5)]
+    spans[0]["bucket"] = "host_other"
+    return {"trace": trace_id(None, 0), "job": None, "round": 0,
+            "wall": 10.0, "spans": spans}
+
+
+def test_golden_dag_attribution_is_exact():
+    crit = critical_path(_golden_stamp())
+    assert crit["round"] == 0 and crit["wall"] == 10.0
+    want = {"sched_wait": 0.0, "arrival_wait": 0.0,
+            "host_gather": 2.0, "h2d": 1.0, "compute": 3.0,
+            "collective_exposed": 1.0, "writeback": 0.0,
+            "flush": 1.5, "host_other": 1.5}
+    assert crit["buckets"] == pytest.approx(want)
+    # the invariant is exact, not approximate
+    assert sum(crit["buckets"].values()) == crit["wall"]
+    assert dominant_bucket(crit) == ("compute", pytest.approx(0.3))
+
+
+def test_golden_dag_clips_overlap_and_overrun():
+    """A sibling overlapping an earlier child is clipped to the
+    uncovered remainder; a child overrunning the root is clipped to
+    the root end — the invariant survives dirty timestamps."""
+    spans = [_gspan(SEQ_ROOT, "round", 0, 10, parent_seq=None),
+             _gspan(8, "gather", 1, 6),
+             _gspan(9, "h2d", 4, 5),       # fully inside gather
+             _gspan(10, "flush", 8, 12)]   # overruns the root
+    spans[0]["bucket"] = "host_other"
+    crit = critical_path({"trace": "jsolo.r0", "round": 0,
+                          "wall": 10.0, "spans": spans})
+    assert crit["buckets"]["host_gather"] == pytest.approx(5.0)
+    assert crit["buckets"]["h2d"] == pytest.approx(0.0)
+    assert crit["buckets"]["flush"] == pytest.approx(2.0)
+    assert sum(crit["buckets"].values()) == crit["wall"] == 10.0
+
+
+def test_device_time_overlay_moves_only_exposed_collective():
+    """per_device collective minus overlapped, clipped to the compute
+    bucket, migrates compute -> collective_exposed; totals hold."""
+    dt = {"per_device": [{"collective_s": 2.0, "overlapped_s": 1.5}]}
+    crit = critical_path(_golden_stamp(), dt)
+    assert crit["buckets"]["compute"] == pytest.approx(2.5)
+    assert crit["buckets"]["collective_exposed"] == pytest.approx(1.5)
+    assert sum(crit["buckets"].values()) == crit["wall"]
+    # fully-hidden collective moves nothing
+    dt = {"per_device": [{"collective_s": 1.0, "overlapped_s": 3.0}]}
+    crit = critical_path(_golden_stamp(), dt)
+    assert crit["buckets"]["compute"] == pytest.approx(3.0)
+    # exposure can never exceed what compute actually covered
+    dt = {"per_device": {"collective_s": 99.0, "overlapped_s": 0.0}}
+    crit = critical_path(_golden_stamp(), dt)
+    assert crit["buckets"]["compute"] == pytest.approx(0.0)
+    assert crit["buckets"]["collective_exposed"] == pytest.approx(4.0)
+    assert sum(crit["buckets"].values()) == crit["wall"]
+
+
+def test_critpath_diff_and_median():
+    def crit(compute, h2d, r):
+        b = {k: 0.0 for k in BUCKETS}
+        b["compute"], b["h2d"] = compute, h2d
+        return {"round": r, "wall": compute + h2d, "buckets": b}
+
+    base = median_buckets([crit(1.0, 0.1, 0), crit(1.2, 0.1, 1),
+                           crit(1.4, 0.3, 2)])
+    assert base["compute"] == pytest.approx(1.2)
+    assert base["h2d"] == pytest.approx(0.1)
+    d = critpath_diff(crit(3.0, 0.1, 3), base)
+    assert d["round"] == 3 and d["wall"] == pytest.approx(3.1)
+    assert d["base_wall"] == pytest.approx(1.3)
+    # rows sorted by absolute growth; ratio None when the median is 0
+    assert d["rows"][0]["bucket"] == "compute"
+    assert d["rows"][0]["delta_s"] == pytest.approx(1.8)
+    assert d["rows"][0]["ratio"] == pytest.approx(2.5)
+    flush_row = next(r for r in d["rows"] if r["bucket"] == "flush")
+    assert flush_row["ratio"] is None
+    assert median_buckets([]) is None
+    assert critpath_diff(None, base) is None
+
+
+def test_critical_path_rejects_unusable_stamps():
+    assert critical_path(None) is None
+    assert critical_path({"spans": []}) is None
+    # a foreign span (trace override) is never picked as the root
+    grant = _gspan(SEQ_GRANT, "sched_grant", 0, 1, parent_seq=None)
+    grant["trace"] = "j0.r0"
+    assert critical_path({"spans": [grant]}) is None
+
+
+# --- tracer lifecycle --------------------------------------------------
+
+
+def test_tracer_nests_and_stamps():
+    t = CausalTracer(job=4)
+    assert t.end_round() is None    # no round open
+    t.begin_round(2)
+    with t.span("gather"):
+        pass
+    with t.span("round_dispatch"):
+        with t.span("collective"):
+            pass
+    stamp = t.end_round()
+    assert stamp["trace"] == "j4.r2" and stamp["round"] == 2
+    by_name = {s["name"]: s for s in stamp["spans"]}
+    root = by_name["round"]
+    assert root["id"] == span_id(4, 2, SEQ_ROOT)
+    assert root["parent"] is None
+    assert by_name["gather"]["parent"] == root["id"]
+    # nesting: the inner span's parent is the enclosing span
+    assert by_name["collective"]["parent"] == \
+        by_name["round_dispatch"]["id"]
+    crit = critical_path(stamp)
+    assert abs(sum(crit["buckets"].values()) - crit["wall"]) \
+        <= CLOCK_TOLERANCE
+    # the stamp validates as a v7 causal payload on a round record
+    rec = make_round_record(2)
+    rec["causal"] = stamp
+    assert validate_record(rec) == []
+
+
+def test_tracer_ignores_non_owner_threads():
+    """Prefetch workers can't corrupt the owner's open stack — spans
+    from other threads are dropped, not misfiled."""
+    t = CausalTracer()
+    t.begin_round(0)
+    worker = threading.Thread(target=lambda: t.open("gather"))
+    worker.start()
+    worker.join()
+    stamp = t.end_round()
+    assert [s["name"] for s in stamp["spans"]] == ["round"]
+
+
+def test_foreign_spans_ride_next_round_and_stitch():
+    """A daemon-minted grant buffers until the daemon's next round
+    record and lands in the TENANT trace at stitch time, parented
+    onto the tenant's deterministic root id — zero orphans."""
+    svc = CausalTracer(job="service")
+    svc.begin_round(0)
+    svc.add_event("sched_grant", 1.0, 2.0, trace=trace_id(0, 5),
+                  sid=span_id(0, 5, SEQ_GRANT),
+                  parent=span_id(0, 5, SEQ_ROOT))
+    svc_stamp = svc.end_round()
+
+    tenant = CausalTracer(job=0)
+    tenant.begin_round(5)
+    with tenant.span("h2d"):
+        pass
+    ten_stamp = tenant.end_round()
+
+    traces = assemble_traces([{"kind": "round", "causal": svc_stamp},
+                              {"kind": "round",
+                               "causal": ten_stamp}])
+    t = traces["j0.r5"]
+    assert t["orphans"] == []
+    assert span_id(0, 5, SEQ_GRANT) in t["spans"]
+    assert t["round"] == 5
+    # a genuinely missing parent IS reported
+    lone = {"trace": "j9.r9", "round": 9, "wall": 0.0,
+            "spans": [_gspan(8, "h2d", 0, 1, job=9, r=9)]}
+    orphan = assemble_traces([{"kind": "round", "causal": lone}])
+    assert orphan["j9.r9"]["orphans"] == [span_id(9, 9, 8)]
+
+
+def test_build_causal_tracer_gates_on_flag():
+    assert build_causal_tracer(Config()) is None
+    t = build_causal_tracer(Config(causal_trace=True), job=3)
+    assert isinstance(t, CausalTracer) and t.job == 3
+
+
+def test_schema_v7_validation():
+    rec = make_round_record(0)
+    assert "causal" not in rec      # off mode adds ZERO fields
+    assert validate_record(rec) == []
+    rec["causal"] = {"trace": "jsolo.r0", "round": 0, "wall": 1.0,
+                     "spans": "nope"}
+    assert any("spans" in p for p in validate_record(rec))
+    rec["causal"] = {"trace": "jsolo.r0", "round": 0, "wall": 1.0,
+                     "spans": [{"id": "x"}]}
+    assert validate_record(rec) != []
+
+
+# --- real CPU runs: solo on/off and the daemon stitch ------------------
+
+
+def _loss(params, batch, cfg):
+    pred = batch["x"] @ params["w"]
+    n = jnp.maximum(jnp.sum(batch["mask"]), 1.0)
+    l = jnp.sum((pred - batch["y"]) ** 2 * batch["mask"]) / n
+    return l, (l * 0.0 + 1.0,)
+
+
+def _job_cfg(seed, ledger="", **kw):
+    base = dict(mode="local_topk", error_type="local",
+                local_momentum=0.9, virtual_momentum=0.0, k=8,
+                num_workers=W, local_batch_size=B, num_clients=64,
+                seed=seed, ledger=ledger)
+    base.update(kw)
+    return Config(**base)
+
+
+def _builder(cfg, mesh):
+    from commefficient_tpu.runtime.fed_model import (FedModel,
+                                                     FedOptimizer)
+    model = FedModel(None, {"w": jnp.zeros((DIM,), jnp.float32)},
+                     _loss, cfg, padded_batch_size=B, mesh=mesh)
+    opt = FedOptimizer([{"lr": 0.25}], cfg, model=model)
+    return model, opt
+
+
+def _batches(seed, n):
+    rng = np.random.RandomState(seed)
+    return [
+        {"client_ids": rng.choice(64, W, replace=False)
+         .astype(np.int32),
+         "x": jnp.asarray(rng.randn(W, B, DIM), jnp.float32),
+         "y": jnp.asarray(rng.randn(W, B), jnp.float32),
+         "mask": jnp.ones((W, B), jnp.float32)}
+        for _ in range(n)]
+
+
+def _solo(seed, rounds, ledger, **cfg_kw):
+    model, opt = _builder(_job_cfg(seed, ledger, **cfg_kw), None)
+    for batch in _batches(7, rounds):
+        model(batch)
+        opt.step()
+    model.finalize()
+    return [json.loads(line) for line in open(ledger)]
+
+
+class TestRealRuns:
+    def test_traced_solo_run_stamps_every_round(self, tmp_path):
+        recs = _solo(3, 3, str(tmp_path / "on.jsonl"),
+                     causal_trace=True)
+        rounds = [r for r in recs if r.get("kind") == "round"]
+        assert len(rounds) == 3
+        for rec in rounds:
+            assert validate_record(rec) == []
+            crit = critical_path(rec["causal"],
+                                 rec.get("device_time"))
+            assert crit["round"] == rec["round"]
+            assert abs(sum(crit["buckets"].values())
+                       - crit["wall"]) <= CLOCK_TOLERANCE
+        traces = assemble_traces(recs)
+        assert sorted(traces) == ["jsolo.r0", "jsolo.r1", "jsolo.r2"]
+        assert all(not t["orphans"] for t in traces.values())
+
+    def test_off_mode_adds_zero_ledger_fields(self, tmp_path):
+        on = _solo(3, 2, str(tmp_path / "on.jsonl"),
+                   causal_trace=True)
+        off = _solo(3, 2, str(tmp_path / "off.jsonl"))
+        for rec in off:
+            assert "causal" not in rec
+        # on-mode adds EXACTLY the one stamp, nothing else
+        on_r = [r for r in on if r.get("kind") == "round"]
+        off_r = [r for r in off if r.get("kind") == "round"]
+        assert [set(a) - set(b) for a, b in zip(on_r, off_r)] \
+            == [{"causal"}, {"causal"}]
+
+    def test_daemon_grants_stitch_into_tenant_traces(self, tmp_path):
+        from commefficient_tpu.fedservice import FedService, JobSpec
+        R = 2
+        led = str(tmp_path / "svc.jsonl")
+        svc = FedService(Config(num_workers=W, local_batch_size=B,
+                                num_clients=64, ledger=led,
+                                causal_trace=True))
+        bs = [_batches(7, R), _batches(9, R)]
+        svc.admit(JobSpec("a", _job_cfg(3, causal_trace=True),
+                          _builder, lambda r: bs[0][r], rounds=R))
+        svc.admit(JobSpec("b", _job_cfg(4, causal_trace=True),
+                          _builder, lambda r: bs[1][r], rounds=R))
+        svc.run()
+        svc.close()
+        recs = []
+        for p in (led, f"{led}.job0.jsonl", f"{led}.job1.jsonl"):
+            recs += [json.loads(line) for line in open(p)]
+        traces = assemble_traces(recs)
+        for j in range(2):
+            for r in range(R):
+                t = traces[trace_id(j, r)]
+                assert t["orphans"] == [], (j, r, t["orphans"])
+                names = {s["name"] for s in t["spans"].values()}
+                assert "sched_grant" in names, (j, r, names)
+        # admission lands in each tenant's round-0 trace
+        assert any(s["name"] == "admission"
+                   for s in traces["j0.r0"]["spans"].values())
+        assert any(s["name"] == "admission"
+                   for s in traces["j1.r0"]["spans"].values())
+
+
+# --- ledger_merge: the shard matrix + torn tails -----------------------
+
+
+def _stamp(job, r, extra_spans=()):
+    root = _gspan(SEQ_ROOT, "round", 0, 10, parent_seq=None,
+                  job=job, r=r)
+    root["bucket"] = "host_other"
+    return {"trace": trace_id(job, r), "job": job, "round": r,
+            "wall": 10.0, "spans": [root] + list(extra_spans)}
+
+
+class TestLedgerMergeStitch:
+    def _write(self, path, recs, torn=False):
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+            if torn:
+                f.write('{"kind": "round", "rou')   # SIGKILL tail
+
+    def test_job_process_matrix_stitches_without_orphans(
+            self, tmp_path, capsys):
+        lm = _load_script("ledger_merge")
+        base = str(tmp_path / "svc.jsonl")
+        # canonical service ledger: one round carrying the foreign
+        # grant spans for both tenants' round 0
+        svc_rec = make_round_record(0)
+        grants = []
+        for j in range(2):
+            g = _gspan(SEQ_GRANT, "sched_grant", 0.0, 0.5,
+                       job=j, r=0)
+            g["trace"] = trace_id(j, 0)
+            grants.append(g)
+        svc_rec["causal"] = _stamp("service", 0, grants)
+        self._write(base, [svc_rec])
+        # job shards: process-0 view has the root + an h2d child;
+        # the .p1 sub-shard contributes a gather span for the SAME
+        # round (dedup by id must union, not duplicate)
+        for j in range(2):
+            rec = make_round_record(0)
+            rec["causal"] = _stamp(
+                j, 0, [_gspan(8, "h2d", 1, 2, job=j, r=0)])
+            self._write(f"{base}.job{j}.jsonl", [rec])
+            sub = make_round_record(0)
+            sub["causal"] = _stamp(
+                j, 0, [_gspan(9, "gather", 2, 3, job=j, r=0)])
+            # job 1's sub-shard is torn mid-record (host died): the
+            # valid prefix must still merge
+            self._write(f"{base}.job{j}.jsonl.p1.jsonl", [sub],
+                        torn=(j == 1))
+        assert lm.main([base]) == 0
+        merged = [json.loads(line)
+                  for line in open(base + ".merged.jsonl")]
+        out = capsys.readouterr()
+        assert "causal:" in out.out and " 0 orphan(s)" in out.out
+        assert "not JSON" in out.err           # the torn tail warned
+        for j in range(2):
+            jr = next(r for r in merged if r.get("job") == j
+                      and r.get("kind") == "round")
+            names = sorted(s["name"]
+                           for s in jr["causal"]["spans"])
+            assert names == ["gather", "h2d", "round"], names
+            # dedup by id: both shards carried the root exactly once
+            ids = [s["id"] for s in jr["causal"]["spans"]]
+            assert len(ids) == len(set(ids))
+        traces = assemble_traces(merged)
+        assert sorted(traces) == ["j0.r0", "j1.r0", "jservice.r0"]
+        assert all(not t["orphans"] for t in traces.values())
+        for j in range(2):
+            assert span_id(j, 0, SEQ_GRANT) \
+                in traces[trace_id(j, 0)]["spans"]
+
+    def test_orphan_spans_are_warned_not_fatal(self, tmp_path,
+                                               capsys):
+        lm = _load_script("ledger_merge")
+        base = str(tmp_path / "svc.jsonl")
+        rec = make_round_record(0)
+        # child span whose parent id no shard ever supplies
+        lost = _gspan(8, "h2d", 1, 2)
+        lost["parent"] = "jsolo.r0.s99"
+        rec["causal"] = {"trace": "jsolo.r0", "round": 0,
+                         "wall": 10.0, "spans": [lost]}
+        self._write(base, [rec])
+        self._write(base + ".p1.jsonl", [make_round_record(0)])
+        assert lm.main([base]) == 0
+        out = capsys.readouterr()
+        assert "1 orphan(s)" in out.out
+        assert "orphan span(s)" in out.err
+
+
+# --- flight recorder: critical-path diff on latency alarms -------------
+
+
+class TestFlightRecorderDiff:
+    def _recorder(self, tmp_path, rounds, alarm_rule):
+        from commefficient_tpu.telemetry.flightrec import \
+            FlightRecorder
+        fr = FlightRecorder(Config(), ring_rounds=8,
+                            out_dir=str(tmp_path / "pm"))
+        for r in range(rounds):
+            rec = make_round_record(r)
+            slow = 10.0 if r == rounds - 1 else 1.0
+            root = _gspan(SEQ_ROOT, "round", 0, slow,
+                          parent_seq=None, r=r)
+            root["bucket"] = "host_other"
+            rec["causal"] = {
+                "trace": trace_id(None, r), "job": None, "round": r,
+                "wall": slow,
+                "spans": [root, _gspan(8, "h2d", 0, 0.5 * slow,
+                                       r=r)]}
+            if r == rounds - 1:
+                rec["alarms"] = [{"rule": alarm_rule, "round": r,
+                                  "value": slow, "threshold": 2.0}]
+            fr.write(rec)
+        return fr
+
+    def test_latency_alarm_bundle_carries_critpath_diff(
+            self, tmp_path):
+        from commefficient_tpu.telemetry.flightrec import \
+            load_postmortem
+        fr = self._recorder(tmp_path, 5, "step_time_regression")
+        bundle, problems = load_postmortem(fr.last_bundle)
+        assert problems == []
+        diff = bundle["context"]["critpath_diff"]
+        assert diff["round"] == 4
+        assert diff["wall"] == pytest.approx(10.0)
+        assert diff["base_wall"] == pytest.approx(1.0)
+        top = diff["rows"][0]
+        assert top["bucket"] in ("h2d", "host_other")
+        assert top["delta_s"] == pytest.approx(4.5)
+        # the postmortem report renders the diff section
+        tr = _load_script("telemetry_report")
+        import io
+        from contextlib import redirect_stdout
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert tr.postmortem_report(fr.last_bundle, False) == 0
+        assert "critical-path diff" in buf.getvalue()
+
+    def test_non_latency_rule_attaches_no_diff(self, tmp_path):
+        from commefficient_tpu.telemetry.flightrec import \
+            load_postmortem
+        fr = self._recorder(tmp_path, 5, "divergence")
+        bundle, _ = load_postmortem(fr.last_bundle)
+        assert "critpath_diff" not in bundle["context"]
+
+    def test_pre_v7_bundle_renders_graceful_note(self, tmp_path):
+        from commefficient_tpu.telemetry.flightrec import (
+            FlightRecorder, load_postmortem)
+        fr = FlightRecorder(Config(), ring_rounds=4,
+                            out_dir=str(tmp_path / "pm"))
+        rec = make_round_record(0)   # no causal stamp at all
+        rec["alarms"] = [{"rule": "slo_burn", "round": 0,
+                          "value": 3.0, "threshold": 1.0}]
+        fr.write(rec)
+        bundle, _ = load_postmortem(fr.last_bundle)
+        assert "critpath_diff" not in bundle["context"]
+        tr = _load_script("telemetry_report")
+        import io
+        from contextlib import redirect_stdout
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert tr.postmortem_report(fr.last_bundle, False) == 0
+        assert "no causal data" in buf.getvalue()
+
+
+# --- consumers: fedwatch + --critpath report ---------------------------
+
+
+class TestConsumers:
+    def test_fedwatch_scrape_path_derives_crit_column(self):
+        fw = _load_script("fedwatch")
+        jobs = fw.job_table([
+            ("commeff_rounds_total", {"job": "0"}, 3.0),
+            ("commeff_critpath_seconds",
+             {"job": "0", "bucket": "h2d"}, 0.6),
+            ("commeff_critpath_seconds",
+             {"job": "0", "bucket": "compute"}, 0.4),
+            ("commeff_rounds_total", {"job": "1"}, 2.0),
+        ])
+        assert jobs["0"]["crit"] == "h2d 60%"
+        assert "crit" not in jobs["1"]      # untraced job: no column
+        assert fw._fmt(jobs["0"]["crit"]) == "h2d 60%"
+        table = fw.render_table(jobs)
+        assert "crit" in table and "h2d 60%" in table
+
+    def test_fedwatch_ledger_path_derives_crit_column(self, tmp_path):
+        fw = _load_script("fedwatch")
+        led = str(tmp_path / "svc.jsonl")
+        with open(led, "w") as f:
+            f.write("\n")
+        rec = make_round_record(0)
+        rec["causal"] = _stamp(0, 0,
+                               [_gspan(8, "h2d", 1, 9, job=0, r=0)])
+        with open(f"{led}.job0.jsonl", "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        jobs = fw.ledger_table(led)
+        assert jobs["0"]["crit"] == "h2d 80%"
+
+    def test_report_critpath_explains_and_degrades(self, tmp_path,
+                                                   capsys):
+        tr = _load_script("telemetry_report")
+        recs = []
+        for r in range(3):
+            rec = make_round_record(r)
+            rec["causal"] = _stamp(
+                None, r, [_gspan(8, "h2d", 1, 3, r=r)])
+            recs.append(rec)
+        assert tr.critpath_report(recs, as_json=False) == 0
+        out = capsys.readouterr().out
+        assert "critical path (3 traced round(s))" in out
+        assert "aggregate bucket shares" in out
+        # JSON mode round-trips
+        assert tr.critpath_report(recs, as_json=True) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert len(payload["rounds"]) == 3
+        assert payload["aggregate"]["wall_s"] == pytest.approx(30.0)
+        # pre-v7 ledger (or off run): graceful note, exit 1
+        assert tr.critpath_report([make_round_record(0)],
+                                  as_json=False) == 1
+        assert "no causal data" in capsys.readouterr().out
+
+
+# --- the flowlint confinement rule -------------------------------------
+
+
+class TestConfinement:
+    def test_rule_is_registered(self):
+        from commefficient_tpu.analysis.lint import \
+            FLOW_CHECKERS_BY_NAME
+        assert "causal-confinement" in FLOW_CHECKERS_BY_NAME
+
+    def test_jit_reachable_causal_code_flagged(self, tmp_path):
+        from commefficient_tpu.analysis.flow import run_flow
+        from commefficient_tpu.analysis.lint import \
+            FLOW_CHECKERS_BY_NAME
+
+        def tree(files):
+            for rel, src in files.items():
+                p = tmp_path / rel
+                p.parent.mkdir(parents=True, exist_ok=True)
+                p.write_text(textwrap.dedent(src))
+            return tmp_path
+
+        root = tree({
+            "telemetry/causal.py": """
+                def mark(x):
+                    return x
+                """,
+            "core/r.py": """
+                import jax
+
+                from telemetry.causal import mark
+
+                def build(cfg):
+                    def traced(x):
+                        return mark(x)
+                    return traced
+
+                step = jax.jit(build(None))
+                """,
+        })
+        vs = run_flow(root=root, checkers=[
+            FLOW_CHECKERS_BY_NAME["causal-confinement"]])
+        assert len(vs) == 1
+        assert vs[0].path == "telemetry/causal.py"
+        assert "host-side only" in vs[0].message
+
+    def test_host_side_causal_code_is_clean(self, tmp_path):
+        from commefficient_tpu.analysis.flow import run_flow
+        from commefficient_tpu.analysis.lint import \
+            FLOW_CHECKERS_BY_NAME
+        p = tmp_path / "telemetry" / "causal.py"
+        p.parent.mkdir(parents=True)
+        p.write_text(textwrap.dedent("""
+            def mark(x):
+                return x
+
+            def host_loop():
+                return mark(1)
+            """))
+        (tmp_path / "core").mkdir()
+        (tmp_path / "core" / "r.py").write_text(textwrap.dedent("""
+            import jax
+            import jax.numpy as jnp
+
+            def build(cfg):
+                def traced(x):
+                    return jnp.sum(x)
+                return traced
+
+            step = jax.jit(build(None))
+            """))
+        vs = run_flow(root=tmp_path, checkers=[
+            FLOW_CHECKERS_BY_NAME["causal-confinement"]])
+        assert vs == []

@@ -311,7 +311,12 @@ def test_probes_off_program_identical(mode, error_type):
         # they observe the round stream, never enter the program
         live_port=1, flightrec_rounds=4, slo_round_p95=0.5,
         slo_staleness_max=2.0, slo_starvation=1.0,
-        slo_window=16, slo_fast_window=4, alarm_slo_burn=2.0)
+        slo_window=16, slo_fast_window=4, alarm_slo_burn=2.0,
+        # causal round tracing is host-side span bookkeeping: the
+        # tracer hooks live in telemetry/_Span, never in a traced
+        # body (the causal-confinement flowlint rule pins this
+        # structurally; this pins the emitted program)
+        causal_trace=True)
     assert _lower_text(
         build_client_round(inert_cfg, linear_loss, 3,
                            transmit_transform=None),
